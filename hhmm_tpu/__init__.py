@@ -1,0 +1,24 @@
+"""hhmm_tpu — TPU-native Bayesian (Hierarchical) Hidden Markov Models.
+
+A JAX/XLA-first framework with the capabilities of the `gsoc17-hhmm`
+research-replication project (R + Stan): simulators, fully Bayesian NUTS
+inference, and financial applications for the HMM model family.
+
+Layer map (see SURVEY.md §7):
+
+- ``core``     — log-space primitives, distributions, constraint bijectors.
+- ``kernels``  — forward / backward / smoothing / Viterbi / FFBS as
+  differentiable ``lax.scan`` recursions over a generic step interface.
+- ``sim``      — generative simulators (HMM, IOHMM) mirroring
+  ``hmm/R/hmm-sim.R`` and ``iohmm-reg/R/iohmm-sim.R`` of the reference.
+- ``models``   — declarative model zoo mirroring the reference's Stan files.
+- ``hhmm``     — hierarchical-HMM tree DSL, recursive simulator, and the
+  compiler from tree → expanded sparse HMM.
+- ``infer``    — iterative NUTS on TPU (vmapped chains), Stan-style warmup
+  adaptation, Rhat/ESS diagnostics, k-means inits, relabeling.
+- ``parallel`` — mesh sharding for many-series scale-out, result caching.
+- ``apps``     — Hassan (2005) forecasting and Tayal (2009) trading
+  pipelines.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,19 @@
+from hhmm_tpu.core.lmath import (
+    logsumexp,
+    log_normalize,
+    log_matvec,
+    log_vecmat,
+    softmax,
+)
+from hhmm_tpu.core import dists
+from hhmm_tpu.core import bijectors
+
+__all__ = [
+    "logsumexp",
+    "log_normalize",
+    "log_matvec",
+    "log_vecmat",
+    "softmax",
+    "dists",
+    "bijectors",
+]

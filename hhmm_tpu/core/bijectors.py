@@ -1,0 +1,176 @@
+"""Constraint bijectors: unconstrained ℝⁿ → constrained parameter spaces.
+
+These mirror Stan's constrained-parameter transforms, which the reference
+relies on for every model:
+
+- ``positive``  — Stan ``real<lower=0>`` (scale parameters, e.g.
+  `hmm/stan/hmm.stan:21` ``sigma_k``).
+- ``ordered``   — Stan ``ordered[K]`` identifiability constraint
+  (`hmm/stan/hmm.stan:20` ``ordered[K] mu_k``,
+  `iohmm-mix/stan/iohmm-mix.stan:19` ``ordered[L] mu_kl``).
+- ``simplex``   — Stan ``simplex[K]`` rows of transition matrices and
+  initial distributions (stick-breaking construction, Stan reference
+  manual §10.7).
+- ``unit_interval`` — ``real<lower=0, upper=1>`` free transition
+  probabilities of the Tayal sparse HMM
+  (`tayal2009/stan/hhmm-tayal2009.stan:15-22`).
+
+Each bijector maps a flat unconstrained slice to the constrained value and
+returns the log-|Jacobian| so the NUTS potential can be written on the
+unconstrained space, exactly as Stan's HMC does.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class Bijector:
+    """Maps an unconstrained vector of size ``n_free`` to a constrained array."""
+
+    n_free: int
+    shape: Tuple[int, ...]
+
+    def forward(self, x):
+        """Return (constrained_value, log_det_jacobian)."""
+        raise NotImplementedError
+
+    def inverse(self, y):
+        """Constrained → unconstrained (used for inits only; no jacobian)."""
+        raise NotImplementedError
+
+
+@dataclass
+class Identity(Bijector):
+    shape: Tuple[int, ...]
+
+    def __post_init__(self):
+        self.n_free = int(np.prod(self.shape)) if self.shape else 1
+
+    def forward(self, x):
+        return x.reshape(self.shape), jnp.zeros(())
+
+    def inverse(self, y):
+        return jnp.asarray(y).reshape(-1)
+
+
+@dataclass
+class Positive(Bijector):
+    """y = exp(x); log|J| = sum(x)."""
+
+    shape: Tuple[int, ...]
+
+    def __post_init__(self):
+        self.n_free = int(np.prod(self.shape)) if self.shape else 1
+
+    def forward(self, x):
+        return jnp.exp(x).reshape(self.shape), jnp.sum(x)
+
+    def inverse(self, y):
+        return jnp.log(jnp.asarray(y)).reshape(-1)
+
+
+@dataclass
+class UnitInterval(Bijector):
+    """y = sigmoid(x); log|J| = sum(log y + log(1-y))."""
+
+    shape: Tuple[int, ...]
+
+    def __post_init__(self):
+        self.n_free = int(np.prod(self.shape)) if self.shape else 1
+
+    def forward(self, x):
+        y = jax.nn.sigmoid(x)
+        ldj = jnp.sum(jax.nn.log_sigmoid(x) + jax.nn.log_sigmoid(-x))
+        return y.reshape(self.shape), ldj
+
+    def inverse(self, y):
+        y = jnp.asarray(y).reshape(-1)
+        return jnp.log(y) - jnp.log1p(-y)
+
+
+@dataclass
+class Ordered(Bijector):
+    """Stan ordered vector: y[0] = x[0], y[k] = y[k-1] + exp(x[k]).
+
+    Supports a leading batch shape: ``shape=(K, L)`` means K independent
+    ordered-L vectors (ordering along the last axis), as in
+    ``ordered[L] mu_kl[K]``.
+    """
+
+    shape: Tuple[int, ...]
+
+    def __post_init__(self):
+        self.n_free = int(np.prod(self.shape))
+
+    def forward(self, x):
+        x = x.reshape(self.shape)
+        first = x[..., :1]
+        rest = jnp.exp(x[..., 1:])
+        y = jnp.concatenate([first, rest], axis=-1)
+        y = jnp.cumsum(y, axis=-1)
+        return y, jnp.sum(x[..., 1:])
+
+    def inverse(self, y):
+        y = jnp.asarray(y).reshape(self.shape)
+        first = y[..., :1]
+        rest = jnp.log(jnp.diff(y, axis=-1))
+        return jnp.concatenate([first, rest], axis=-1).reshape(-1)
+
+
+@dataclass
+class Simplex(Bijector):
+    """Stan stick-breaking simplex.
+
+    ``shape`` is the constrained shape, last axis K (the simplex axis);
+    free size is ``prod(shape[:-1]) * (K - 1)``.
+
+    z_k = sigmoid(x_k + log(1 / (K - k))),  y_k = z_k * (1 - sum_{j<k} y_j).
+    """
+
+    shape: Tuple[int, ...]
+
+    def __post_init__(self):
+        K = self.shape[-1]
+        self.n_free = int(np.prod(self.shape[:-1], dtype=np.int64)) * (K - 1) if K > 1 else 0
+        self._K = K
+
+    def forward(self, x):
+        K = self._K
+        if K == 1:
+            return jnp.ones(self.shape), jnp.zeros(())
+        x = x.reshape(self.shape[:-1] + (K - 1,))
+        offsets = -jnp.log(jnp.arange(K - 1, 0, -1, dtype=x.dtype))
+        logit_z = x + offsets
+        log_z = jax.nn.log_sigmoid(logit_z)
+        log_1mz = jax.nn.log_sigmoid(-logit_z)
+        # log of remaining stick after each break: cumsum of log(1-z)
+        log_rem = jnp.cumsum(log_1mz, axis=-1)
+        log_rem_before = jnp.concatenate(
+            [jnp.zeros_like(log_rem[..., :1]), log_rem[..., :-1]], axis=-1
+        )
+        log_y_head = log_z + log_rem_before
+        log_y_tail = log_rem[..., -1:]
+        log_y = jnp.concatenate([log_y_head, log_y_tail], axis=-1)
+        # |J| = prod_k z_k (1 - z_k) * rem_before_k  (Stan manual §10.7)
+        ldj = jnp.sum(log_z + log_1mz + log_rem_before)
+        return jnp.exp(log_y), ldj
+
+    def inverse(self, y):
+        K = self._K
+        if K == 1:
+            return jnp.zeros((0,))
+        y = jnp.asarray(y).reshape(self.shape)
+        csum = jnp.cumsum(y, axis=-1)
+        rem_before = jnp.concatenate(
+            [jnp.ones_like(csum[..., :1]), 1.0 - csum[..., :-2], ], axis=-1
+        ) if K > 2 else jnp.ones_like(y[..., :1])
+        z = y[..., :-1] / rem_before
+        offsets = -jnp.log(jnp.arange(K - 1, 0, -1, dtype=y.dtype))
+        x = jnp.log(z) - jnp.log1p(-z) - offsets
+        return x.reshape(-1)

@@ -1,0 +1,64 @@
+"""Minimal distribution library (log-pdfs + samplers) for the model zoo.
+
+Covers every distribution the reference's Stan models use:
+normal (`hmm/stan/hmm.stan:60-62` style priors/emissions), half-normal-via-
+constraint scale priors, categorical/multinomial emissions
+(`hmm/stan/hmm-multinom.stan:21`), per-state Gaussian mixtures
+(`iohmm-mix/stan/iohmm-mix.stan:53-65`), and Dirichlet priors on simplex
+rows (Stan's implicit uniform-on-simplex is Dirichlet(1)).
+
+Shapes broadcast; everything is jittable and differentiable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import gammaln, logsumexp
+
+_HALF_LOG_2PI = 0.5 * jnp.log(2.0 * jnp.pi)
+
+
+def normal_logpdf(x, mu=0.0, sigma=1.0):
+    z = (x - mu) / sigma
+    return -0.5 * z * z - jnp.log(sigma) - _HALF_LOG_2PI
+
+
+def normal_sample(key, mu=0.0, sigma=1.0, shape=()):
+    return mu + sigma * jax.random.normal(key, shape)
+
+
+def categorical_logpmf(x, log_p):
+    """``x`` integer in [0, K); ``log_p`` [..., K] (need not be normalized)."""
+    log_p = log_p - logsumexp(log_p, axis=-1, keepdims=True)
+    return jnp.take_along_axis(log_p, x[..., None], axis=-1)[..., 0]
+
+
+def categorical_sample(key, log_p, shape=()):
+    return jax.random.categorical(key, log_p, shape=shape or None)
+
+
+def dirichlet_logpdf(p, alpha):
+    """Log-density of a simplex point ``p`` under Dirichlet(alpha)."""
+    return (
+        jnp.sum((alpha - 1.0) * jnp.log(p), axis=-1)
+        + gammaln(jnp.sum(alpha, axis=-1))
+        - jnp.sum(gammaln(alpha), axis=-1)
+    )
+
+
+def mixture_normal_logpdf(x, log_w, mu, sigma):
+    """Gaussian-mixture log-pdf: ``logsumexp_l(log_w[l] + N(x | mu[l], sigma[l]))``.
+
+    ``x`` scalar/batched; ``log_w``, ``mu``, ``sigma`` have a trailing
+    mixture axis L. This is the inner loop of the IOHMM-mix observation
+    likelihood (`iohmm-mix/stan/iohmm-mix.stan:53-65`).
+    """
+    comp = normal_logpdf(x[..., None], mu, sigma)
+    return logsumexp(log_w + comp, axis=-1)
+
+
+def gumbel_argmax_sample(key, log_p, axis=-1):
+    """Categorical sampling via the Gumbel-max trick (vmappable over batches)."""
+    g = jax.random.gumbel(key, log_p.shape)
+    return jnp.argmax(log_p + g, axis=axis)

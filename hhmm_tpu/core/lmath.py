@@ -1,0 +1,39 @@
+"""Log-space math primitives.
+
+TPU-native equivalent of the reference's ``common/R/math.R:2-9``
+(``logsumexp``, ``softmax``), extended with the log-space matrix/vector
+products that every HMM recursion is built from.
+
+All functions are pure, jittable, and differentiable; they are the
+inner ops of the ``lax.scan`` kernels in :mod:`hhmm_tpu.kernels`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.nn import softmax  # re-export; same semantics as common/R/math.R:7-9
+from jax.scipy.special import logsumexp
+
+__all__ = ["logsumexp", "softmax", "log_normalize", "log_matvec", "log_vecmat"]
+
+
+def log_normalize(log_x: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    """Normalize a log-space vector so that ``exp`` of it sums to one."""
+    return log_x - logsumexp(log_x, axis=axis, keepdims=True)
+
+
+def log_vecmat(log_x: jnp.ndarray, log_A: jnp.ndarray) -> jnp.ndarray:
+    """Log-space row-vector × matrix: ``out[j] = logsumexp_i(x[i] + A[i, j])``.
+
+    This is the forward-recursion step with the convention
+    ``A[i, j] = log P(z_t = j | z_{t-1} = i)``.
+    """
+    return logsumexp(log_x[..., :, None] + log_A, axis=-2)
+
+
+def log_matvec(log_A: jnp.ndarray, log_x: jnp.ndarray) -> jnp.ndarray:
+    """Log-space matrix × column-vector: ``out[i] = logsumexp_j(A[i, j] + x[j])``.
+
+    This is the backward-recursion step.
+    """
+    return logsumexp(log_A + log_x[..., None, :], axis=-1)

@@ -1,0 +1,13 @@
+from hhmm_tpu.infer.run import sample_nuts, SamplerConfig
+from hhmm_tpu.infer.diagnostics import split_rhat, ess, summary
+from hhmm_tpu.infer.relabel import greedy_relabel, confusion_matrix
+
+__all__ = [
+    "sample_nuts",
+    "SamplerConfig",
+    "split_rhat",
+    "ess",
+    "summary",
+    "greedy_relabel",
+    "confusion_matrix",
+]

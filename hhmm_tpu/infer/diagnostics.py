@@ -1,0 +1,115 @@
+"""MCMC diagnostics: split-R̂, effective sample size, posterior summaries.
+
+The reference's acceptance gates are Rhat/n_eff from ``summary(stan.fit)``
+plus shinystan inspection (`hmm/main.R:59-87`, SURVEY.md §4 item 3).
+These are the same estimators (Gelman et al. BDA3 / Stan reference:
+split-chain R̂; ESS via FFT autocovariance with Geyer's initial monotone
+positive sequence), implemented host-side in NumPy — diagnostics are not
+on the hot path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["split_rhat", "ess", "summary"]
+
+
+def _split_chains(x: np.ndarray) -> np.ndarray:
+    """[chains, draws] → [2*chains, draws//2]."""
+    c, n = x.shape
+    half = n // 2
+    return np.concatenate([x[:, :half], x[:, n - half :]], axis=0)
+
+
+def split_rhat(x: np.ndarray) -> float:
+    """Potential scale reduction on split chains. ``x`` is [chains, draws]."""
+    x = _split_chains(np.asarray(x, dtype=np.float64))
+    m, n = x.shape
+    chain_means = x.mean(axis=1)
+    chain_vars = x.var(axis=1, ddof=1)
+    W = chain_vars.mean()
+    B = n * chain_means.var(ddof=1) if m > 1 else 0.0
+    var_plus = (n - 1) / n * W + B / n
+    if W <= 0:
+        return 1.0
+    return float(np.sqrt(var_plus / W))
+
+
+def _autocovariance_fft(x: np.ndarray) -> np.ndarray:
+    """Biased autocovariance per chain via FFT. x: [chains, draws]."""
+    m, n = x.shape
+    xc = x - x.mean(axis=1, keepdims=True)
+    pad = int(2 ** np.ceil(np.log2(2 * n)))
+    f = np.fft.rfft(xc, pad, axis=1)
+    acov = np.fft.irfft(f * np.conj(f), pad, axis=1)[:, :n].real
+    return acov / n
+
+
+def ess(x: np.ndarray) -> float:
+    """Bulk effective sample size (Stan's estimator, Geyer truncation)."""
+    x = np.asarray(x, dtype=np.float64)
+    x = _split_chains(x)
+    m, n = x.shape
+    if n < 4:
+        return float(m * n)
+    acov = _autocovariance_fft(x)
+    chain_var = acov[:, 0] * n / (n - 1.0)
+    mean_var = chain_var.mean()
+    var_plus = mean_var * (n - 1.0) / n
+    if m > 1:
+        var_plus += x.mean(axis=1).var(ddof=1)
+    if var_plus <= 0:
+        return float(m * n)
+
+    rho = 1.0 - (mean_var - acov.mean(axis=0)) / var_plus  # rho[0] = 1
+    # Geyer initial positive monotone sequence on paired sums
+    max_pairs = (n - 1) // 2
+    rho_even = rho[0 : 2 * max_pairs : 2]
+    rho_odd = rho[1 : 2 * max_pairs + 1 : 2]
+    paired = rho_even + rho_odd
+    # initial positive
+    positive = paired > 0
+    if not positive[0]:
+        tau = 1.0
+    else:
+        first_neg = np.argmax(~positive) if np.any(~positive) else len(paired)
+        p = paired[:first_neg]
+        # monotone decreasing
+        p = np.minimum.accumulate(p)
+        tau = -1.0 + 2.0 * np.sum(p)
+    tau = max(tau, 1.0 / np.log10(m * n + 10))
+    return float(min(m * n / tau, m * n * np.log10(m * n)))
+
+
+def summary(
+    samples: Dict[str, np.ndarray],
+    probs=(0.025, 0.25, 0.5, 0.75, 0.975),
+) -> Dict[str, Dict[str, np.ndarray]]:
+    """Per-parameter posterior summary table.
+
+    ``samples[name]`` is [chains, draws, ...]; returns mean/sd/quantiles/
+    n_eff/Rhat per scalar component — the equivalent of the reference's
+    ``summary(stan.fit)`` block in every driver (`hmm/main.R:59-62`).
+    """
+    out = {}
+    for name, arr in samples.items():
+        arr = np.asarray(arr)
+        c, n = arr.shape[:2]
+        flatdim = int(np.prod(arr.shape[2:], dtype=np.int64)) if arr.ndim > 2 else 1
+        flat = arr.reshape(c, n, flatdim)
+        stats = {
+            "mean": flat.mean(axis=(0, 1)),
+            "sd": flat.std(axis=(0, 1), ddof=1),
+            "n_eff": np.array([ess(flat[:, :, i]) for i in range(flatdim)]),
+            "rhat": np.array([split_rhat(flat[:, :, i]) for i in range(flatdim)]),
+        }
+        for p in probs:
+            stats[f"q{int(p * 100)}" if p not in (0.025, 0.975) else f"q{p * 100:g}"] = (
+                np.quantile(flat, p, axis=(0, 1))
+            )
+        stats["shape"] = arr.shape[2:]
+        out[name] = stats
+    return out

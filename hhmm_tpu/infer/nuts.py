@@ -1,0 +1,361 @@
+"""Iterative No-U-Turn Sampler, XLA-compatible (static shapes, bounded depth).
+
+The reference's inference engine is Stan's recursive NUTS (every model is
+fit with ``rstan::stan``, e.g. `hmm/main.R:49-54`). Recursion and dynamic
+trajectory lengths don't map to XLA, so this is the *iterative* form of
+multinomial NUTS (Hoffman & Gelman 2014; Betancourt 2017 multinomial
+weights; iterative U-turn bookkeeping after Phan et al. 2019, as in
+NumPyro/TFP): trajectory doubling is a bounded ``lax.while_loop``, and
+within-subtree U-turn checks use O(log2 max_leaves) momentum checkpoints
+indexed by the bit pattern of the leaf counter — all static shapes, fully
+``vmap``-able over chains and series (SURVEY.md §7.3 "NUTS on TPU").
+
+Conventions: positions are flat f32 vectors on the *unconstrained* space;
+``logp_fn(q) -> (logp, grad)`` is the joint log-density (model handles
+constraint transforms + Jacobians, exactly like Stan); kinetic energy uses
+a diagonal inverse mass matrix.
+
+Divergence threshold follows Stan (ΔH > 1000).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["NUTSInfo", "nuts_step", "find_reasonable_step_size"]
+
+DELTA_MAX = 1000.0
+
+
+class NUTSInfo(NamedTuple):
+    accept_prob: jnp.ndarray  # mean Metropolis accept prob over trajectory
+    num_leaves: jnp.ndarray  # leapfrog steps taken this transition
+    diverging: jnp.ndarray  # bool
+    energy: jnp.ndarray  # -logp + kinetic at the accepted point
+    depth: jnp.ndarray  # tree depth reached
+
+
+def _leapfrog(logp_fn, inv_mass, eps, q, p, grad):
+    p = p + 0.5 * eps * grad
+    q = q + eps * inv_mass * p
+    logp, grad = logp_fn(q)
+    p = p + 0.5 * eps * grad
+    return q, p, logp, grad
+
+
+def _kinetic(inv_mass, p):
+    return 0.5 * jnp.sum(inv_mass * p * p)
+
+
+def _is_turning(inv_mass, p_left, p_right, p_sum):
+    """Generalized U-turn criterion (Betancourt; Stan appendix A.4.2 form)."""
+    v_left = inv_mass * p_left
+    v_right = inv_mass * p_right
+    rho = p_sum - 0.5 * (p_left + p_right)
+    return (jnp.dot(v_left, rho) <= 0) | (jnp.dot(v_right, rho) <= 0)
+
+
+def _trailing_ones(n):
+    """Number of contiguous low set bits of n (int32)."""
+    mask = jnp.bitwise_and(n, jnp.bitwise_not(n + 1))
+    return lax.population_count(mask)
+
+
+def _ckpt_idxs(n):
+    """Checkpoint index range to test a new odd leaf n against.
+
+    ``idx_max`` = popcount(n >> 1); ``idx_min`` = idx_max − (trailing ones
+    of n) + 1. See Phan et al. 2019 (iterative NUTS bookkeeping).
+    """
+    idx_max = lax.population_count(jnp.right_shift(n, 1))
+    idx_min = idx_max - _trailing_ones(n) + 1
+    return idx_min, idx_max
+
+
+class _SubtreeState(NamedTuple):
+    key: jax.Array
+    # moving endpoint
+    q: jnp.ndarray
+    p: jnp.ndarray
+    grad: jnp.ndarray
+    # subtree multinomial proposal
+    q_prop: jnp.ndarray
+    logp_prop: jnp.ndarray
+    grad_prop: jnp.ndarray
+    log_weight: jnp.ndarray  # logsumexp of leaf weights (-H + H0)
+    p_sum: jnp.ndarray
+    # checkpoints for iterative U-turn checks
+    p_ckpts: jnp.ndarray  # [max_depth, dim]
+    p_sum_ckpts: jnp.ndarray  # [max_depth, dim]
+    leaf_idx: jnp.ndarray
+    turning: jnp.ndarray
+    diverging: jnp.ndarray
+    sum_accept: jnp.ndarray
+    num_leaves: jnp.ndarray
+
+
+def _iterative_turning(inv_mass, p_leaf, p_sum, p_ckpts, p_sum_ckpts, idx_min, idx_max):
+    def body(state):
+        i, _ = state
+        sub_sum = p_sum - p_sum_ckpts[i] + p_ckpts[i]
+        turning = _is_turning(inv_mass, p_ckpts[i], p_leaf, sub_sum)
+        return i - 1, turning
+
+    def cond(state):
+        i, turning = state
+        return (i >= idx_min) & (~turning)
+
+    _, turning = lax.while_loop(cond, body, (idx_max, jnp.asarray(False)))
+    return turning
+
+
+def _build_subtree(
+    logp_fn, inv_mass, eps_signed, max_depth, key, q0, p0, grad0, energy0, num_leaves
+):
+    """Expand ``num_leaves`` leapfrog steps from (q0, p0), building one subtree.
+
+    Returns a _SubtreeState; early-exits on U-turn or divergence.
+    """
+    dim = q0.shape[0]
+    dtype = q0.dtype
+    init = _SubtreeState(
+        key=key,
+        q=q0,
+        p=p0,
+        grad=grad0,
+        q_prop=q0,
+        logp_prop=jnp.zeros((), dtype),
+        grad_prop=grad0,
+        log_weight=-jnp.inf,
+        p_sum=jnp.zeros((dim,), dtype),
+        p_ckpts=jnp.zeros((max_depth, dim), dtype),
+        p_sum_ckpts=jnp.zeros((max_depth, dim), dtype),
+        leaf_idx=jnp.zeros((), jnp.int32),
+        turning=jnp.asarray(False),
+        diverging=jnp.asarray(False),
+        sum_accept=jnp.zeros((), dtype),
+        num_leaves=jnp.zeros((), jnp.int32),
+    )
+
+    def cond(s: _SubtreeState):
+        return (s.leaf_idx < num_leaves) & (~s.turning) & (~s.diverging)
+
+    def body(s: _SubtreeState):
+        q, p, logp, grad = _leapfrog(logp_fn, inv_mass, eps_signed, s.q, s.p, s.grad)
+        energy = -logp + _kinetic(inv_mass, p)
+        delta = energy - energy0
+        diverging = (delta > DELTA_MAX) | jnp.isnan(delta)
+        log_w = -delta  # multinomial log weight of this leaf
+        log_w = jnp.where(diverging, -jnp.inf, log_w)
+        accept = jnp.minimum(1.0, jnp.exp(-delta))
+        accept = jnp.where(jnp.isnan(accept), 0.0, accept)
+
+        # progressive multinomial sampling within the subtree
+        new_log_weight = jnp.logaddexp(s.log_weight, log_w)
+        key, sub = jax.random.split(s.key)
+        take_new = jnp.log(jax.random.uniform(sub)) < (log_w - new_log_weight)
+        q_prop = jnp.where(take_new, q, s.q_prop)
+        logp_prop = jnp.where(take_new, logp, s.logp_prop)
+        grad_prop = jnp.where(take_new, grad, s.grad_prop)
+
+        p_sum = s.p_sum + p
+        n = s.leaf_idx
+        idx_min, idx_max = _ckpt_idxs(n)
+        is_even = (n % 2) == 0
+        p_ckpts = jnp.where(is_even, s.p_ckpts.at[idx_max].set(p), s.p_ckpts)
+        p_sum_ckpts = jnp.where(
+            is_even, s.p_sum_ckpts.at[idx_max].set(p_sum), s.p_sum_ckpts
+        )
+        # U-turn checks run on odd leaves only (even leaves just checkpoint).
+        turning = jnp.where(
+            is_even,
+            jnp.asarray(False),
+            _iterative_turning(inv_mass, p, p_sum, p_ckpts, p_sum_ckpts, idx_min, idx_max),
+        )
+        # Guard: a 1-leaf subtree can't turn on itself.
+        turning = turning & (num_leaves > 1)
+
+        return _SubtreeState(
+            key=key,
+            q=q,
+            p=p,
+            grad=grad,
+            q_prop=q_prop,
+            logp_prop=logp_prop,
+            grad_prop=grad_prop,
+            log_weight=new_log_weight,
+            p_sum=p_sum,
+            p_ckpts=p_ckpts,
+            p_sum_ckpts=p_sum_ckpts,
+            leaf_idx=n + 1,
+            turning=turning,
+            diverging=diverging,
+            sum_accept=s.sum_accept + accept,
+            num_leaves=s.num_leaves + 1,
+        )
+
+    return lax.while_loop(cond, body, init)
+
+
+class _TreeState(NamedTuple):
+    key: jax.Array
+    q_left: jnp.ndarray
+    p_left: jnp.ndarray
+    grad_left: jnp.ndarray
+    q_right: jnp.ndarray
+    p_right: jnp.ndarray
+    grad_right: jnp.ndarray
+    q_prop: jnp.ndarray
+    logp_prop: jnp.ndarray
+    grad_prop: jnp.ndarray
+    log_weight: jnp.ndarray
+    p_sum: jnp.ndarray
+    depth: jnp.ndarray
+    turning: jnp.ndarray
+    diverging: jnp.ndarray
+    sum_accept: jnp.ndarray
+    num_leaves: jnp.ndarray
+
+
+def nuts_step(
+    logp_fn: Callable[[jnp.ndarray], Tuple[jnp.ndarray, jnp.ndarray]],
+    key: jax.Array,
+    q: jnp.ndarray,
+    logp: jnp.ndarray,
+    grad: jnp.ndarray,
+    step_size: jnp.ndarray,
+    inv_mass: jnp.ndarray,
+    max_treedepth: int = 10,
+):
+    """One NUTS transition. Returns ``(q', logp', grad', NUTSInfo)``."""
+    dim = q.shape[0]
+    dtype = q.dtype
+    key, key_mom = jax.random.split(key)
+    p0 = jax.random.normal(key_mom, (dim,), dtype) / jnp.sqrt(inv_mass)
+    energy0 = -logp + _kinetic(inv_mass, p0)
+
+    init = _TreeState(
+        key=key,
+        q_left=q,
+        p_left=p0,
+        grad_left=grad,
+        q_right=q,
+        p_right=p0,
+        grad_right=grad,
+        q_prop=q,
+        logp_prop=logp,
+        grad_prop=grad,
+        log_weight=jnp.zeros((), dtype),  # initial point has weight exp(0)
+        p_sum=p0,
+        depth=jnp.zeros((), jnp.int32),
+        turning=jnp.asarray(False),
+        diverging=jnp.asarray(False),
+        sum_accept=jnp.zeros((), dtype),
+        num_leaves=jnp.zeros((), jnp.int32),
+    )
+
+    def cond(s: _TreeState):
+        return (s.depth < max_treedepth) & (~s.turning) & (~s.diverging)
+
+    def body(s: _TreeState):
+        key, key_dir, key_accept, key_sub = jax.random.split(s.key, 4)
+        go_right = jax.random.bernoulli(key_dir)
+        eps_signed = jnp.where(go_right, step_size, -step_size)
+        q0 = jnp.where(go_right, s.q_right, s.q_left)
+        p0 = jnp.where(go_right, s.p_right, s.p_left)
+        g0 = jnp.where(go_right, s.grad_right, s.grad_left)
+        num_leaves = jnp.left_shift(jnp.asarray(1, jnp.int32), s.depth)
+
+        sub = _build_subtree(
+            logp_fn, inv_mass, eps_signed, max_treedepth, key_sub,
+            q0, p0, g0, energy0, num_leaves,
+        )
+
+        complete = (~sub.turning) & (~sub.diverging)
+
+        # Biased progressive sampling across subtrees (Betancourt 2017).
+        take_new = complete & (
+            jnp.log(jax.random.uniform(key_accept)) < (sub.log_weight - s.log_weight)
+        )
+        q_prop = jnp.where(take_new, sub.q_prop, s.q_prop)
+        logp_prop = jnp.where(take_new, sub.logp_prop, s.logp_prop)
+        grad_prop = jnp.where(take_new, sub.grad_prop, s.grad_prop)
+        log_weight = jnp.logaddexp(s.log_weight, sub.log_weight)
+
+        q_left = jnp.where(go_right, s.q_left, sub.q)
+        p_left = jnp.where(go_right, s.p_left, sub.p)
+        grad_left = jnp.where(go_right, s.grad_left, sub.grad)
+        q_right = jnp.where(go_right, sub.q, s.q_right)
+        p_right = jnp.where(go_right, sub.p, s.p_right)
+        grad_right = jnp.where(go_right, sub.grad, s.grad_right)
+
+        p_sum = s.p_sum + sub.p_sum
+        turning_full = _is_turning(inv_mass, p_left, p_right, p_sum)
+        turning = sub.turning | (complete & turning_full)
+
+        return _TreeState(
+            key=key,
+            q_left=q_left,
+            p_left=p_left,
+            grad_left=grad_left,
+            q_right=q_right,
+            p_right=p_right,
+            grad_right=grad_right,
+            q_prop=q_prop,
+            logp_prop=logp_prop,
+            grad_prop=grad_prop,
+            log_weight=log_weight,
+            p_sum=p_sum,
+            depth=s.depth + 1,
+            turning=turning,
+            diverging=sub.diverging,
+            sum_accept=s.sum_accept + sub.sum_accept,
+            num_leaves=s.num_leaves + sub.num_leaves,
+        )
+
+    final = lax.while_loop(cond, body, init)
+
+    n = jnp.maximum(final.num_leaves, 1)
+    info = NUTSInfo(
+        accept_prob=final.sum_accept / n,
+        num_leaves=final.num_leaves,
+        diverging=final.diverging,
+        energy=-final.logp_prop,
+        depth=final.depth,
+    )
+    return final.q_prop, final.logp_prop, final.grad_prop, info
+
+
+def find_reasonable_step_size(logp_fn, inv_mass, q, logp, grad, key, init_step=1.0):
+    """Stan's init heuristic: double/halve ε until the one-step accept prob
+    crosses 0.5 (bounded iterations for XLA)."""
+    dim = q.shape[0]
+    p0 = jax.random.normal(key, (dim,), q.dtype) / jnp.sqrt(inv_mass)
+    energy0 = -logp + _kinetic(inv_mass, p0)
+
+    def accept_logprob(eps):
+        q1, p1, logp1, _ = _leapfrog(logp_fn, inv_mass, eps, q, p0, grad)
+        e1 = -logp1 + _kinetic(inv_mass, p1)
+        d = energy0 - e1
+        return jnp.where(jnp.isnan(d), -jnp.inf, d)
+
+    a0 = accept_logprob(init_step)
+    direction = jnp.where(a0 > jnp.log(0.5), 1.0, -1.0)
+
+    def cond(state):
+        eps, it = state
+        a = accept_logprob(eps)
+        keep = jnp.where(direction > 0, a > jnp.log(0.5), a < jnp.log(0.5))
+        return keep & (it < 50) & (eps > 1e-7) & (eps < 1e7)
+
+    def body(state):
+        eps, it = state
+        return eps * jnp.where(direction > 0, 2.0, 0.5), it + 1
+
+    eps, _ = lax.while_loop(cond, body, (jnp.asarray(init_step, q.dtype), 0))
+    return eps

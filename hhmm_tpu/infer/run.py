@@ -1,0 +1,247 @@
+"""Warmup adaptation + sampling loop, vmapped over chains.
+
+Reproduces Stan's warmup machinery (the engine behind every ``rstan::stan``
+call in the reference, e.g. `hmm/main.R:49-54`):
+
+- dual-averaging step-size adaptation (Hoffman & Gelman 2014, Stan's
+  defaults γ=0.05, t0=10, κ=0.75, target accept δ=0.8),
+- diagonal mass-matrix estimation over Stan's expanding adaptation
+  windows (init buffer 75, base window 25 doubling, term buffer 50 —
+  rescaled proportionally for short warmups, as Stan does),
+- Welford online variance with Stan's shrinkage toward unit
+  ``(n / (n+5)) var + 1e-3 (5 / (n+5))``.
+
+The whole run (warmup + sampling) is two ``lax.scan``s inside one ``jit``;
+chains are ``vmap``ed (the TPU-native replacement for RStan's
+chain-per-core forking, SURVEY.md §2.9) and the result is further
+``vmap``-able over batched series.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from hhmm_tpu.infer.nuts import nuts_step, find_reasonable_step_size, NUTSInfo
+
+__all__ = ["SamplerConfig", "sample_nuts", "warmup_schedule"]
+
+
+@dataclass(frozen=True)
+class SamplerConfig:
+    """MCMC budget — mirrors the reference drivers' "Set up" blocks
+    (`hmm/main.R:13-18`: iter/warmup/chains/seed)."""
+
+    num_warmup: int = 500
+    num_samples: int = 500
+    num_chains: int = 1
+    max_treedepth: int = 10
+    target_accept: float = 0.8
+    init_step_size: float = 0.1
+
+
+def warmup_schedule(num_warmup: int):
+    """Stan's three-phase warmup: returns (update_mass[t], window_end[t]) bools."""
+    init_buffer, term_buffer, base_window = 75, 50, 25
+    if num_warmup < init_buffer + term_buffer + base_window:
+        init_buffer = int(0.15 * num_warmup)
+        term_buffer = int(0.10 * num_warmup)
+        base_window = num_warmup - init_buffer - term_buffer
+    update_mass = np.zeros(num_warmup, dtype=bool)
+    window_end = np.zeros(num_warmup, dtype=bool)
+    update_mass[init_buffer : num_warmup - term_buffer] = True
+    # expanding windows: 25, 50, 100, ... within the mass phase
+    t = init_buffer
+    w = base_window
+    while t < num_warmup - term_buffer:
+        end = t + w
+        if end + 2 * w > num_warmup - term_buffer:
+            end = num_warmup - term_buffer
+        window_end[min(end, num_warmup) - 1] = True
+        t = end
+        w *= 2
+    return jnp.asarray(update_mass), jnp.asarray(window_end)
+
+
+class _DAState(NamedTuple):
+    log_eps: jnp.ndarray
+    log_eps_bar: jnp.ndarray
+    h_bar: jnp.ndarray
+    mu: jnp.ndarray
+    count: jnp.ndarray
+
+
+def _da_init(eps):
+    return _DAState(
+        log_eps=jnp.log(eps),
+        log_eps_bar=jnp.zeros_like(eps),
+        h_bar=jnp.zeros_like(eps),
+        mu=jnp.log(10.0 * eps),
+        count=jnp.zeros_like(eps),
+    )
+
+
+def _da_update(s: _DAState, accept_prob, target):
+    gamma, t0, kappa = 0.05, 10.0, 0.75
+    count = s.count + 1.0
+    eta = 1.0 / (count + t0)
+    h_bar = (1.0 - eta) * s.h_bar + eta * (target - accept_prob)
+    log_eps = s.mu - jnp.sqrt(count) / gamma * h_bar
+    x_eta = count ** (-kappa)
+    log_eps_bar = x_eta * log_eps + (1.0 - x_eta) * s.log_eps_bar
+    return _DAState(log_eps, log_eps_bar, h_bar, s.mu, count)
+
+
+class _Welford(NamedTuple):
+    n: jnp.ndarray
+    mean: jnp.ndarray
+    m2: jnp.ndarray
+
+
+def _welford_init(dim, dtype):
+    return _Welford(jnp.zeros((), dtype), jnp.zeros((dim,), dtype), jnp.zeros((dim,), dtype))
+
+
+def _welford_update(s: _Welford, x):
+    n = s.n + 1.0
+    d = x - s.mean
+    mean = s.mean + d / n
+    m2 = s.m2 + d * (x - mean)
+    return _Welford(n, mean, m2)
+
+
+def _welford_variance(s: _Welford):
+    var = s.m2 / jnp.maximum(s.n - 1.0, 1.0)
+    # Stan's regularization toward the unit metric
+    return (s.n / (s.n + 5.0)) * var + 1e-3 * (5.0 / (s.n + 5.0))
+
+
+def _single_chain(
+    logp_fn,
+    key,
+    q0,
+    num_warmup,
+    num_samples,
+    max_treedepth,
+    target_accept,
+    init_step_size,
+):
+    dim = q0.shape[0]
+    dtype = q0.dtype
+    update_mass, window_end = warmup_schedule(num_warmup)
+
+    value_and_grad = jax.value_and_grad(lambda q: logp_fn(q))
+
+    def lp(q):
+        return value_and_grad(q)
+
+    logp0, grad0 = lp(q0)
+    key, key_eps = jax.random.split(key)
+    inv_mass0 = jnp.ones((dim,), dtype)
+    eps0 = find_reasonable_step_size(
+        lp, inv_mass0, q0, logp0, grad0, key_eps, init_step_size
+    )
+
+    warm_init = (
+        q0,
+        logp0,
+        grad0,
+        _da_init(eps0),
+        inv_mass0,
+        _welford_init(dim, dtype),
+        key,
+    )
+
+    def warm_step(carry, xs):
+        q, logp, grad, da, inv_mass, wf, key = carry
+        upd_mass, win_end = xs
+        key, sub = jax.random.split(key)
+        eps = jnp.exp(da.log_eps)
+        q, logp, grad, info = nuts_step(
+            lp, sub, q, logp, grad, eps, inv_mass, max_treedepth
+        )
+        da = _da_update(da, info.accept_prob, target_accept)
+        wf = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(upd_mass, new, old), _welford_update(wf, q), wf
+        )
+
+        # at a window end: adopt new mass matrix, reset welford + DA
+        new_inv_mass = _welford_variance(wf)
+        inv_mass = jnp.where(win_end, new_inv_mass, inv_mass)
+        fresh_da = _da_init(jnp.exp(da.log_eps))
+        da = jax.tree_util.tree_map(
+            lambda f, o: jnp.where(win_end, f, o), fresh_da, da
+        )
+        wf = jax.tree_util.tree_map(
+            lambda f, o: jnp.where(win_end, f, o), _welford_init(dim, dtype), wf
+        )
+        return (q, logp, grad, da, inv_mass, wf, key), info.diverging
+
+    (q, logp, grad, da, inv_mass, _, key), warm_div = lax.scan(
+        warm_step, warm_init, (update_mass, window_end)
+    )
+
+    eps_final = jnp.exp(da.log_eps_bar)
+
+    def samp_step(carry, _):
+        q, logp, grad, key = carry
+        key, sub = jax.random.split(key)
+        q, logp, grad, info = nuts_step(
+            lp, sub, q, logp, grad, eps_final, inv_mass, max_treedepth
+        )
+        return (q, logp, grad, key), (q, logp, info)
+
+    _, (qs, logps, infos) = lax.scan(
+        samp_step, (q, logp, grad, key), None, length=num_samples
+    )
+    stats = {
+        "accept_prob": infos.accept_prob,
+        "num_leaves": infos.num_leaves,
+        "diverging": infos.diverging,
+        "depth": infos.depth,
+        "logp": logps,
+        "step_size": eps_final,
+        "inv_mass": inv_mass,
+        "warmup_diverging": warm_div,
+    }
+    return qs, stats
+
+
+def sample_nuts(
+    logp_fn: Callable[[jnp.ndarray], jnp.ndarray],
+    key: jax.Array,
+    init_q: jnp.ndarray,
+    config: SamplerConfig = SamplerConfig(),
+    jit: bool = True,
+):
+    """Run NUTS. ``init_q`` is [dim] (broadcast to chains) or [chains, dim].
+
+    Returns ``(samples [chains, num_samples, dim], stats dict)``.
+    """
+    C = config.num_chains
+    init_q = jnp.atleast_2d(jnp.asarray(init_q))
+    if init_q.shape[0] == 1 and C > 1:
+        init_q = jnp.tile(init_q, (C, 1))
+    if init_q.shape[0] != C:
+        raise ValueError(f"init_q has {init_q.shape[0]} rows, config.num_chains={C}")
+    keys = jax.random.split(key, C)
+
+    run = partial(
+        _single_chain,
+        logp_fn,
+        num_warmup=config.num_warmup,
+        num_samples=config.num_samples,
+        max_treedepth=config.max_treedepth,
+        target_accept=config.target_accept,
+        init_step_size=config.init_step_size,
+    )
+    fn = jax.vmap(run)
+    if jit:
+        fn = jax.jit(fn)
+    return fn(keys, init_q)

@@ -1,0 +1,17 @@
+from hhmm_tpu.kernels.filtering import (
+    forward_filter,
+    backward_pass,
+    smooth,
+    forward_backward,
+)
+from hhmm_tpu.kernels.viterbi import viterbi
+from hhmm_tpu.kernels.ffbs import ffbs_sample
+
+__all__ = [
+    "forward_filter",
+    "backward_pass",
+    "smooth",
+    "forward_backward",
+    "viterbi",
+    "ffbs_sample",
+]

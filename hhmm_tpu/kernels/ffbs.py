@@ -1,0 +1,72 @@
+"""Forward-filtering backward-sampling (FFBS).
+
+Draws a state path from the exact posterior ``p(z_{1:T} | x_{1:T}, θ)``.
+The reference obtains posterior state draws only implicitly, through
+per-MCMC-draw generated quantities; FFBS is the first-class TPU-native
+equivalent (SURVEY.md §7.1 item 2) and the building block for blocked
+Gibbs samplers over (θ, z).
+
+Backward sampling: ``z_T ~ Cat(softmax(log_alpha[T]))``;
+``z_t ~ Cat(softmax(log_alpha[t] + log_A_t[:, z_{t+1}]))``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from hhmm_tpu.kernels.filtering import forward_filter, _split_A
+
+__all__ = ["ffbs_sample"]
+
+
+def ffbs_sample(
+    key: jax.Array,
+    log_pi: jnp.ndarray,
+    log_A: jnp.ndarray,
+    log_obs: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Sample one state path ``z [T] int32`` from the smoothing posterior.
+
+    With a tail-padding ``mask``, padded steps repeat the last valid state.
+    """
+    T, K = log_obs.shape
+    A_t = _split_A(log_A, T)
+
+    log_alpha, _ = forward_filter(log_pi, log_A, log_obs, mask)
+
+    key_last, key_rest = jax.random.split(key)
+    z_last = jax.random.categorical(key_last, log_alpha[T - 1])
+
+    keys = jax.random.split(key_rest, T - 1)
+
+    def step(z_next, xs):
+        if A_t is None:
+            k, alpha_t, m_next = xs
+            lA = log_A
+        else:
+            k, alpha_t, m_next, lA = xs
+        logits = alpha_t + lA[:, z_next]
+        z = jax.random.categorical(k, logits)
+        if mask is not None:
+            # If step t+1 was padding, z_{t+1} carries no information;
+            # sample from the filter at t instead.
+            z = jnp.where(m_next > 0, z, jax.random.categorical(k, alpha_t))
+        return z, z
+
+    m = jnp.ones((T,), log_obs.dtype) if mask is None else mask
+    if A_t is None:
+        xs = (keys, log_alpha[:-1], m[1:])
+    else:
+        xs = (keys, log_alpha[:-1], m[1:], A_t)
+    _, z_rest = lax.scan(step, z_last, xs, reverse=True)
+    z = jnp.concatenate([z_rest, z_last[None]], axis=0).astype(jnp.int32)
+    if mask is not None:
+        # Overwrite padded tail with the last valid state.
+        T_last = jnp.sum(m).astype(jnp.int32) - 1
+        z = jnp.where(jnp.arange(T) <= T_last, z, z[T_last])
+    return z

@@ -1,0 +1,148 @@
+"""Forward filtering, backward pass, and smoothing as ``lax.scan`` kernels.
+
+Generic step interface — every model in the zoo reduces to:
+
+- ``log_pi``  [K]            initial state log-probabilities,
+- ``log_A``   [K,K] or [T-1,K,K]  transition log-probs
+  (``log_A[i, j] = log P(z_t = j | z_{t-1} = i)``; the 3-D form is the
+  time-inhomogeneous IOHMM case where row t drives the t→t+1 step),
+- ``log_obs`` [T,K]          per-step observation log-likelihoods,
+- ``mask``    [T] optional   1.0 for valid steps, 0.0 for padding
+  (ragged-length batching; masked steps contribute nothing to the
+  log-likelihood and leave the carry untouched).
+
+The forward recursion is the HMC target — it carries gradients, exactly as
+the reference's Stan models marginalize states in the ``model`` block
+(`hmm/stan/hmm.stan:27-46`: forward + ``target += log_sum_exp(unalpha[T])``).
+The backward pass evaluates next-step evidence ``log_obs[t+1]`` relative
+to the entry being written (Murphy Eq. 17.58), matching the reference's
+recursions (`hmm/stan/hmm.stan:65-87`); correctness is pinned by the
+brute-force path-enumeration test in ``tests/test_kernels.py``.
+
+Sparse/gated transitions (Tayal sign-gating, semi-supervised group
+evidence) are expressed by passing ``-inf``-masked ``log_A`` / ``log_obs``
+— no special-casing in the kernels.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from hhmm_tpu.core.lmath import log_normalize, log_vecmat, log_matvec, logsumexp
+
+__all__ = ["forward_filter", "backward_pass", "smooth", "forward_backward"]
+
+_NEG_INF = -jnp.inf
+
+
+def _split_A(log_A: jnp.ndarray, T: int):
+    """Return per-step transition slices for scan xs (or None if homogeneous)."""
+    if log_A.ndim == 2:
+        return None
+    if log_A.shape[0] != T - 1:
+        raise ValueError(
+            f"time-varying log_A must have T-1={T - 1} slices, got {log_A.shape[0]}"
+        )
+    return log_A
+
+
+def forward_filter(
+    log_pi: jnp.ndarray,
+    log_A: jnp.ndarray,
+    log_obs: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Forward recursion. Returns ``(log_alpha [T,K], loglik scalar)``.
+
+    ``log_alpha`` is unnormalized (Stan's ``unalpha_tk``,
+    `hmm/stan/hmm.stan:27-43`); ``loglik = logsumexp(log_alpha[T_last])``.
+    With a mask, masked steps copy the previous carry, so the final carry is
+    the filter at the last *valid* step and ``loglik`` is exact for the
+    unpadded sequence.
+    """
+    T = log_obs.shape[0]
+    A_t = _split_A(log_A, T)
+
+    alpha0 = log_pi + log_obs[0]
+    if mask is not None:
+        # An all-masked series would be degenerate; t=0 is assumed valid.
+        alpha0 = jnp.where(mask[0] > 0, alpha0, log_pi)
+
+    def step(carry, xs):
+        if A_t is None:
+            obs_t, m_t = xs
+            lA = log_A
+        else:
+            obs_t, m_t, lA = xs
+        new = log_vecmat(carry, lA) + obs_t
+        if mask is not None:
+            new = jnp.where(m_t > 0, new, carry)
+        return new, new
+
+    m = jnp.ones((T,), log_obs.dtype) if mask is None else mask
+    xs = (log_obs[1:], m[1:]) if A_t is None else (log_obs[1:], m[1:], A_t)
+    alpha_last, alpha_rest = lax.scan(step, alpha0, xs)
+    log_alpha = jnp.concatenate([alpha0[None], alpha_rest], axis=0)
+    return log_alpha, logsumexp(alpha_last)
+
+
+def backward_pass(
+    log_A: jnp.ndarray,
+    log_obs: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Backward recursion. Returns ``log_beta [T,K]``.
+
+    ``beta[T-1] = 0``; ``beta[t][i] = logsumexp_j(A[i,j] + obs[t+1,j] + beta[t+1,j])``.
+    Masked (padding) steps propagate the carry unchanged, so for a ragged
+    series padded at the tail, ``beta`` at valid steps equals the unpadded
+    recursion.
+    """
+    T, K = log_obs.shape
+    A_t = _split_A(log_A, T)
+
+    beta_last = jnp.zeros((K,), log_obs.dtype)
+
+    def step(carry, xs):
+        if A_t is None:
+            obs_next, m_next = xs
+            lA = log_A
+        else:
+            obs_next, m_next, lA = xs
+        new = log_matvec(lA, obs_next + carry)
+        if mask is not None:
+            new = jnp.where(m_next > 0, new, carry)
+        return new, new
+
+    m = jnp.ones((T,), log_obs.dtype) if mask is None else mask
+    if A_t is None:
+        xs = (log_obs[1:], m[1:])
+    else:
+        xs = (log_obs[1:], m[1:], A_t)
+    _, beta_rest = lax.scan(step, beta_last, xs, reverse=True)
+    return jnp.concatenate([beta_rest, beta_last[None]], axis=0)
+
+
+def smooth(log_alpha: jnp.ndarray, log_beta: jnp.ndarray) -> jnp.ndarray:
+    """Smoothed state log-probabilities ``log_gamma [T,K]`` (normalized per t).
+
+    Equivalent of the reference's ``gamma_tk`` (`hmm/stan/hmm.stan:89-96`).
+    """
+    return log_normalize(log_alpha + log_beta, axis=-1)
+
+
+def forward_backward(
+    log_pi: jnp.ndarray,
+    log_A: jnp.ndarray,
+    log_obs: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+):
+    """Convenience: returns ``(log_alpha, log_beta, log_gamma, loglik)``."""
+    log_alpha, loglik = forward_filter(log_pi, log_A, log_obs, mask)
+    log_beta = backward_pass(log_A, log_obs, mask)
+    return log_alpha, log_beta, smooth(log_alpha, log_beta), loglik

@@ -1,0 +1,65 @@
+"""Viterbi (max-plus) decoding as a ``lax.scan`` with backtrace.
+
+Equivalent of the reference's per-draw ``zstar_t`` generated quantities
+(`hmm/stan/hmm.stan:98-130`), with the init bug fixed: every state is
+initialized, ``delta[0, j] = log_pi[j] + log_obs[0, j]`` (the reference
+initializes only ``delta_tk[1, K]`` — SURVEY.md §2.8 item 1; the corrected
+form appears only in `iohmm-mix/stan/iohmm-hmix.stan:167`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from hhmm_tpu.kernels.filtering import _split_A
+
+__all__ = ["viterbi"]
+
+
+def viterbi(
+    log_pi: jnp.ndarray,
+    log_A: jnp.ndarray,
+    log_obs: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Most-likely state path. Returns ``(path [T] int32, log_prob scalar)``.
+
+    With a tail-padding ``mask``, padded steps copy the previous state and
+    do not affect the path over valid steps.
+    """
+    T, K = log_obs.shape
+    A_t = _split_A(log_A, T)
+
+    delta0 = log_pi + log_obs[0]
+
+    def fwd(carry, xs):
+        if A_t is None:
+            obs_t, m_t = xs
+            lA = log_A
+        else:
+            obs_t, m_t, lA = xs
+        # scores[i, j] = delta[i] + A[i, j]
+        scores = carry[:, None] + lA
+        back = jnp.argmax(scores, axis=0)
+        new = jnp.max(scores, axis=0) + obs_t
+        if mask is not None:
+            new = jnp.where(m_t > 0, new, carry)
+            back = jnp.where(m_t > 0, back, jnp.arange(K))
+        return new, (new, back)
+
+    m = jnp.ones((T,), log_obs.dtype) if mask is None else mask
+    xs = (log_obs[1:], m[1:]) if A_t is None else (log_obs[1:], m[1:], A_t)
+    delta_last, (_, backs) = lax.scan(fwd, delta0, xs)
+
+    z_last = jnp.argmax(delta_last)
+
+    def bwd(z_next, back_t):
+        z = back_t[z_next]
+        return z, z
+
+    _, path_rest = lax.scan(bwd, z_last, backs, reverse=True)
+    path = jnp.concatenate([path_rest, z_last[None]], axis=0)
+    return path.astype(jnp.int32), jnp.max(delta_last)

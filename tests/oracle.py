@@ -1,0 +1,92 @@
+"""Slow, obviously-correct NumPy float64 reference implementations.
+
+Golden oracles for the JAX kernels (SURVEY.md §4: "golden-value tests of
+forward/backward/Viterbi against a slow NumPy oracle"). Everything is
+written as direct loops over t with explicit logsumexp — no vectorization
+tricks — so correctness is auditable by eye.
+
+Conventions match :mod:`hhmm_tpu.kernels`:
+``A[i, j] = P(z_t = j | z_{t-1} = i)``; time-varying ``A`` has shape
+``[T-1, K, K]`` where slice t drives the t→t+1 step.
+"""
+
+import numpy as np
+from scipy.special import logsumexp
+
+
+def _A_at(log_A, t):
+    return log_A if log_A.ndim == 2 else log_A[t]
+
+
+def forward_np(log_pi, log_A, log_obs):
+    T, K = log_obs.shape
+    log_alpha = np.zeros((T, K))
+    log_alpha[0] = log_pi + log_obs[0]
+    for t in range(1, T):
+        A = _A_at(log_A, t - 1)
+        for j in range(K):
+            log_alpha[t, j] = logsumexp(log_alpha[t - 1] + A[:, j]) + log_obs[t, j]
+    return log_alpha, logsumexp(log_alpha[-1])
+
+
+def backward_np(log_A, log_obs):
+    T, K = log_obs.shape
+    log_beta = np.zeros((T, K))
+    for t in range(T - 2, -1, -1):
+        A = _A_at(log_A, t)
+        for i in range(K):
+            log_beta[t, i] = logsumexp(A[i] + log_obs[t + 1] + log_beta[t + 1])
+    return log_beta
+
+
+def smooth_np(log_alpha, log_beta):
+    g = log_alpha + log_beta
+    return g - logsumexp(g, axis=1, keepdims=True)
+
+
+def viterbi_np(log_pi, log_A, log_obs):
+    T, K = log_obs.shape
+    delta = np.zeros((T, K))
+    back = np.zeros((T, K), dtype=int)
+    delta[0] = log_pi + log_obs[0]
+    for t in range(1, T):
+        A = _A_at(log_A, t - 1)
+        for j in range(K):
+            scores = delta[t - 1] + A[:, j]
+            back[t, j] = np.argmax(scores)
+            delta[t, j] = np.max(scores) + log_obs[t, j]
+    path = np.zeros(T, dtype=int)
+    path[-1] = np.argmax(delta[-1])
+    for t in range(T - 2, -1, -1):
+        path[t] = back[t + 1, path[t + 1]]
+    return path, np.max(delta[-1])
+
+
+def smoothing_marginals_brute(log_pi, log_A, log_obs):
+    """Exact p(z_t | x) by brute-force enumeration of all K^T paths (tiny T)."""
+    T, K = log_obs.shape
+    from itertools import product
+
+    logp_paths = {}
+    for path in product(range(K), repeat=T):
+        lp = log_pi[path[0]] + log_obs[0, path[0]]
+        for t in range(1, T):
+            lp += _A_at(log_A, t - 1)[path[t - 1], path[t]] + log_obs[t, path[t]]
+        logp_paths[path] = lp
+    total = logsumexp(np.array(list(logp_paths.values())))
+    gamma = np.full((T, K), -np.inf)
+    for path, lp in logp_paths.items():
+        for t in range(T):
+            gamma[t, path[t]] = np.logaddexp(gamma[t, path[t]], lp)
+    return gamma - total
+
+
+def random_hmm(rng, K, T, time_varying=False):
+    """Random log-space (log_pi, log_A, log_obs) for oracle comparisons."""
+    log_pi = np.log(rng.dirichlet(np.ones(K)))
+    if time_varying:
+        log_A = np.log(rng.dirichlet(np.ones(K), size=(T - 1, K)))
+    else:
+        log_A = np.log(rng.dirichlet(np.ones(K), size=K))
+    log_obs = rng.normal(size=(T, K))
+    return log_pi, log_A, log_obs

@@ -1,0 +1,154 @@
+"""Golden tests of the lax.scan kernels against the NumPy float64 oracle."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hhmm_tpu.kernels import (
+    forward_filter,
+    backward_pass,
+    smooth,
+    forward_backward,
+    viterbi,
+    ffbs_sample,
+)
+import oracle
+
+
+@pytest.mark.parametrize("K,T", [(2, 7), (4, 25), (3, 100)])
+@pytest.mark.parametrize("tv", [False, True])
+def test_forward_matches_oracle(rng, K, T, tv):
+    log_pi, log_A, log_obs = oracle.random_hmm(rng, K, T, time_varying=tv)
+    la_np, ll_np = oracle.forward_np(log_pi, log_A, log_obs)
+    la, ll = forward_filter(jnp.asarray(log_pi), jnp.asarray(log_A), jnp.asarray(log_obs))
+    np.testing.assert_allclose(la, la_np, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(ll, ll_np, rtol=2e-4)
+
+
+@pytest.mark.parametrize("K,T", [(2, 7), (4, 25)])
+@pytest.mark.parametrize("tv", [False, True])
+def test_backward_smooth_match_oracle(rng, K, T, tv):
+    log_pi, log_A, log_obs = oracle.random_hmm(rng, K, T, time_varying=tv)
+    la_np, _ = oracle.forward_np(log_pi, log_A, log_obs)
+    lb_np = oracle.backward_np(log_A, log_obs)
+    lg_np = oracle.smooth_np(la_np, lb_np)
+    la, lb, lg, _ = forward_backward(
+        jnp.asarray(log_pi), jnp.asarray(log_A), jnp.asarray(log_obs)
+    )
+    np.testing.assert_allclose(lb, lb_np, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(lg, lg_np, rtol=2e-4, atol=2e-4)
+
+
+def test_smoothing_matches_brute_force(rng):
+    """γ from forward-backward equals exact path enumeration (K=3, T=5)."""
+    log_pi, log_A, log_obs = oracle.random_hmm(rng, 3, 5)
+    lg_brute = oracle.smoothing_marginals_brute(log_pi, log_A, log_obs)
+    _, _, lg, _ = forward_backward(
+        jnp.asarray(log_pi), jnp.asarray(log_A), jnp.asarray(log_obs)
+    )
+    np.testing.assert_allclose(lg, lg_brute, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("tv", [False, True])
+def test_viterbi_matches_oracle(rng, tv):
+    log_pi, log_A, log_obs = oracle.random_hmm(rng, 4, 60, time_varying=tv)
+    path_np, score_np = oracle.viterbi_np(log_pi, log_A, log_obs)
+    path, score = viterbi(jnp.asarray(log_pi), jnp.asarray(log_A), jnp.asarray(log_obs))
+    np.testing.assert_array_equal(path, path_np)
+    np.testing.assert_allclose(score, score_np, rtol=2e-4)
+
+
+def test_masked_forward_equals_truncated(rng):
+    """Padding + mask gives identical loglik/filter to the unpadded series."""
+    K, T_valid, T_pad = 3, 40, 64
+    log_pi, log_A, log_obs = oracle.random_hmm(rng, K, T_pad)
+    mask = np.zeros(T_pad)
+    mask[:T_valid] = 1.0
+    la_full, ll_full = forward_filter(
+        jnp.asarray(log_pi), jnp.asarray(log_A), jnp.asarray(log_obs[:T_valid])
+    )
+    la_mask, ll_mask = forward_filter(
+        jnp.asarray(log_pi), jnp.asarray(log_A), jnp.asarray(log_obs), jnp.asarray(mask)
+    )
+    np.testing.assert_allclose(ll_mask, ll_full, rtol=1e-5)
+    np.testing.assert_allclose(la_mask[:T_valid], la_full, rtol=2e-4, atol=2e-4)
+
+
+def test_masked_backward_viterbi_equal_truncated(rng):
+    K, T_valid, T_pad = 3, 30, 48
+    log_pi, log_A, log_obs = oracle.random_hmm(rng, K, T_pad)
+    mask = np.zeros(T_pad)
+    mask[:T_valid] = 1.0
+    lb_full = backward_pass(jnp.asarray(log_A), jnp.asarray(log_obs[:T_valid]))
+    lb_mask = backward_pass(jnp.asarray(log_A), jnp.asarray(log_obs), jnp.asarray(mask))
+    np.testing.assert_allclose(lb_mask[:T_valid], lb_full, rtol=2e-4, atol=2e-4)
+
+    p_full, _ = viterbi(jnp.asarray(log_pi), jnp.asarray(log_A), jnp.asarray(log_obs[:T_valid]))
+    p_mask, _ = viterbi(
+        jnp.asarray(log_pi), jnp.asarray(log_A), jnp.asarray(log_obs), jnp.asarray(mask)
+    )
+    np.testing.assert_array_equal(p_mask[:T_valid], p_full)
+
+
+def test_forward_loglik_gradient_finite(rng):
+    """The HMC target must be differentiable with finite gradients."""
+    log_pi, log_A, log_obs = oracle.random_hmm(rng, 3, 20)
+
+    def loss(lobs):
+        return forward_filter(jnp.asarray(log_pi), jnp.asarray(log_A), lobs)[1]
+
+    g = jax.grad(loss)(jnp.asarray(log_obs))
+    assert np.all(np.isfinite(g))
+    # d loglik / d log_obs[t] sums over states to the posterior marginal = 1
+    np.testing.assert_allclose(np.sum(np.asarray(g), axis=1), 1.0, rtol=5e-4)
+
+
+def test_ffbs_marginals_match_smoothing(rng):
+    """FFBS empirical state frequencies converge to the smoothed marginals."""
+    log_pi, log_A, log_obs = oracle.random_hmm(rng, 3, 12)
+    _, _, lg, _ = forward_backward(
+        jnp.asarray(log_pi), jnp.asarray(log_A), jnp.asarray(log_obs)
+    )
+    n = 4000
+    keys = jax.random.split(jax.random.PRNGKey(0), n)
+    paths = jax.vmap(
+        lambda k: ffbs_sample(k, jnp.asarray(log_pi), jnp.asarray(log_A), jnp.asarray(log_obs))
+    )(keys)
+    freq = np.stack([(np.asarray(paths) == k).mean(axis=0) for k in range(3)], axis=1)
+    np.testing.assert_allclose(freq, np.exp(lg), atol=0.03)
+
+
+def test_ffbs_pairwise_consistency(rng):
+    """FFBS joint (z_t, z_{t+1}) frequencies match brute-force pairwise posterior."""
+    from itertools import product
+    from scipy.special import logsumexp as lse
+
+    K, T = 2, 6
+    log_pi, log_A, log_obs = oracle.random_hmm(rng, K, T)
+    # brute-force pairwise marginal at t=2
+    logp = {}
+    for path in product(range(K), repeat=T):
+        lp = log_pi[path[0]] + log_obs[0, path[0]]
+        for t in range(1, T):
+            lp += log_A[path[t - 1], path[t]] + log_obs[t, path[t]]
+        logp[path] = lp
+    total = lse(np.array(list(logp.values())))
+    pair = np.zeros((K, K))
+    for path, lp in logp.items():
+        pair[path[2], path[3]] += np.exp(lp - total)
+
+    n = 6000
+    keys = jax.random.split(jax.random.PRNGKey(1), n)
+    paths = np.asarray(
+        jax.vmap(
+            lambda k: ffbs_sample(
+                k, jnp.asarray(log_pi), jnp.asarray(log_A), jnp.asarray(log_obs)
+            )
+        )(keys)
+    )
+    emp = np.zeros((K, K))
+    for a in range(K):
+        for b in range(K):
+            emp[a, b] = np.mean((paths[:, 2] == a) & (paths[:, 3] == b))
+    np.testing.assert_allclose(emp, pair, atol=0.03)
