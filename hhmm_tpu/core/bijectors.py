@@ -61,18 +61,23 @@ class Identity(Bijector):
 
 @dataclass
 class Positive(Bijector):
-    """y = exp(x); log|J| = sum(x)."""
+    """y = lower + exp(x); log|J| = sum(x).
+
+    ``lower`` mirrors Stan's ``real<lower=...>`` shifted-exp transform
+    (e.g. ``real<lower=0.0001> sigma_k`` in `hmm/stan/hmm.stan:21`).
+    """
 
     shape: Tuple[int, ...]
+    lower: float = 0.0
 
     def __post_init__(self):
         self.n_free = int(np.prod(self.shape)) if self.shape else 1
 
     def forward(self, x):
-        return jnp.exp(x).reshape(self.shape), jnp.sum(x)
+        return self.lower + jnp.exp(x).reshape(self.shape), jnp.sum(x)
 
     def inverse(self, y):
-        return jnp.log(jnp.asarray(y)).reshape(-1)
+        return jnp.log(jnp.asarray(y) - self.lower).reshape(-1)
 
 
 @dataclass

@@ -39,9 +39,15 @@ def categorical_sample(key, log_p, shape=()):
 
 
 def dirichlet_logpdf(p, alpha):
-    """Log-density of a simplex point ``p`` under Dirichlet(alpha)."""
+    """Log-density of a simplex point ``p`` under Dirichlet(alpha).
+
+    Uses xlogy semantics so boundary points with alpha components equal
+    to 1 give 0·log(0) = 0 (finite) instead of NaN.
+    """
+    from jax.scipy.special import xlogy
+
     return (
-        jnp.sum((alpha - 1.0) * jnp.log(p), axis=-1)
+        jnp.sum(xlogy(alpha - 1.0, p), axis=-1)
         + gammaln(jnp.sum(alpha, axis=-1))
         - jnp.sum(gammaln(alpha), axis=-1)
     )

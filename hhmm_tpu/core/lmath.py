@@ -14,7 +14,30 @@ import jax.numpy as jnp
 from jax.nn import softmax  # re-export; same semantics as common/R/math.R:7-9
 from jax.scipy.special import logsumexp
 
-__all__ = ["logsumexp", "softmax", "log_normalize", "log_matvec", "log_vecmat"]
+__all__ = [
+    "logsumexp",
+    "softmax",
+    "log_normalize",
+    "log_matvec",
+    "log_vecmat",
+    "safe_log",
+    "MASK_NEG",
+]
+
+# Finite stand-in for -inf in masked/gated log-probabilities. A true -inf
+# poisons reverse-mode gradients whenever a logsumexp sees an all-masked
+# column (softmax of all--inf is 0/0 → NaN cotangents). -1e4 keeps any
+# masked path at least e^-10000 below real paths — exactly 0 at f32
+# precision — while every gradient stays finite.
+MASK_NEG = -1.0e4
+
+_TINY = 1.1754944e-38  # smallest f32 normal
+
+
+def safe_log(x: jnp.ndarray) -> jnp.ndarray:
+    """log with a gradient-safe floor: zeros (structural or underflowed)
+    map to log(f32-tiny) ≈ -87.3 without producing inf/NaN cotangents."""
+    return jnp.log(jnp.where(x > _TINY, x, _TINY))
 
 
 def log_normalize(log_x: jnp.ndarray, axis: int = -1) -> jnp.ndarray:

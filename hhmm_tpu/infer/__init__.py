@@ -1,6 +1,6 @@
 from hhmm_tpu.infer.run import sample_nuts, SamplerConfig
 from hhmm_tpu.infer.diagnostics import split_rhat, ess, summary
-from hhmm_tpu.infer.relabel import greedy_relabel, confusion_matrix
+from hhmm_tpu.infer.relabel import greedy_relabel, confusion_matrix, apply_relabel
 
 __all__ = [
     "sample_nuts",
@@ -10,4 +10,5 @@ __all__ = [
     "summary",
     "greedy_relabel",
     "confusion_matrix",
+    "apply_relabel",
 ]

@@ -36,7 +36,7 @@ class NUTSInfo(NamedTuple):
     accept_prob: jnp.ndarray  # mean Metropolis accept prob over trajectory
     num_leaves: jnp.ndarray  # leapfrog steps taken this transition
     diverging: jnp.ndarray  # bool
-    energy: jnp.ndarray  # -logp + kinetic at the accepted point
+    energy: jnp.ndarray  # Hamiltonian -logp + kinetic at the accepted point
     depth: jnp.ndarray  # tree depth reached
 
 
@@ -87,6 +87,7 @@ class _SubtreeState(NamedTuple):
     q_prop: jnp.ndarray
     logp_prop: jnp.ndarray
     grad_prop: jnp.ndarray
+    energy_prop: jnp.ndarray  # Hamiltonian of the proposed leaf
     log_weight: jnp.ndarray  # logsumexp of leaf weights (-H + H0)
     p_sum: jnp.ndarray
     # checkpoints for iterative U-turn checks
@@ -131,6 +132,7 @@ def _build_subtree(
         q_prop=q0,
         logp_prop=jnp.zeros((), dtype),
         grad_prop=grad0,
+        energy_prop=energy0,
         log_weight=-jnp.inf,
         p_sum=jnp.zeros((dim,), dtype),
         p_ckpts=jnp.zeros((max_depth, dim), dtype),
@@ -162,6 +164,7 @@ def _build_subtree(
         q_prop = jnp.where(take_new, q, s.q_prop)
         logp_prop = jnp.where(take_new, logp, s.logp_prop)
         grad_prop = jnp.where(take_new, grad, s.grad_prop)
+        energy_prop = jnp.where(take_new, energy, s.energy_prop)
 
         p_sum = s.p_sum + p
         n = s.leaf_idx
@@ -188,6 +191,7 @@ def _build_subtree(
             q_prop=q_prop,
             logp_prop=logp_prop,
             grad_prop=grad_prop,
+            energy_prop=energy_prop,
             log_weight=new_log_weight,
             p_sum=p_sum,
             p_ckpts=p_ckpts,
@@ -213,6 +217,7 @@ class _TreeState(NamedTuple):
     q_prop: jnp.ndarray
     logp_prop: jnp.ndarray
     grad_prop: jnp.ndarray
+    energy_prop: jnp.ndarray
     log_weight: jnp.ndarray
     p_sum: jnp.ndarray
     depth: jnp.ndarray
@@ -250,6 +255,7 @@ def nuts_step(
         q_prop=q,
         logp_prop=logp,
         grad_prop=grad,
+        energy_prop=energy0,
         log_weight=jnp.zeros((), dtype),  # initial point has weight exp(0)
         p_sum=p0,
         depth=jnp.zeros((), jnp.int32),
@@ -285,6 +291,7 @@ def nuts_step(
         q_prop = jnp.where(take_new, sub.q_prop, s.q_prop)
         logp_prop = jnp.where(take_new, sub.logp_prop, s.logp_prop)
         grad_prop = jnp.where(take_new, sub.grad_prop, s.grad_prop)
+        energy_prop = jnp.where(take_new, sub.energy_prop, s.energy_prop)
         log_weight = jnp.logaddexp(s.log_weight, sub.log_weight)
 
         q_left = jnp.where(go_right, s.q_left, sub.q)
@@ -309,6 +316,7 @@ def nuts_step(
             q_prop=q_prop,
             logp_prop=logp_prop,
             grad_prop=grad_prop,
+            energy_prop=energy_prop,
             log_weight=log_weight,
             p_sum=p_sum,
             depth=s.depth + 1,
@@ -325,7 +333,7 @@ def nuts_step(
         accept_prob=final.sum_accept / n,
         num_leaves=final.num_leaves,
         diverging=final.diverging,
-        energy=-final.logp_prop,
+        energy=final.energy_prop,
         depth=final.depth,
     )
     return final.q_prop, final.logp_prop, final.grad_prop, info

@@ -204,6 +204,7 @@ def _single_chain(
         "accept_prob": infos.accept_prob,
         "num_leaves": infos.num_leaves,
         "diverging": infos.diverging,
+        "energy": infos.energy,
         "depth": infos.depth,
         "logp": logps,
         "step_size": eps_final,

@@ -1,0 +1,18 @@
+from hhmm_tpu.models.base import BaseHMMModel
+from hhmm_tpu.models.gaussian_hmm import GaussianHMM
+from hhmm_tpu.models.multinomial_hmm import MultinomialHMM, SemisupMultinomialHMM
+from hhmm_tpu.models.iohmm import IOHMMReg, IOHMMMix, IOHMMHMix, IOHMMHMixLite
+from hhmm_tpu.models.tayal import TayalHHMM, TayalHHMMLite
+
+__all__ = [
+    "BaseHMMModel",
+    "GaussianHMM",
+    "MultinomialHMM",
+    "SemisupMultinomialHMM",
+    "IOHMMReg",
+    "IOHMMMix",
+    "IOHMMHMix",
+    "IOHMMHMixLite",
+    "TayalHHMM",
+    "TayalHHMMLite",
+]

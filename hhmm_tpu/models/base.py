@@ -1,0 +1,129 @@
+"""Model-zoo base: declarative (bijectors, prior, builder) → NUTS-ready logp.
+
+Every model in the zoo mirrors one of the reference's Stan models 1:1 in
+*behavior* (SURVEY.md §7.1 item 3): a model is
+
+- an ordered set of named parameters with constraint bijectors
+  (Stan's ``parameters`` block),
+- a ``log_prior`` on the constrained values (Stan's ``model`` block
+  priors; flat = 0, matching the reference models that declare none),
+- a ``build(params, data)`` that produces the generic step interface
+  ``(log_pi, log_A, log_obs, mask)`` consumed by the scan kernels
+  (Stan's ``transformed parameters`` forward-pass inputs).
+
+The NUTS target is then ``loglik + log_prior + log|Jacobian|`` on the
+unconstrained space — exactly the density Stan's HMC samples. Generated
+quantities (filtered/smoothed probabilities, Viterbi paths) are computed
+per posterior draw by ``vmap``, the TPU-native analog of Stan's
+``generated quantities`` loop over saved draws.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hhmm_tpu.core.bijectors import Bijector
+from hhmm_tpu.kernels import forward_filter, backward_pass, smooth, viterbi
+
+__all__ = ["BaseHMMModel"]
+
+Data = Dict[str, jnp.ndarray]
+
+
+class BaseHMMModel:
+    """Subclasses define ``specs()``, ``build()``, optionally ``log_prior()``."""
+
+    def specs(self) -> List[Tuple[str, Bijector]]:
+        raise NotImplementedError
+
+    def build(self, params: Dict[str, jnp.ndarray], data: Data):
+        """Return ``(log_pi, log_A, log_obs, mask)`` (mask may be None)."""
+        raise NotImplementedError
+
+    def log_prior(self, params: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+        return jnp.zeros(())
+
+    # ---- generic machinery ----
+
+    @property
+    def n_free(self) -> int:
+        return sum(b.n_free for _, b in self.specs())
+
+    def unpack(self, theta: jnp.ndarray) -> Tuple[Dict[str, jnp.ndarray], jnp.ndarray]:
+        """Flat unconstrained vector → constrained params dict + total log|J|."""
+        params = {}
+        ldj = jnp.zeros(())
+        i = 0
+        for name, bij in self.specs():
+            val, d = bij.forward(theta[i : i + bij.n_free])
+            params[name] = val
+            ldj = ldj + d
+            i += bij.n_free
+        return params, ldj
+
+    def pack(self, params: Dict[str, np.ndarray]) -> jnp.ndarray:
+        """Constrained params dict → flat unconstrained vector (for inits)."""
+        parts = [bij.inverse(params[name]) for name, bij in self.specs()]
+        return jnp.concatenate([jnp.atleast_1d(p) for p in parts])
+
+    def loglik(self, params: Dict[str, jnp.ndarray], data: Data) -> jnp.ndarray:
+        log_pi, log_A, log_obs, mask = self.build(params, data)
+        _, ll = forward_filter(log_pi, log_A, log_obs, mask)
+        return ll
+
+    def make_logp(self, data: Data) -> Callable[[jnp.ndarray], jnp.ndarray]:
+        """The NUTS target on the unconstrained space (Stan's lp__)."""
+
+        def logp(theta):
+            params, ldj = self.unpack(theta)
+            return self.loglik(params, data) + self.log_prior(params) + ldj
+
+        return logp
+
+    def init_unconstrained(self, key: jax.Array, data: Data) -> jnp.ndarray:
+        """Default init: standard normal draw on the unconstrained space
+        (Stan's default is uniform(-2,2); models override with k-means
+        inits mirroring the reference drivers)."""
+        return 0.5 * jax.random.normal(key, (self.n_free,))
+
+    def generated(self, theta_draws: jnp.ndarray, data: Data) -> Dict[str, jnp.ndarray]:
+        """Per-draw generated quantities, vmapped over posterior draws.
+
+        Returns ``alpha`` (filtered probs, normalized per t), ``gamma``
+        (smoothed probs), ``zstar`` (Viterbi path), ``logp_zstar`` —
+        the reference's ``alpha_tk / gamma_tk / zstar_t`` outputs
+        (`hmm/stan/hmm.stan:48-130`).
+        """
+
+        def one(theta):
+            params, _ = self.unpack(theta)
+            log_pi, log_A, log_obs, mask = self.build(params, data)
+            log_alpha, ll = forward_filter(log_pi, log_A, log_obs, mask)
+            log_beta = backward_pass(log_A, log_obs, mask)
+            log_gamma = smooth(log_alpha, log_beta)
+            zstar, logp_zstar = viterbi(log_pi, log_A, log_obs, mask)
+            alpha = jax.nn.softmax(log_alpha, axis=-1)
+            return {
+                "alpha": alpha,
+                "gamma": jnp.exp(log_gamma),
+                "zstar": zstar,
+                "logp_zstar": logp_zstar,
+                "loglik": ll,
+            }
+
+        lead = theta_draws.shape[:-1]
+        flat = theta_draws.reshape(-1, theta_draws.shape[-1])
+        out = jax.vmap(one)(flat)
+        return {k: v.reshape(lead + v.shape[1:]) for k, v in out.items()}
+
+    def constrained_draws(self, theta_draws: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+        """Map [chains, draws, dim] (or [draws, dim]) unconstrained draws to
+        constrained parameter arrays with the same leading axes."""
+        lead = theta_draws.shape[:-1]
+        flat = theta_draws.reshape(-1, theta_draws.shape[-1])
+        params = jax.vmap(lambda t: self.unpack(t)[0])(flat)
+        return {k: v.reshape(lead + v.shape[1:]) for k, v in params.items()}

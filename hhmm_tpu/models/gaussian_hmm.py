@@ -1,0 +1,79 @@
+"""Gaussian-emission HMM — behavioral equivalent of `hmm/stan/hmm.stan`.
+
+Parameters (matching `hmm/stan/hmm.stan:14-22`): initial simplex ``p_1k``,
+transition simplex rows ``A_ij``, ``ordered[K] mu_k`` (the identifiability
+constraint, `hmm/stan/hmm.stan:20`), ``sigma_k > 1e-4``. No explicit
+priors — the target is the marginalized forward log-likelihood alone
+(`hmm/stan/hmm.stan:46`), i.e. flat priors on the constrained space.
+
+The k-means init mirrors the reference driver's ``init_fun``
+(`hmm/main.R:37-47`): cluster x, order cluster centers, init mu/sigma
+from cluster moments and A/p1 uniform.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hhmm_tpu.core import dists
+from hhmm_tpu.core.lmath import safe_log
+from hhmm_tpu.core.bijectors import Bijector, Ordered, Positive, Simplex
+from hhmm_tpu.models.base import BaseHMMModel
+
+__all__ = ["GaussianHMM"]
+
+
+class GaussianHMM(BaseHMMModel):
+    def __init__(self, K: int):
+        self.K = K
+
+    def specs(self) -> List[Tuple[str, Bijector]]:
+        K = self.K
+        return [
+            ("p_1k", Simplex(shape=(K,))),
+            ("A_ij", Simplex(shape=(K, K))),
+            ("mu_k", Ordered(shape=(K,))),
+            ("sigma_k", Positive(shape=(K,), lower=1e-4)),
+        ]
+
+    def build(self, params, data):
+        x = data["x"]
+        log_obs = dists.normal_logpdf(
+            x[:, None], params["mu_k"][None, :], params["sigma_k"][None, :]
+        )
+        return (
+            safe_log(params["p_1k"]),
+            safe_log(params["A_ij"]),
+            log_obs,
+            data.get("mask"),
+        )
+
+    def init_unconstrained(self, key, data):
+        """k-means-style init on host (reference: `hmm/main.R:37-47`)."""
+        x = np.asarray(data["x"])
+        mask = data.get("mask")
+        if mask is not None:
+            x = x[np.asarray(mask) > 0]
+        K = self.K
+        from scipy.cluster.vq import kmeans2
+
+        centers, labels = kmeans2(x.astype(np.float64), K, minit="++", seed=0)
+        order = np.argsort(centers)
+        mu = np.sort(centers)
+        sigma = np.array(
+            [max(x[labels == order[k]].std(), 1e-2) if (labels == order[k]).any() else x.std()
+             for k in range(K)]
+        )
+        # small jitter so vmapped chains start at distinct points
+        jitter = 0.1 * np.asarray(jax.random.normal(key, (K,)))
+        params = {
+            "p_1k": np.full(K, 1.0 / K),
+            "A_ij": np.full((K, K), 1.0 / K),
+            "mu_k": np.sort(mu + jitter * sigma),
+            "sigma_k": sigma,
+        }
+        return self.pack(params)

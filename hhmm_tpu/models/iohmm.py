@@ -1,0 +1,292 @@
+"""Input-output HMM models — equivalents of `iohmm-reg/stan/iohmm-reg.stan`,
+`iohmm-mix/stan/iohmm-mix.stan`, `iohmm-mix/stan/iohmm-hmix.stan` and
+`iohmm-mix/stan/iohmm-hmix-lite.stan`.
+
+Shared transition structure (`iohmm-reg.stan:40-49`): at each step a single
+K-vector ``a_t = softmax_j(u_t · w_j)`` — input-driven and independent of
+the previous state (the reference's intended rank-1 simplification,
+SURVEY.md §2.8 item 2; `hassan2005/main.Rmd:758`).
+
+Two ways to apply that vector in the forward recursion:
+
+- ``trans_mode="stan"`` (default): exact behavioral parity with the
+  reference, which indexes the vector by the *previous* state ``i``
+  (`iohmm-reg.stan:71`: ``unalpha[t-1,i] + log(A_ij[t][i]) + oblik[t][j]``).
+  The transition factor is then a j-independent constant per step, so
+  filtered state probabilities reduce to softmax of the emission
+  likelihoods; ``a_t`` still shapes the w-posterior through the
+  likelihood.
+- ``trans_mode="gen"``: the vector is a distribution over the
+  *destination* state ``j`` — consistent with the generative simulator
+  (``iohmm_sim``: z_t ~ Cat(a_t), `iohmm-reg/R/iohmm-sim.R:40-44`).
+  Use this for simulation-based calibration.
+
+Both are expressed as rank-1 time-varying transition matrices feeding the
+shared scan kernels. The reference's backward pass uses yet another
+(destination-indexed) convention inconsistent with its forward
+(`iohmm-reg.stan:94`); here backward/smoothing always use the same
+convention as the forward, which only affects plot-grade gamma output.
+
+Priors: `iohmm-reg.stan:113-121` (w,b ~ N(0,5), s ~ half-N(0,3));
+`iohmm-mix.stan:124-126` (w ~ N(0,5), mu ~ N(0,10), s ~ half-N(0,3));
+hmix variants take the reference's 9-vector ``hyperparams``
+(`iohmm-hmix.stan:10,124-135`): w ~ N(h1,h2), mu_kl[j] ~ N(hypermu_k[j],
+h3), s ~ half-N(h4,h5), lambda ~ Beta(h6,h7) elementwise,
+hypermu ~ N(h8,h9) with an ordered[K] constraint.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.scipy.special import betaln
+
+from hhmm_tpu.core import dists
+from hhmm_tpu.core.bijectors import Bijector, Identity, Ordered, Positive, Simplex
+from hhmm_tpu.core.lmath import logsumexp, safe_log
+from hhmm_tpu.kernels.filtering import forward_filter
+from hhmm_tpu.models.base import BaseHMMModel
+
+__all__ = ["IOHMMReg", "IOHMMMix", "IOHMMHMix", "IOHMMHMixLite"]
+
+
+class _IOHMMBase(BaseHMMModel):
+    def __init__(self, K: int, M: int, trans_mode: str = "stan"):
+        if trans_mode not in ("stan", "gen"):
+            raise ValueError("trans_mode must be 'stan' or 'gen'")
+        self.K = K
+        self.M = M
+        self.trans_mode = trans_mode
+
+    def _log_A_t(self, params, data):
+        """Rank-1 time-varying transition matrices [T-1, K, K]."""
+        u = data["u"]  # [T, M]
+        logits = u @ params["w_km"].T  # [T, K]
+        log_a = jax.nn.log_softmax(logits, axis=-1)[1:]  # slices for t=1..T-1
+        if self.trans_mode == "stan":
+            # indexed by previous state i (`iohmm-reg.stan:71`)
+            return jnp.broadcast_to(
+                log_a[:, :, None], log_a.shape + (self.K,)
+            )
+        # destination-indexed (generative semantics)
+        return jnp.broadcast_to(log_a[:, None, :], (log_a.shape[0], self.K, self.K))
+
+    def _log_obs(self, params, data):
+        raise NotImplementedError
+
+    def build(self, params, data):
+        return (
+            safe_log(params["p_1k"]),
+            self._log_A_t(params, data),
+            self._log_obs(params, data),
+            data.get("mask"),
+        )
+
+    def oblik_t(self, params, data):
+        """Per-step observation log-likelihood weighted by the normalized
+        filter — the quantity the Hassan forecaster consumes
+        (`iohmm-hmix.stan:118-121`: ``logsumexp(log alpha_tk[t] + oblik_tk[t])``)."""
+        log_pi, log_A, log_obs, mask = self.build(params, data)
+        log_alpha, _ = forward_filter(log_pi, log_A, log_obs, mask)
+        log_alpha_norm = jax.nn.log_softmax(log_alpha, axis=-1)
+        return logsumexp(log_alpha_norm + log_obs, axis=-1)
+
+
+class IOHMMReg(_IOHMMBase):
+    """Linear-regression emissions: x_t ~ N(u_t · b_j, s_j)
+    (`iohmm-reg.stan:51-57`)."""
+
+    def specs(self) -> List[Tuple[str, Bijector]]:
+        K, M = self.K, self.M
+        return [
+            ("p_1k", Simplex(shape=(K,))),
+            ("w_km", Identity(shape=(K, M))),
+            ("b_km", Identity(shape=(K, M))),
+            ("s_k", Positive(shape=(K,), lower=1e-4)),
+        ]
+
+    def _log_obs(self, params, data):
+        mean = data["u"] @ params["b_km"].T  # [T, K]
+        return dists.normal_logpdf(data["x"][:, None], mean, params["s_k"][None, :])
+
+    def log_prior(self, params):
+        return (
+            jnp.sum(dists.normal_logpdf(params["w_km"], 0.0, 5.0))
+            + jnp.sum(dists.normal_logpdf(params["b_km"], 0.0, 5.0))
+            + jnp.sum(dists.normal_logpdf(params["s_k"], 0.0, 3.0))
+        )
+
+    def init_unconstrained(self, key, data):
+        """Residual-clustering init: global OLS → k-means on residuals →
+        per-cluster OLS. Separates chains from the collapsed
+        all-states-equal mode (the IOHMM analog of the reference's
+        k-means chain inits, `hmm/main.R:37-47`)."""
+        from scipy.cluster.vq import kmeans2
+
+        u = np.asarray(data["u"], dtype=np.float64)
+        x = np.asarray(data["x"], dtype=np.float64)
+        K, M = self.K, self.M
+        beta, *_ = np.linalg.lstsq(u, x, rcond=None)
+        resid = x - u @ beta
+        centers, labels = kmeans2(resid, K, minit="++", seed=0)
+        order = np.argsort(centers)
+        b = np.tile(beta, (K, 1))
+        s = np.full(K, max(resid.std(), 1e-2))
+        for rank, k in enumerate(order):
+            m = labels == k
+            if m.sum() > M + 1:
+                bk, *_ = np.linalg.lstsq(u[m], x[m], rcond=None)
+                b[rank] = bk
+                s[rank] = max((x[m] - u[m] @ bk).std(), 1e-2)
+            else:
+                b[rank, 0] = beta[0] + centers[k]
+        key_b, key_w = jax.random.split(key)
+        jit = 0.2 * np.asarray(jax.random.normal(key_b, b.shape))
+        params = {
+            "p_1k": np.full(K, 1.0 / K),
+            "w_km": 0.1 * np.asarray(jax.random.normal(key_w, (K, M))),
+            "b_km": b + jit * s[:, None],
+            "s_k": s,
+        }
+        return self.pack(params)
+
+
+class _MixEmissions:
+    """Per-state L-component Gaussian-mixture emission log-likelihoods
+    (`iohmm-mix.stan:53-65`)."""
+
+    def _log_obs(self, params, data):
+        x = data["x"]
+        log_lam = safe_log(params["lambda_kl"])  # [K, L]
+        return dists.mixture_normal_logpdf(
+            x[:, None], log_lam[None], params["mu_kl"][None], params["s_kl"][None]
+        )
+
+
+class IOHMMMix(_MixEmissions, _IOHMMBase):
+    """Flat-prior mixture model (`iohmm-mix/stan/iohmm-mix.stan`)."""
+
+    def __init__(self, K: int, M: int, L: int, trans_mode: str = "stan"):
+        super().__init__(K, M, trans_mode)
+        self.L = L
+
+    def specs(self) -> List[Tuple[str, Bijector]]:
+        K, M, L = self.K, self.M, self.L
+        return [
+            ("p_1k", Simplex(shape=(K,))),
+            ("w_km", Identity(shape=(K, M))),
+            ("lambda_kl", Simplex(shape=(K, L))),
+            ("mu_kl", Ordered(shape=(K, L))),
+            ("s_kl", Positive(shape=(K, L))),
+        ]
+
+    def log_prior(self, params):
+        return (
+            jnp.sum(dists.normal_logpdf(params["w_km"], 0.0, 5.0))
+            + jnp.sum(dists.normal_logpdf(params["mu_kl"], 0.0, 10.0))
+            + jnp.sum(dists.normal_logpdf(params["s_kl"], 0.0, 3.0))
+        )
+
+
+class IOHMMHMix(IOHMMMix):
+    """Hierarchical mixture: ``ordered[K] hypermu_k`` hyperprior over the
+    per-state component means — added because the flat model diverged
+    (`log.md:554`); priors driven by the 9-vector ``hyperparams``
+    (`iohmm-hmix.stan:124-135`)."""
+
+    def __init__(self, K, M, L, hyperparams, trans_mode: str = "stan"):
+        super().__init__(K, M, L, trans_mode)
+        hp = np.asarray(hyperparams, dtype=np.float64)
+        if hp.shape != (9,):
+            raise ValueError(
+                f"hyperparams must have 9 elements (got {hp.shape}); the "
+                "reference driver iohmm-mix/main.R:31 passes 7 — a known "
+                "defect (SURVEY.md §2.8 item 5), not replicated here"
+            )
+        self.hyperparams = jnp.asarray(hp, dtype=jnp.float32)
+
+    def specs(self) -> List[Tuple[str, Bijector]]:
+        return super().specs() + [("hypermu_k", Ordered(shape=(self.K,)))]
+
+    def log_prior(self, params):
+        h = self.hyperparams
+        lam = params["lambda_kl"]
+        log_beta_pdf = (
+            (h[5] - 1.0) * safe_log(lam)
+            + (h[6] - 1.0) * safe_log(1.0 - lam)
+            - betaln(h[5], h[6])
+        )
+        return (
+            jnp.sum(dists.normal_logpdf(params["w_km"], h[0], h[1]))
+            + jnp.sum(
+                dists.normal_logpdf(
+                    params["mu_kl"], params["hypermu_k"][:, None], h[2]
+                )
+            )
+            + jnp.sum(dists.normal_logpdf(params["s_kl"], h[3], h[4]))
+            + jnp.sum(log_beta_pdf)
+            + jnp.sum(dists.normal_logpdf(params["hypermu_k"], h[7], h[8]))
+        )
+
+    def init_unconstrained(self, key, data):
+        """Nested k-means init (reference: `iohmm-mix/R/iohmm-mix-init.R:2-22`):
+        outer k-means over x → K state clusters ordered by center; inner
+        k-means per cluster → L ordered component means/sds."""
+        from scipy.cluster.vq import kmeans2
+
+        x = np.asarray(data["x"], dtype=np.float64)
+        K, L, M = self.K, self.L, self.M
+        centers, labels = kmeans2(x, K, minit="++", seed=0)
+        order = np.argsort(centers)
+        mu_kl = np.zeros((K, L))
+        s_kl = np.full((K, L), max(x.std(), 1e-2))
+        for rank, k in enumerate(order):
+            xk = x[labels == k]
+            if len(xk) >= L:
+                c2, l2 = kmeans2(xk, L, minit="++", seed=0)
+                o2 = np.argsort(c2)
+                mu_kl[rank] = np.sort(c2)
+                for r2, l in enumerate(o2):
+                    xl = xk[l2 == l]
+                    if len(xl) > 1:
+                        s_kl[rank, r2] = max(xl.std(), 1e-2)
+            else:
+                mu_kl[rank] = np.sort(xk.mean() + np.linspace(-1, 1, L) * x.std())
+        mu_kl = np.sort(mu_kl, axis=1)
+        # strictify ordering for the bijector inverse
+        mu_kl += np.arange(L)[None, :] * 1e-4
+        jit = 0.05 * np.asarray(jax.random.normal(key, mu_kl.shape))
+        mu_kl = np.sort(mu_kl + jit * s_kl, axis=1)
+        params = {
+            "p_1k": np.full(K, 1.0 / K),
+            "w_km": np.zeros((K, M)),
+            "lambda_kl": np.full((K, L), 1.0 / L),
+            "mu_kl": mu_kl,
+            "s_kl": s_kl,
+            "hypermu_k": np.sort(mu_kl.mean(axis=1)) + np.arange(K) * 1e-4,
+        }
+        return self.pack(params)
+
+
+class IOHMMHMixLite(IOHMMHMix):
+    """Walk-forward fast path (`iohmm-mix/stan/iohmm-hmix-lite.stan`):
+    identical posterior (same parameters, priors, and forward-only
+    likelihood) but generated quantities reduced to ``oblik_t`` — the
+    reference's deliberate minimum for forecasting (`log.md:572`,
+    `hassan2005/main.Rmd:795`). In the JAX engine the training densities
+    are already identical; this subclass exists so the generated pass is
+    cheap.
+    """
+
+    def generated(self, theta_draws, data):
+        def one(theta):
+            params, _ = self.unpack(theta)
+            return {"oblik_t": self.oblik_t(params, data)}
+
+        lead = theta_draws.shape[:-1]
+        flat = theta_draws.reshape(-1, theta_draws.shape[-1])
+        out = jax.vmap(one)(flat)
+        return {k: v.reshape(lead + v.shape[1:]) for k, v in out.items()}

@@ -1,0 +1,142 @@
+"""Multinomial-emission HMMs — equivalents of `hmm/stan/hmm-multinom.stan`
+and the semi-supervised variant `hmm/stan/hmm-multinom-semisup.stan`.
+
+Discrete emissions: ``simplex[L] phi_k[K]`` per state
+(`hmm-multinom.stan:21`), observations x ∈ {0..L-1}. Flat priors; the
+target is the marginalized forward log-likelihood.
+
+Semi-supervised variant: an observed group label g ∈ {0,1} per step gates
+the transition-probability term — the ``log A_ij`` factor is applied only
+when the destination state j is consistent with g[t] (group 0 ↔ states
+{0, 3}, group 1 ↔ states {1, 2} in the reference's 4-state Tayal-shaped
+config, `hmm-multinom-semisup.stan:42-44`). Two semantics are provided:
+
+- ``gate_mode="stan"`` (default): reproduce the reference exactly —
+  inconsistent destinations keep their emission term but skip the
+  transition factor (the forward recursion literally omits ``log A``).
+- ``gate_mode="hard"``: inconsistent destinations are impossible
+  (additive −inf on the emission term) — the statistically-clean
+  "hard evidence" reading of the same model. Use this when the goal is
+  a proper posterior rather than Stan-output parity.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hhmm_tpu.core.bijectors import Bijector, Simplex
+from hhmm_tpu.core.lmath import logsumexp, safe_log, MASK_NEG
+from hhmm_tpu.kernels.filtering import forward_filter
+from hhmm_tpu.models.base import BaseHMMModel
+
+__all__ = ["MultinomialHMM", "SemisupMultinomialHMM"]
+
+
+class MultinomialHMM(BaseHMMModel):
+    def __init__(self, K: int, L: int):
+        self.K = K
+        self.L = L
+
+    def specs(self) -> List[Tuple[str, Bijector]]:
+        K, L = self.K, self.L
+        return [
+            ("p_1k", Simplex(shape=(K,))),
+            ("A_ij", Simplex(shape=(K, K))),
+            ("phi_k", Simplex(shape=(K, L))),
+        ]
+
+    def build(self, params, data):
+        x = data["x"].astype(jnp.int32)  # [T] in 0..L-1
+        log_phi = safe_log(params["phi_k"])  # [K, L]
+        log_obs = log_phi.T[x]  # [T, K]
+        return (
+            safe_log(params["p_1k"]),
+            safe_log(params["A_ij"]),
+            log_obs,
+            data.get("mask"),
+        )
+
+
+class SemisupMultinomialHMM(MultinomialHMM):
+    """Adds observed group evidence g[t] gating the transition term.
+
+    ``groups``: length-K int array mapping state → group id; the
+    reference's config is K=4 with groups (0, 1, 1, 0)
+    (`hmm-multinom-semisup.stan:42-44`: g==1 ↔ states {1,4} 1-indexed).
+    """
+
+    def __init__(self, K: int, L: int, groups, gate_mode: str = "stan"):
+        super().__init__(K, L)
+        self.groups = np.asarray(groups, dtype=np.int32)
+        if self.groups.shape != (K,):
+            raise ValueError(f"groups must have shape ({K},)")
+        if gate_mode not in ("stan", "hard"):
+            raise ValueError("gate_mode must be 'stan' or 'hard'")
+        self.gate_mode = gate_mode
+
+    def build(self, params, data):
+        raise NotImplementedError("SemisupMultinomialHMM overrides loglik directly")
+
+    def _gated(self, params, data):
+        """Shared (log_pi, log_A_t, log_obs) with the selected gating —
+        single source of truth for loglik AND generated quantities.
+
+        In stan-parity mode the initial log π factor is NOT gated: the
+        reference applies ``log(p_1k[j])`` to every state at t=1
+        (`hmm-multinom-semisup.stan:33-35`); only the transition factor
+        for t≥2 is gated (`:42-44`).
+        """
+        x = data["x"].astype(jnp.int32)
+        g = data["g"].astype(jnp.int32)  # [T] observed group labels
+        log_phi = safe_log(params["phi_k"])
+        log_obs = log_phi.T[x]  # [T, K]
+        consistent = g[:, None] == jnp.asarray(self.groups)[None, :]  # [T, K]
+        log_pi = safe_log(params["p_1k"])
+        log_A = safe_log(params["A_ij"])
+        T = log_obs.shape[0]
+
+        if self.gate_mode == "hard":
+            # impossible destinations: masked emission (clean gating)
+            log_obs = jnp.where(consistent, log_obs, MASK_NEG)
+            log_A_t = jnp.broadcast_to(log_A[None], (T - 1,) + log_A.shape)
+            return log_pi, log_A_t, log_obs
+
+        # Stan-parity mode: transition factor applied only on consistent
+        # destinations; inconsistent ones keep the emission term with a
+        # unit transition factor — expressed as a per-step transition
+        # matrix A_t[i, j] = consistent[t+1, j] ? A[i, j] : 1.
+        log_A_t = jnp.where(consistent[1:, None, :], log_A[None, :, :], 0.0)
+        return log_pi, log_A_t, log_obs
+
+    def loglik(self, params, data):
+        log_pi, log_A_t, log_obs = self._gated(params, data)
+        _, ll = forward_filter(log_pi, log_A_t, log_obs, data.get("mask"))
+        return ll
+
+    def generated(self, theta_draws, data):
+        from hhmm_tpu.kernels import backward_pass, smooth, viterbi
+
+        def one(theta):
+            params, _ = self.unpack(theta)
+            log_pi, log_A_t, log_obs = self._gated(params, data)
+            mask = data.get("mask")
+            log_alpha, ll = forward_filter(log_pi, log_A_t, log_obs, mask)
+            log_beta = backward_pass(log_A_t, log_obs, mask)
+            log_gamma = smooth(log_alpha, log_beta)
+            zstar, lz = viterbi(log_pi, log_A_t, log_obs, mask)
+            return {
+                "alpha": jax.nn.softmax(log_alpha, axis=-1),
+                "gamma": jnp.exp(log_gamma),
+                "zstar": zstar,
+                "logp_zstar": lz,
+                "loglik": ll,
+            }
+
+        lead = theta_draws.shape[:-1]
+        flat = theta_draws.reshape(-1, theta_draws.shape[-1])
+        out = jax.vmap(one)(flat)
+        return {k: v.reshape(lead + v.shape[1:]) for k, v in out.items()}

@@ -1,0 +1,191 @@
+"""Tayal (2009) HHMM→HMM reduction — equivalents of
+`tayal2009/stan/hhmm-tayal2009.stan` and the `-lite` backtesting variant.
+
+The 2-top-state (bull/bear), 4-production-state HHMM is expanded to a
+sparse K=4 HMM (derivation: `tayal2009/main.Rmd:306-345`; see also
+:mod:`hhmm_tpu.hhmm.compile` which generalizes the expansion):
+
+- initial: π = [π₁, 0, 1−π₁, 0]  (`hhmm-tayal2009.stan:30-32`),
+- transitions with only 3 free parameters
+  (`hhmm-tayal2009.stan:34-44`, 0-indexed)::
+
+      A[0,1]=a01   A[0,2]=a02=1−a01     (bear production → up legs)
+      A[1,0]=1                          (deterministic alternation)
+      A[2,0]=a20   A[2,3]=a23=1−a20     (bull production → down legs)
+      A[3,2]=1
+
+- emissions: L=9 zig-zag symbols per state; observations arrive as
+  (x ∈ 0..8, sign ∈ {0=up, 1=down}). States {1,2} emit up-legs,
+  {0,3} emit down-legs.
+
+Sign gating, as in the reference's forward pass
+(`hhmm-tayal2009.stan:46-70`): the transition factor ``log A[i,j]`` (and
+at t=0 the ``log π[j]`` factor, restricted to entry states j∈{2 up, 0
+down}) is applied only when the destination j is sign-consistent;
+inconsistent destinations keep their emission term with a unit
+transition factor. ``gate_mode="hard"`` instead forbids inconsistent
+destinations (−inf emissions) — the clean reading, exact when the sign
+sequence strictly alternates (which zig-zag legs do by construction).
+
+The lite variant (`hhmm-tayal2009-lite.stan:94-158`) adds out-of-sample
+generated quantities: forward filtering + Viterbi on a held-out suffix,
+restarted from π — the backtesting fast path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hhmm_tpu.core.bijectors import Bijector, Simplex, UnitInterval
+from hhmm_tpu.core.lmath import safe_log, MASK_NEG
+from hhmm_tpu.kernels import backward_pass, forward_filter, smooth, viterbi
+from hhmm_tpu.models.base import BaseHMMModel
+
+__all__ = ["TayalHHMM", "TayalHHMMLite", "UP", "DOWN"]
+
+UP, DOWN = 0, 1
+# 0-indexed state sign groups: states {1,2} emit up legs, {0,3} down legs
+_UP_STATES = np.array([False, True, True, False])
+# entry states receiving the pi factor at t=0 (`hhmm-tayal2009.stan:50-54`)
+_ENTRY_UP, _ENTRY_DOWN = 2, 0
+
+
+class TayalHHMM(BaseHMMModel):
+    K = 4
+
+    def __init__(self, L: int = 9, gate_mode: str = "stan"):
+        if gate_mode not in ("stan", "hard"):
+            raise ValueError("gate_mode must be 'stan' or 'hard'")
+        self.L = L
+        self.gate_mode = gate_mode
+
+    def specs(self) -> List[Tuple[str, Bijector]]:
+        return [
+            ("p_11", UnitInterval(shape=())),
+            ("A_row", Simplex(shape=(2, 2))),
+            ("phi_k", Simplex(shape=(self.K, self.L))),
+        ]
+
+    def assemble(self, params):
+        """Sparse (π, A) from the 3 free parameters."""
+        p11 = params["p_11"]
+        Ar = params["A_row"]
+        pi = jnp.stack([p11.reshape(()), jnp.zeros(()), 1.0 - p11.reshape(()), jnp.zeros(())])
+        A = jnp.zeros((4, 4))
+        A = A.at[0, 1].set(Ar[0, 0]).at[0, 2].set(Ar[0, 1])
+        A = A.at[1, 0].set(1.0)
+        A = A.at[2, 0].set(Ar[1, 0]).at[2, 3].set(Ar[1, 1])
+        A = A.at[3, 2].set(1.0)
+        return pi, A
+
+    def _terms(self, params, x, sign):
+        x = x.astype(jnp.int32)
+        sign = sign.astype(jnp.int32)
+        pi, A = self.assemble(params)
+        log_phi = safe_log(params["phi_k"])
+        log_obs = log_phi.T[x]  # [T, K]
+        up = jnp.asarray(_UP_STATES)
+        consistent = jnp.where(sign[:, None] == UP, up[None, :], ~up[None, :])
+        return pi, A, log_obs, consistent
+
+    def _gated(self, params, x, sign):
+        """(log_pi, log_A_t, log_obs) with the selected gating semantics."""
+        pi, A, log_obs, consistent = self._terms(params, x, sign)
+        log_pi = safe_log(pi)
+        log_A = safe_log(A)
+        if self.gate_mode == "hard":
+            log_obs = jnp.where(consistent, log_obs, MASK_NEG)
+            T = log_obs.shape[0]
+            log_A_t = jnp.broadcast_to(log_A[None], (T - 1, 4, 4))
+            return log_pi, log_A_t, log_obs
+        # Stan parity: pi factor only on the sign-matching entry state;
+        # transition factor only on sign-consistent destinations.
+        entry = jnp.where(sign[0] == UP, _ENTRY_UP, _ENTRY_DOWN)
+        log_pi_g = jnp.where(jnp.arange(4) == entry, log_pi, 0.0)
+        log_A_t = jnp.where(consistent[1:, None, :], log_A[None], 0.0)
+        return log_pi_g, log_A_t, log_obs
+
+    def build(self, params, data):
+        log_pi, log_A_t, log_obs = self._gated(params, data["x"], data["sign"])
+        return log_pi, log_A_t, log_obs, data.get("mask")
+
+    def init_unconstrained(self, key, data):
+        """Informed chain init: phi rows start at the empirical symbol
+        frequencies of same-sign legs (up states ← up-leg frequencies,
+        down states ← down-leg frequencies) with jitter. The stan-parity
+        density is multimodal — a mode with state roles inverted (all
+        mass on the ungated emission-only track) competes with the
+        intended one — so chains start in the intended basin, the analog
+        of the reference's k-means chain inits (`hmm/main.R:37-47`)."""
+        x = np.asarray(data["x"])
+        sign = np.asarray(data["sign"])
+        L = self.L
+        freq_up = np.bincount(x[sign == UP], minlength=L) + 1.0
+        freq_dn = np.bincount(x[sign == DOWN], minlength=L) + 1.0
+        freq_up = freq_up / freq_up.sum()
+        freq_dn = freq_dn / freq_dn.sum()
+        phi = np.stack([freq_dn, freq_up, freq_up, freq_dn])
+        noise = np.asarray(jax.random.dirichlet(key, jnp.ones(L) * 20.0, (4,)))
+        phi = 0.7 * phi + 0.3 * noise
+        params = {
+            "p_11": np.array(0.5),
+            "A_row": np.full((2, 2), 0.5),
+            "phi_k": phi / phi.sum(axis=1, keepdims=True),
+        }
+        return self.pack(params)
+
+    def generated(self, theta_draws, data):
+        def one(theta):
+            params, _ = self.unpack(theta)
+            log_pi, log_A_t, log_obs = self._gated(params, data["x"], data["sign"])
+            mask = data.get("mask")
+            log_alpha, ll = forward_filter(log_pi, log_A_t, log_obs, mask)
+            log_beta = backward_pass(log_A_t, log_obs, mask)
+            zstar, lz = viterbi(log_pi, log_A_t, log_obs, mask)
+            return {
+                "alpha": jax.nn.softmax(log_alpha, axis=-1),
+                "gamma": jnp.exp(smooth(log_alpha, log_beta)),
+                "zstar": zstar,
+                "logp_zstar": lz,
+                "loglik": ll,
+            }
+
+        lead = theta_draws.shape[:-1]
+        flat = theta_draws.reshape(-1, theta_draws.shape[-1])
+        out = jax.vmap(one)(flat)
+        return {k: v.reshape(lead + v.shape[1:]) for k, v in out.items()}
+
+
+class TayalHHMMLite(TayalHHMM):
+    """Same training posterior; generated quantities run filtering +
+    Viterbi on a held-out OOS segment restarted from π
+    (`hhmm-tayal2009-lite.stan:94-158`). ``data`` additionally carries
+    ``x_oos``, ``sign_oos`` (and optionally ``mask_oos``)."""
+
+    def generated(self, theta_draws, data):
+        def one(theta):
+            params, _ = self.unpack(theta)
+            # in-sample filtered probabilities
+            log_pi, log_A_t, log_obs = self._gated(params, data["x"], data["sign"])
+            log_alpha, _ = forward_filter(log_pi, log_A_t, log_obs, data.get("mask"))
+            # OOS: restart from pi on the held-out suffix
+            log_pi_o, log_A_o, log_obs_o = self._gated(
+                params, data["x_oos"], data["sign_oos"]
+            )
+            mask_o = data.get("mask_oos")
+            log_alpha_o, _ = forward_filter(log_pi_o, log_A_o, log_obs_o, mask_o)
+            zstar_o, _ = viterbi(log_pi_o, log_A_o, log_obs_o, mask_o)
+            return {
+                "alpha": jax.nn.softmax(log_alpha, axis=-1),
+                "alpha_oos": jax.nn.softmax(log_alpha_o, axis=-1),
+                "zstar_oos": zstar_o,
+            }
+
+        lead = theta_draws.shape[:-1]
+        flat = theta_draws.reshape(-1, theta_draws.shape[-1])
+        out = jax.vmap(one)(flat)
+        return {k: v.reshape(lead + v.shape[1:]) for k, v in out.items()}

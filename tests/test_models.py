@@ -1,0 +1,323 @@
+"""Model-zoo tests: simulation-based parameter recovery (SURVEY.md §4 item 1)
+plus logp/gradient sanity for every model.
+
+Recovery configs mirror the reference drivers: Gaussian HMM uses
+`hmm/main.R:7-11` (T=500, K=2, A=[[.8,.2],[.35,.65]], p1=[.9,.1],
+emissions N(10z, 3) — rescaled ×0.1 here); the Tayal check mirrors
+`tayal2009/main-sim.R:7-28` (simulate the expanded sparse-A HMM, fit the
+Tayal model).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hhmm_tpu.sim import hmm_sim, obsmodel_gaussian, obsmodel_categorical, iohmm_sim, obsmodel_reg
+from hhmm_tpu.models import (
+    GaussianHMM,
+    MultinomialHMM,
+    SemisupMultinomialHMM,
+    IOHMMReg,
+    IOHMMMix,
+    IOHMMHMix,
+    IOHMMHMixLite,
+    TayalHHMM,
+    TayalHHMMLite,
+)
+from hhmm_tpu.infer import sample_nuts, SamplerConfig, split_rhat
+
+HP9 = [0.0, 5.0, 1.0, 0.0, 3.0, 1.0, 1.0, 0.0, 5.0]
+
+
+def _fit(model, data, key=0, warmup=300, samples=300, chains=2):
+    logp = model.make_logp(data)
+    keys = jax.random.split(jax.random.PRNGKey(key), chains)
+    init = jnp.stack([model.init_unconstrained(k, data) for k in keys])
+    cfg = SamplerConfig(num_warmup=warmup, num_samples=samples, num_chains=chains)
+    qs, stats = sample_nuts(logp, jax.random.PRNGKey(key + 1), init, cfg)
+    return qs, stats
+
+
+def test_gaussian_hmm_recovery():
+    A = np.array([[0.80, 0.20], [0.35, 0.65]])
+    p1 = np.array([0.9, 0.1])
+    mu_true, sigma_true = np.array([1.0, 2.0]), np.array([0.3, 0.3])
+    z, x = hmm_sim(
+        jax.random.PRNGKey(42), 500, A, p1, obsmodel_gaussian(mu_true, sigma_true)
+    )
+    model = GaussianHMM(K=2)
+    data = {"x": jnp.asarray(x)}
+    qs, stats = _fit(model, data)
+    assert np.asarray(stats["diverging"]).mean() < 0.05
+    post = model.constrained_draws(qs)
+    A_hat = np.asarray(post["A_ij"]).mean(axis=(0, 1))
+    mu_hat = np.asarray(post["mu_k"]).mean(axis=(0, 1))
+    sigma_hat = np.asarray(post["sigma_k"]).mean(axis=(0, 1))
+    np.testing.assert_allclose(mu_hat, mu_true, atol=0.08)
+    np.testing.assert_allclose(sigma_hat, sigma_true, atol=0.05)
+    np.testing.assert_allclose(A_hat, A, atol=0.10)
+    # state recovery through generated quantities
+    gen = model.generated(qs.reshape(-1, qs.shape[-1])[::50], data)
+    zstar = np.asarray(gen["zstar"])
+    acc = (zstar == np.asarray(z)[None, :]).mean()
+    assert acc > 0.9
+
+
+def test_multinomial_hmm_recovery():
+    A = np.array([[0.85, 0.15], [0.25, 0.75]])
+    p1 = np.array([0.5, 0.5])
+    phi = np.array([[0.7, 0.2, 0.1], [0.1, 0.15, 0.75]])
+    z, x = hmm_sim(jax.random.PRNGKey(7), 600, A, p1, obsmodel_categorical(phi))
+    model = MultinomialHMM(K=2, L=3)
+    data = {"x": jnp.asarray(x)}
+    qs, stats = _fit(model, data)
+    post = model.constrained_draws(qs)
+    phi_hat = np.asarray(post["phi_k"]).mean(axis=(0, 1))
+    A_hat = np.asarray(post["A_ij"]).mean(axis=(0, 1))
+    # undo label switching with the greedy confusion-matrix relabeler
+    # (the reference's post-pass, iohmm-reg/main.R:78-94)
+    from hhmm_tpu.infer import greedy_relabel
+    from itertools import permutations
+
+    gen = model.generated(qs.reshape(-1, qs.shape[-1])[::100], data)
+    z_hat = np.asarray(np.median(np.asarray(gen["zstar"]), axis=0)).astype(int)
+    perm = greedy_relabel(np.asarray(z), z_hat, 2)
+    inv = np.argsort(perm)  # row r of estimates corresponds to true state perm[r]
+    phi_hat = phi_hat[inv]
+    A_hat = A_hat[np.ix_(inv, inv)]
+    np.testing.assert_allclose(phi_hat, phi, atol=0.12)
+    np.testing.assert_allclose(A_hat, A, atol=0.15)
+
+
+def test_iohmm_reg_recovery():
+    """Generative-mode IOHMM-reg recovers regression weights
+    (config shape: `iohmm-reg/main.R:10-22`, shrunk for CPU)."""
+    rng = np.random.default_rng(3)
+    T, K, M = 300, 2, 3
+    u = np.column_stack([np.ones(T), rng.normal(size=(T, M - 1))])
+    w = np.array([[1.5, 0.5, -0.5], [-1.5, -0.5, 0.5]])
+    b = np.array([[2.0, 1.0, 0.0], [-2.0, 0.0, 1.0]])
+    s = np.array([0.4, 0.4])
+    out = iohmm_sim(jax.random.PRNGKey(5), u, w, obsmodel_reg(b, s))
+    model = IOHMMReg(K=K, M=M, trans_mode="gen")
+    data = {"x": out["x"], "u": out["u"]}
+    qs, stats = _fit(model, data)
+    post = model.constrained_draws(qs)
+    b_hat = np.asarray(post["b_km"]).mean(axis=(0, 1))
+    s_hat = np.asarray(post["s_k"]).mean(axis=(0, 1))
+    # undo label switching by matching intercepts
+    perm = [int(np.argmin(np.abs(b_hat[:, 0] - b[k, 0]))) for k in range(K)]
+    assert sorted(perm) == list(range(K))
+    np.testing.assert_allclose(b_hat[perm], b, atol=0.25)
+    np.testing.assert_allclose(s_hat[perm], s, atol=0.15)
+
+
+def _simulate_tayal(key, T=500):
+    """Expanded sparse-A Tayal HMM simulation (`tayal2009/main-sim.R:7-28`)."""
+    A = np.array(
+        [
+            [0.00, 0.80, 0.20, 0.00],
+            [1.00, 0.00, 0.00, 0.00],
+            [0.35, 0.00, 0.00, 0.65],
+            [0.00, 0.00, 1.00, 0.00],
+        ]
+    )
+    p1 = np.array([0.5, 0.0, 0.5, 0.0])
+    # states {1,2} emit up symbols, {0,3} down symbols; distinct shapes
+    phi = np.array(
+        [
+            [0.5, 0.3, 0.2, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0],
+            [0.0, 0.0, 0.0, 0.6, 0.3, 0.1, 0.0, 0.0, 0.0],
+            [0.0, 0.0, 0.0, 0.1, 0.3, 0.6, 0.0, 0.0, 0.0],
+            [0.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.2, 0.3, 0.5],
+        ]
+    )
+    z, x = hmm_sim(key, T, A, p1, obsmodel_categorical(phi), validate=True)
+    sign = np.where(np.isin(np.asarray(z), [1, 2]), 0, 1)  # UP=0, DOWN=1
+    return A, p1, phi, np.asarray(z), np.asarray(x), sign
+
+
+@pytest.mark.parametrize("gate_mode", ["hard", "stan"])
+def test_tayal_recovery(gate_mode):
+    """State-recovery check up to label permutation (the reference's own
+    workflow: hard classification + ex-post relabeling,
+    `tayal2009/main.R:157-184`), plus a mode-quality check: the posterior
+    mean must explain the data at least as well as the true parameters
+    (the up-state pair {1,2} is only weakly identified from dynamics, so
+    exact A_row recovery is not guaranteed — the reference hits the same
+    ambiguity and relabels by ex-post return ordering)."""
+    from hhmm_tpu.infer import greedy_relabel, apply_relabel
+
+    A, p1, phi, z, x, sign = _simulate_tayal(jax.random.PRNGKey(11))
+    model = TayalHHMM(L=9, gate_mode=gate_mode)
+    data = {"x": jnp.asarray(x), "sign": jnp.asarray(sign)}
+    qs, stats = _fit(model, data, warmup=250, samples=250)
+    assert np.asarray(stats["diverging"]).mean() < 0.05
+
+    # mode quality: mean posterior logp at draws ≥ logp at truth − margin
+    logp = model.make_logp(data)
+    truth = model.pack(
+        {"p_11": np.array(0.5), "A_row": np.array([[0.8, 0.2], [0.35, 0.65]]),
+         "phi_k": np.clip(phi, 1e-6, None) / np.clip(phi, 1e-6, None).sum(1, keepdims=True)}
+    )
+    lp_true = float(logp(truth))
+    lp_draws = float(np.mean([float(logp(q)) for q in np.asarray(qs)[:, -50::10].reshape(-1, qs.shape[-1])]))
+    assert lp_draws > lp_true - 30.0
+
+    # state recovery, using the reference's classification rule: hard
+    # states from the median filtered probability across draws
+    # (`tayal2009/main.R:130-165`), then greedy relabeling
+    gen = model.generated(qs.reshape(-1, qs.shape[-1])[::50], data)
+    alpha_med = np.median(np.asarray(gen["alpha"]), axis=0)  # [T, K]
+    z_hat = np.argmax(alpha_med, axis=-1)
+    perm = greedy_relabel(z, z_hat, 4)
+    z_rel = apply_relabel(z_hat, perm)
+    if gate_mode == "hard":
+        assert (z_rel == z).mean() > 0.85
+    # top-state (bear {0,1} vs bull {2,3}) recovery must survive relabeling
+    top_acc = (np.isin(z_rel, [2, 3]) == np.isin(z, [2, 3])).mean()
+    assert top_acc > 0.8
+
+
+def test_tayal_stan_parity_oracle():
+    """The stan-parity gated forward must equal a direct NumPy
+    transcription of the reference's recursion
+    (`hhmm-tayal2009.stan:46-70`): per-state accumulator over previous
+    states with the transition factor applied only at sign-consistent
+    destinations, pi applied only at the sign-matching entry state."""
+    from scipy.special import logsumexp as lse
+
+    rng = np.random.default_rng(9)
+    T, L = 60, 9
+    x = rng.integers(0, L, T)
+    sign = np.arange(T) % 2  # strictly alternating, starts UP
+    p11 = 0.37
+    Ar = np.array([[0.7, 0.3], [0.45, 0.55]])
+    phi = rng.dirichlet(np.ones(L), size=4)
+
+    # oracle: literal transcription
+    pi = np.array([p11, 0, 1 - p11, 0])
+    A = np.zeros((4, 4))
+    A[0, 1], A[0, 2] = Ar[0]
+    A[1, 0] = 1.0
+    A[2, 0], A[2, 3] = Ar[1]
+    A[3, 2] = 1.0
+    up_states = [1, 2]
+    with np.errstate(divide="ignore"):
+        logA = np.log(A)
+        logpi = np.log(pi)
+        logphi = np.log(phi)
+    alpha = np.zeros((T, 4))
+    for j in range(4):
+        alpha[0, j] = logphi[j, x[0]]
+        if (sign[0] == 0 and j == 2) or (sign[0] == 1 and j == 0):
+            alpha[0, j] += logpi[j]
+    for t in range(1, T):
+        cons = up_states if sign[t] == 0 else [0, 3]
+        for j in range(4):
+            acc = alpha[t - 1].copy() + logphi[j, x[t]]
+            if j in cons:
+                acc += logA[:, j]
+            alpha[t, j] = lse(acc)
+    ll_oracle = lse(alpha[-1])
+
+    model = TayalHHMM(L=L, gate_mode="stan")
+    theta = model.pack({"p_11": np.array(p11), "A_row": Ar, "phi_k": phi})
+    ll = float(model.make_logp({"x": jnp.asarray(x), "sign": jnp.asarray(sign)})(theta))
+    # remove the prior-side log-jacobian to compare pure log-likelihoods
+    _, ldj = model.unpack(theta)
+    np.testing.assert_allclose(ll - float(ldj), ll_oracle, rtol=5e-4, atol=5e-3)
+
+
+def test_tayal_lite_oos_outputs():
+    A, p1, phi, z, x, sign = _simulate_tayal(jax.random.PRNGKey(13), T=400)
+    model = TayalHHMMLite(L=9, gate_mode="hard")
+    split = 300
+    data = {
+        "x": jnp.asarray(x[:split]),
+        "sign": jnp.asarray(sign[:split]),
+        "x_oos": jnp.asarray(x[split:]),
+        "sign_oos": jnp.asarray(sign[split:]),
+    }
+    qs, _ = _fit(model, data, warmup=200, samples=100)
+    gen = model.generated(qs.reshape(-1, qs.shape[-1])[::20], data)
+    alpha_oos = np.asarray(gen["alpha_oos"])
+    assert alpha_oos.shape[1:] == (100, 4)
+    np.testing.assert_allclose(alpha_oos.sum(axis=-1), 1.0, atol=1e-3)
+    z_oos_hat = np.asarray(gen["zstar_oos"])
+    # posterior-median hard path should track the true top-state regime
+    top_true = np.isin(z[split:], [2, 3])
+    top_hat = np.isin(np.median(z_oos_hat, axis=0), [2, 3])
+    assert (top_hat == top_true).mean() > 0.7
+
+
+@pytest.mark.parametrize(
+    "model,data_fn",
+    [
+        (
+            SemisupMultinomialHMM(K=4, L=9, groups=[0, 1, 1, 0], gate_mode="stan"),
+            lambda: {
+                "x": jnp.asarray(np.random.default_rng(0).integers(0, 9, 120)),
+                "g": jnp.asarray(np.random.default_rng(1).integers(0, 2, 120)),
+            },
+        ),
+        (
+            SemisupMultinomialHMM(K=4, L=9, groups=[0, 1, 1, 0], gate_mode="hard"),
+            lambda: {
+                "x": jnp.asarray(np.random.default_rng(0).integers(0, 9, 120)),
+                "g": jnp.asarray(np.random.default_rng(1).integers(0, 2, 120)),
+            },
+        ),
+        (
+            IOHMMMix(K=2, M=2, L=2),
+            lambda: {
+                "x": jnp.asarray(np.random.default_rng(2).normal(size=150)),
+                "u": jnp.asarray(
+                    np.column_stack(
+                        [np.ones(150), np.random.default_rng(3).normal(size=150)]
+                    )
+                ),
+            },
+        ),
+        (
+            IOHMMHMix(K=2, M=2, L=2, hyperparams=HP9),
+            lambda: {
+                "x": jnp.asarray(np.random.default_rng(2).normal(size=150)),
+                "u": jnp.asarray(
+                    np.column_stack(
+                        [np.ones(150), np.random.default_rng(3).normal(size=150)]
+                    )
+                ),
+            },
+        ),
+    ],
+)
+def test_logp_and_grad_finite(model, data_fn):
+    data = data_fn()
+    logp = model.make_logp(data)
+    theta = model.init_unconstrained(jax.random.PRNGKey(0), data)
+    val, grad = jax.value_and_grad(logp)(theta)
+    assert np.isfinite(np.asarray(val))
+    assert np.all(np.isfinite(np.asarray(grad)))
+
+
+def test_hmix_lite_oblik():
+    rng = np.random.default_rng(4)
+    T = 120
+    data = {
+        "x": jnp.asarray(rng.normal(size=T)),
+        "u": jnp.asarray(np.column_stack([np.ones(T), rng.normal(size=T)])),
+    }
+    model = IOHMMHMixLite(K=2, M=2, L=2, hyperparams=HP9)
+    theta = model.init_unconstrained(jax.random.PRNGKey(0), data)
+    gen = model.generated(theta[None, :], data)
+    assert gen["oblik_t"].shape == (1, T)
+    assert np.all(np.isfinite(np.asarray(gen["oblik_t"])))
+
+
+def test_hyperparams_arity_enforced():
+    """The reference driver's 7-vs-9 hyperparameter mismatch
+    (SURVEY.md §2.8 item 5) must be a hard error here."""
+    with pytest.raises(ValueError, match="9 elements"):
+        IOHMMHMix(K=2, M=2, L=2, hyperparams=[0, 5, 1, 0, 3, 1, 1])
