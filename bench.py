@@ -1,0 +1,144 @@
+"""Benchmark: batched Tayal HHMM posterior — series/sec vs Stan/CPU.
+
+The BASELINE.json north-star config (#5): NUTS posteriors for the Tayal
+(2009) sparse-HMM reduction over 256 independent tick series, vmapped and
+run on one chip (multi-chip scales linearly via the mesh sharding in
+``__graft_entry__.dryrun_multichip`` — per-series work is embarrassingly
+parallel, SURVEY.md §2.9).
+
+Baseline: the reference fits each series with RStan NUTS at 500 iter /
+250 warmup (`tayal2009/main.R:34-39`). Its log records ≈5 min for a
+*smaller* model (IOHMM-mix T=300, K=2, L=3, 600 iter, `log.md:546`) and
+≈30 min for K=4; we charge Stan a conservative 120 s per Tayal series
+(K=4, L=9, T≈1000 zig-zag legs, 500 iter), i.e. baseline throughput
+1/120 series/sec. ``vs_baseline`` is the speedup factor; the north-star
+target is ≥50×.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+STAN_SECONDS_PER_SERIES = 120.0
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--series", type=int, default=256)
+    ap.add_argument("--T", type=int, default=1024)
+    ap.add_argument("--warmup", type=int, default=250)
+    ap.add_argument("--samples", type=int, default=250)
+    ap.add_argument("--max-treedepth", type=int, default=8)
+    ap.add_argument(
+        "--chunk",
+        type=int,
+        default=64,
+        help="series per XLA execution; the device tunnel kills executions "
+        "running longer than a few minutes, so the 256-series batch is "
+        "dispatched as sequential chunks (throughput is unaffected: each "
+        "chunk saturates the chip)",
+    )
+    ap.add_argument("--quick", action="store_true", help="tiny config for smoke tests")
+    args = ap.parse_args()
+    if args.quick:
+        args.series, args.T, args.warmup, args.samples = 8, 128, 20, 20
+
+    from __graft_entry__ import _tayal_batch
+    from hhmm_tpu.infer import SamplerConfig, sample_nuts
+    from hhmm_tpu.infer.diagnostics import ess
+    from hhmm_tpu.models import TayalHHMM
+
+    model = TayalHHMM()
+    x, sign = _tayal_batch(args.series, args.T, seed=42)
+    cfg = SamplerConfig(
+        num_warmup=args.warmup,
+        num_samples=args.samples,
+        num_chains=1,
+        max_treedepth=args.max_treedepth,
+    )
+
+    chunk = min(args.chunk, args.series)
+    if args.series % chunk != 0:
+        raise SystemExit(f"--series {args.series} must be divisible by --chunk {chunk}")
+    init = jnp.stack(
+        [
+            model.init_unconstrained(
+                jax.random.PRNGKey(100 + i), {"x": x[i], "sign": sign[i]}
+            )
+            for i in range(args.series)
+        ]
+    )[:, None, :]
+    keys = jax.random.split(jax.random.PRNGKey(0), args.series)
+
+    def run_chunk(x, sign, init, keys):
+        def one(xi, si, qi, ki):
+            logp = model.make_logp({"x": xi, "sign": si})
+            qs, stats = sample_nuts(logp, ki, qi, cfg, jit=False)
+            return qs, stats["logp"], stats["diverging"]
+
+        return jax.vmap(one)(x, sign, init, keys)
+
+    run = jax.jit(run_chunk)
+    # warm-up/compile pass uses DIFFERENT keys: the device tunnel can
+    # memoize byte-identical requests, so re-running the same call would
+    # time a cache hit, not the computation
+    warm_keys = jax.random.split(jax.random.PRNGKey(999), chunk)
+    t0 = time.time()
+    jax.block_until_ready(run(x[:chunk], sign[:chunk], init[:chunk], warm_keys))
+    compile_and_run = time.time() - t0
+
+    t0 = time.time()
+    logps, div = [], []
+    for s in range(0, args.series, chunk):
+        sl = slice(s, s + chunk)
+        _, lp, dv = jax.block_until_ready(run(x[sl], sign[sl], init[sl], keys[sl]))
+        logps.append(lp)
+        div.append(dv)
+    exec_s = time.time() - t0
+    logps = jnp.concatenate(logps)
+    div = jnp.concatenate(div)
+
+    series_per_sec = args.series / exec_s
+    vs_baseline = series_per_sec * STAN_SECONDS_PER_SERIES
+
+    # secondary diagnostics (stderr): ESS/sec of lp__, divergence rate
+    lp = np.asarray(logps)  # [B, chains, draws]
+    ess_vals = [ess(lp[i]) for i in range(min(16, args.series))]
+    print(
+        json.dumps(
+            {
+                "device": str(jax.devices()[0]),
+                "exec_s": round(exec_s, 3),
+                "compile_s": round(compile_and_run - exec_s * chunk / args.series, 3),
+                "mean_ess_lp": round(float(np.mean(ess_vals)), 1),
+                "ess_per_sec": round(float(np.mean(ess_vals)) * series_per_sec, 1),
+                "divergence_rate": round(float(np.asarray(div).mean()), 4),
+                "config": vars(args),
+            }
+        ),
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "tayal_batched_posterior_throughput",
+                "value": round(series_per_sec, 4),
+                "unit": "series/sec",
+                "vs_baseline": round(vs_baseline, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""HHMM structure DSL: node taxonomy, recursive simulator, and the
+tree → flat-sparse-HMM compiler (SURVEY.md §7.1 item 4). The hierarchy
+is the "source of truth for model structure" (BASELINE.json); the TPU
+kernels only ever see the compiled flat (π, A)."""
+
+from hhmm_tpu.hhmm.structure import (
+    End,
+    Internal,
+    Production,
+    finalize,
+    iter_leaves,
+    leaf_groups,
+)
+from hhmm_tpu.hhmm.simulate import hhmm_sim, sample_emission
+from hhmm_tpu.hhmm.compile import (
+    FlatHMM,
+    compile_hhmm,
+    gaussian_leaf_params,
+    categorical_leaf_params,
+)
+from hhmm_tpu.hhmm.examples import (
+    hmix_tree,
+    fine1998_tree,
+    tayal_tree,
+    jangmin2004_tree,
+)
+
+__all__ = [
+    "End",
+    "Internal",
+    "Production",
+    "finalize",
+    "iter_leaves",
+    "leaf_groups",
+    "hhmm_sim",
+    "sample_emission",
+    "FlatHMM",
+    "compile_hhmm",
+    "gaussian_leaf_params",
+    "categorical_leaf_params",
+    "hmix_tree",
+    "fine1998_tree",
+    "tayal_tree",
+    "jangmin2004_tree",
+]

@@ -79,7 +79,7 @@ class SemisupMultinomialHMM(MultinomialHMM):
         self.gate_mode = gate_mode
 
     def build(self, params, data):
-        raise NotImplementedError("SemisupMultinomialHMM overrides loglik directly")
+        return (*self._gated(params, data), data.get("mask"))
 
     def _gated(self, params, data):
         """Shared (log_pi, log_A_t, log_obs) with the selected gating —
@@ -100,10 +100,11 @@ class SemisupMultinomialHMM(MultinomialHMM):
         T = log_obs.shape[0]
 
         if self.gate_mode == "hard":
-            # impossible destinations: masked emission (clean gating)
+            # impossible destinations: masked emission (clean gating);
+            # log_A stays homogeneous 2-D so the scan kernels keep it
+            # closed over instead of threading T-1 slices through xs
             log_obs = jnp.where(consistent, log_obs, MASK_NEG)
-            log_A_t = jnp.broadcast_to(log_A[None], (T - 1,) + log_A.shape)
-            return log_pi, log_A_t, log_obs
+            return log_pi, log_A, log_obs
 
         # Stan-parity mode: transition factor applied only on consistent
         # destinations; inconsistent ones keep the emission term with a
@@ -111,32 +112,3 @@ class SemisupMultinomialHMM(MultinomialHMM):
         # matrix A_t[i, j] = consistent[t+1, j] ? A[i, j] : 1.
         log_A_t = jnp.where(consistent[1:, None, :], log_A[None, :, :], 0.0)
         return log_pi, log_A_t, log_obs
-
-    def loglik(self, params, data):
-        log_pi, log_A_t, log_obs = self._gated(params, data)
-        _, ll = forward_filter(log_pi, log_A_t, log_obs, data.get("mask"))
-        return ll
-
-    def generated(self, theta_draws, data):
-        from hhmm_tpu.kernels import backward_pass, smooth, viterbi
-
-        def one(theta):
-            params, _ = self.unpack(theta)
-            log_pi, log_A_t, log_obs = self._gated(params, data)
-            mask = data.get("mask")
-            log_alpha, ll = forward_filter(log_pi, log_A_t, log_obs, mask)
-            log_beta = backward_pass(log_A_t, log_obs, mask)
-            log_gamma = smooth(log_alpha, log_beta)
-            zstar, lz = viterbi(log_pi, log_A_t, log_obs, mask)
-            return {
-                "alpha": jax.nn.softmax(log_alpha, axis=-1),
-                "gamma": jnp.exp(log_gamma),
-                "zstar": zstar,
-                "logp_zstar": lz,
-                "loglik": ll,
-            }
-
-        lead = theta_draws.shape[:-1]
-        flat = theta_draws.reshape(-1, theta_draws.shape[-1])
-        out = jax.vmap(one)(flat)
-        return {k: v.reshape(lead + v.shape[1:]) for k, v in out.items()}

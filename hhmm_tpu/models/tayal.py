@@ -42,7 +42,7 @@ import numpy as np
 
 from hhmm_tpu.core.bijectors import Bijector, Simplex, UnitInterval
 from hhmm_tpu.core.lmath import safe_log, MASK_NEG
-from hhmm_tpu.kernels import backward_pass, forward_filter, smooth, viterbi
+from hhmm_tpu.kernels import forward_filter, viterbi
 from hhmm_tpu.models.base import BaseHMMModel
 
 __all__ = ["TayalHHMM", "TayalHHMMLite", "UP", "DOWN"]
@@ -98,10 +98,10 @@ class TayalHHMM(BaseHMMModel):
         log_pi = safe_log(pi)
         log_A = safe_log(A)
         if self.gate_mode == "hard":
+            # homogeneous 2-D log_A: the scan kernels keep it closed over
+            # instead of threading T-1 slices through xs on the hot path
             log_obs = jnp.where(consistent, log_obs, MASK_NEG)
-            T = log_obs.shape[0]
-            log_A_t = jnp.broadcast_to(log_A[None], (T - 1, 4, 4))
-            return log_pi, log_A_t, log_obs
+            return log_pi, log_A, log_obs
         # Stan parity: pi factor only on the sign-matching entry state;
         # transition factor only on sign-consistent destinations.
         entry = jnp.where(sign[0] == UP, _ENTRY_UP, _ENTRY_DOWN)
@@ -137,28 +137,6 @@ class TayalHHMM(BaseHMMModel):
             "phi_k": phi / phi.sum(axis=1, keepdims=True),
         }
         return self.pack(params)
-
-    def generated(self, theta_draws, data):
-        def one(theta):
-            params, _ = self.unpack(theta)
-            log_pi, log_A_t, log_obs = self._gated(params, data["x"], data["sign"])
-            mask = data.get("mask")
-            log_alpha, ll = forward_filter(log_pi, log_A_t, log_obs, mask)
-            log_beta = backward_pass(log_A_t, log_obs, mask)
-            zstar, lz = viterbi(log_pi, log_A_t, log_obs, mask)
-            return {
-                "alpha": jax.nn.softmax(log_alpha, axis=-1),
-                "gamma": jnp.exp(smooth(log_alpha, log_beta)),
-                "zstar": zstar,
-                "logp_zstar": lz,
-                "loglik": ll,
-            }
-
-        lead = theta_draws.shape[:-1]
-        flat = theta_draws.reshape(-1, theta_draws.shape[-1])
-        out = jax.vmap(one)(flat)
-        return {k: v.reshape(lead + v.shape[1:]) for k, v in out.items()}
-
 
 class TayalHHMMLite(TayalHHMM):
     """Same training posterior; generated quantities run filtering +

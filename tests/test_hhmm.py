@@ -1,0 +1,231 @@
+"""HHMM structure DSL tests: validation, compiler correctness (hand
+values + Tayal parity + empirical law of the recursive engine), and the
+reference example trees."""
+
+import numpy as np
+import pytest
+
+from hhmm_tpu.hhmm import (
+    End,
+    Internal,
+    Production,
+    compile_hhmm,
+    fine1998_tree,
+    finalize,
+    gaussian_leaf_params,
+    hhmm_sim,
+    hmix_tree,
+    jangmin2004_tree,
+    leaf_groups,
+    tayal_tree,
+)
+from hhmm_tpu.models import TayalHHMM
+
+
+def _leaf(mu=0.0):
+    return Production(obs=("gaussian", {"mu": mu, "sigma": 1.0}))
+
+
+class TestValidation:
+    def test_pi_must_sum_to_one(self):
+        bad = Internal(pi=[0.5, 0.2], A=np.eye(2), children=[_leaf(), _leaf()])
+        with pytest.raises(ValueError, match="sum to 1"):
+            finalize(bad)
+
+    def test_no_pi_mass_on_end(self):
+        bad = Internal(
+            pi=[0.5, 0.5], A=[[0.0, 1.0], [0.0, 1.0]], children=[_leaf(), End()]
+        )
+        with pytest.raises(ValueError, match="End child"):
+            finalize(bad)
+
+    def test_a_rows_stochastic(self):
+        bad = Internal(
+            pi=[1.0, 0.0], A=[[0.3, 0.3], [0.0, 1.0]], children=[_leaf(), End()]
+        )
+        with pytest.raises(ValueError, match="sums to"):
+            finalize(bad)
+
+    def test_orphanless_wiring(self):
+        root = hmix_tree()
+        comp = root.children[0]
+        assert comp.parent is root and comp.index == 0
+        for j, child in enumerate(comp.children):
+            assert child.parent is comp and child.index == j
+
+    def test_degenerate_end_only_subtree_rejected(self):
+        inner = Internal(pi=[0.0], A=[[1.0]], children=[End()])
+        # an End-only subtree cannot satisfy the pi-sums-to-1 constraint
+        with pytest.raises(ValueError):
+            finalize(Internal(pi=[1.0, 0.0], A=np.eye(2), children=[inner, End()]))
+
+    def test_aliased_node_rejected(self):
+        shared = Internal(
+            pi=[1.0, 0.0],
+            A=[[0.0, 1.0], [0.0, 1.0]],
+            children=[_leaf(), End()],
+        )
+        root = Internal(
+            pi=[0.5, 0.5],
+            A=[[0.5, 0.5], [0.5, 0.5]],
+            children=[shared, shared],
+        )
+        with pytest.raises(ValueError, match="more than once"):
+            finalize(root)
+
+
+class TestCompile:
+    def test_hmix_hand_values(self):
+        flat = compile_hhmm(hmix_tree())
+        np.testing.assert_allclose(flat.pi, [0.5, 0.5])
+        # from comp 2: 0.9 stay, 0.1 exit → root restart → re-enter 50/50
+        np.testing.assert_allclose(flat.A, [[0.9, 0.1], [0.05, 0.95]])
+        mu, sigma = gaussian_leaf_params(flat)
+        np.testing.assert_allclose(mu, [5.0, -5.0])
+        np.testing.assert_allclose(sigma, [1.0, 1.0])
+
+    def test_tayal_matches_hand_derivation(self):
+        """Compiled bull/bear tree == the hand-derived sparse K=4 HMM of
+        `tayal2009/main.Rmd:306-345` as implemented in models/tayal.py."""
+        rng = np.random.default_rng(3)
+        p11, a_bear, a_bull = 0.37, 0.62, 0.81
+        phi = rng.dirichlet(np.ones(9), size=4)
+        flat = compile_hhmm(tayal_tree(p11, a_bear, a_bull, phi))
+
+        model = TayalHHMM()
+        # the reference parameterizes asymmetrically (`hhmm-tayal2009.stan:34-44`):
+        # bear row carries the within-regime prob (A[0,1]=a01), bull row the
+        # exit prob (A[2,0]=a20) — hence [a_bear, ...] but [1-a_bull, ...]
+        params = {
+            "p_11": np.array(p11),
+            "A_row": np.array([[a_bear, 1 - a_bear], [1 - a_bull, a_bull]]),
+            "phi_k": phi,
+        }
+        pi_ref, A_ref = model.assemble(params)
+        np.testing.assert_allclose(flat.pi, np.asarray(pi_ref), atol=1e-12)
+        np.testing.assert_allclose(flat.A, np.asarray(A_ref), atol=1e-12)
+        np.testing.assert_array_equal(flat.groups, [0, 0, 1, 1])
+
+    def test_fine1998_compiles(self):
+        flat = compile_hhmm(fine1998_tree())
+        assert flat.K == 5
+        np.testing.assert_allclose(flat.A.sum(axis=1), np.ones(5), atol=1e-12)
+        mu, _ = gaussian_leaf_params(flat)
+        np.testing.assert_allclose(sorted(mu), [21.0, 32.0, 41.0, 42.0, 43.0])
+
+    def test_jangmin_compiles(self):
+        flat = compile_hhmm(jangmin2004_tree())
+        assert flat.K == 63
+        np.testing.assert_allclose(flat.A.sum(axis=1), np.ones(63), atol=1e-12)
+        # top-state labels: 5 regimes, 15/15/15/15/3 leaves
+        counts = np.bincount(flat.groups)
+        np.testing.assert_array_equal(counts, [15, 15, 15, 15, 3])
+
+
+class TestSimulatorMatchesCompiler:
+    """The compiled flat HMM must be the exact law of the recursive
+    engine: empirical leaf-transition frequencies from hhmm_sim ≈ A."""
+
+    @pytest.mark.parametrize("tree_fn", [hmix_tree, fine1998_tree])
+    def test_empirical_transitions(self, tree_fn):
+        tree = tree_fn()
+        flat = compile_hhmm(tree)
+        rng = np.random.default_rng(0)
+        T = 40000
+        z, x = hhmm_sim(tree, T, rng)
+        counts = np.zeros((flat.K, flat.K))
+        np.add.at(counts, (z[:-1], z[1:]), 1.0)
+        visited = counts.sum(axis=1) > 200
+        emp = counts[visited] / counts[visited].sum(axis=1, keepdims=True)
+        np.testing.assert_allclose(emp, flat.A[visited], atol=0.03)
+
+    def test_emissions_match_leaves(self):
+        tree = fine1998_tree()
+        flat = compile_hhmm(tree)
+        mu, _ = gaussian_leaf_params(flat)
+        rng = np.random.default_rng(1)
+        z, x = hhmm_sim(tree, 20000, rng)
+        for k in range(flat.K):
+            if (z == k).sum() > 100:
+                assert abs(x[z == k].mean() - mu[k]) < 0.1
+
+    def test_flat_hmm_sim_equivalence(self):
+        """Sampling the compiled chain with the TPU-path simulator gives
+        the same stationary occupancy as the recursive engine."""
+        import jax
+
+        from hhmm_tpu.sim import hmm_sim, obsmodel_gaussian
+
+        tree = hmix_tree()
+        flat = compile_hhmm(tree)
+        mu, sigma = gaussian_leaf_params(flat)
+        z_flat, _ = hmm_sim(
+            jax.random.PRNGKey(0), 40000, flat.A, flat.pi, obsmodel_gaussian(mu, sigma)
+        )
+        z_rec, _ = hhmm_sim(tree, 40000, np.random.default_rng(2))
+        occ_flat = np.bincount(np.asarray(z_flat), minlength=2) / 40000
+        occ_rec = np.bincount(z_rec, minlength=2) / 40000
+        # compare both to the analytic stationary distribution of A
+        # (left eigenvector), not to each other — the sticky chain's
+        # autocorrelation makes sim-vs-sim comparisons noisy
+        evals, evecs = np.linalg.eig(flat.A.T)
+        stat = np.real(evecs[:, np.argmax(np.real(evals))])
+        stat = stat / stat.sum()
+        np.testing.assert_allclose(occ_flat, stat, atol=0.03)
+        np.testing.assert_allclose(occ_rec, stat, atol=0.03)
+
+
+class TestTreeToPosteriorRoundTrip:
+    """End-to-end: tree DSL → recursive engine data → NUTS fit of the
+    flat model → state recovery (the reference's simulate→fit→diagnose
+    loop, `tayal2009/main-sim.R`, with the tree as the generator)."""
+
+    def test_tayal_tree_fit_recovery(self):
+        import jax
+        import jax.numpy as jnp
+
+        from hhmm_tpu.hhmm import hhmm_sim, tayal_tree
+        from hhmm_tpu.infer import (
+            SamplerConfig,
+            apply_relabel,
+            greedy_relabel,
+            sample_nuts,
+        )
+
+        phi_true = np.array(
+            [
+                [0.5, 0.3, 0.2, 0, 0, 0, 0, 0, 0],
+                [0, 0, 0, 0.6, 0.3, 0.1, 0, 0, 0],
+                [0, 0, 0, 0.1, 0.3, 0.6, 0, 0, 0],
+                [0, 0, 0, 0, 0, 0, 0.2, 0.3, 0.5],
+            ]
+        )
+        tree = tayal_tree(0.5, 0.8, 0.65, phi_true)
+        z, x = hhmm_sim(tree, 2000, np.random.default_rng(0))
+        sign = np.where((z == 1) | (z == 2), 0, 1).astype(np.int32)
+        data = {"x": jnp.asarray(x.astype(np.int32)), "sign": jnp.asarray(sign)}
+
+        model = TayalHHMM(gate_mode="hard")
+        cfg = SamplerConfig(num_warmup=300, num_samples=300, num_chains=2)
+        init = jnp.stack(
+            [
+                model.init_unconstrained(k, data)
+                for k in jax.random.split(jax.random.PRNGKey(1), 2)
+            ]
+        )
+        qs, stats = sample_nuts(model.make_logp(data), jax.random.PRNGKey(2), init, cfg)
+        assert float(np.asarray(stats["diverging"]).mean()) < 0.05
+        gen = model.generated(qs.reshape(-1, qs.shape[-1])[::50], data)
+        alpha_med = np.median(np.asarray(gen["alpha"]), axis=0)
+        z_hat = np.argmax(alpha_med, axis=-1)
+        z_rel = apply_relabel(z_hat, greedy_relabel(z, z_hat, 4))
+        assert (z_rel == z).mean() > 0.9
+
+
+class TestGroups:
+    def test_depth2_groups(self):
+        tree = fine1998_tree()
+        g1 = leaf_groups(tree, depth=1)
+        # leaves in DFS order: p21 (under q21), then q22 subtree
+        assert g1[0] == 0
+        assert all(g == 1 for g in g1[1:])
