@@ -1,0 +1,2 @@
+"""Financial applications layer (SURVEY.md §2.6-2.7): the Hassan (2005)
+forecasting and Tayal (2009) trading replications."""

@@ -1,0 +1,18 @@
+"""Hassan (2005) application — IOHMM stock-close forecasting
+(SURVEY.md §2.6): dataset builder with scaling bookkeeping, the
+likelihood-neighbor forecaster, and the batched walk-forward harness."""
+
+from hhmm_tpu.apps.hassan.data import Dataset, make_dataset, simulate_ohlc
+from hhmm_tpu.apps.hassan.forecast import forecast_errors, neighbouring_forecast
+from hhmm_tpu.apps.hassan.wf import WFForecastResult, wf_forecast, DEFAULT_HYPERPARAMS
+
+__all__ = [
+    "Dataset",
+    "make_dataset",
+    "simulate_ohlc",
+    "forecast_errors",
+    "neighbouring_forecast",
+    "WFForecastResult",
+    "wf_forecast",
+    "DEFAULT_HYPERPARAMS",
+]

@@ -1,0 +1,46 @@
+"""Tayal (2009) application — high-frequency regime detection and
+trading (SURVEY.md §2.7): zig-zag feature extraction, the lite
+HHMM backtesting path, top-state mapping/labeling, trading rules,
+analytics, and the batched walk-forward harness."""
+
+from hhmm_tpu.apps.tayal.features import (
+    ZigZag,
+    extract_features,
+    to_model_inputs,
+    expand_to_ticks,
+)
+from hhmm_tpu.apps.tayal.trading import Trades, topstate_trading, buyandhold, equity_curve
+from hhmm_tpu.apps.tayal.analytics import (
+    TopRuns,
+    map_to_topstate,
+    topstate_runs,
+    relabel_by_return,
+    topstate_summary,
+)
+from hhmm_tpu.apps.tayal.pipeline import TayalWindowResult, run_window, classify_hard
+from hhmm_tpu.apps.tayal.simulate import simulate_ticks
+from hhmm_tpu.apps.tayal.wf import WFTask, WFResult, build_tasks, wf_trade
+
+__all__ = [
+    "ZigZag",
+    "extract_features",
+    "to_model_inputs",
+    "expand_to_ticks",
+    "Trades",
+    "topstate_trading",
+    "buyandhold",
+    "equity_curve",
+    "TopRuns",
+    "map_to_topstate",
+    "topstate_runs",
+    "relabel_by_return",
+    "topstate_summary",
+    "TayalWindowResult",
+    "run_window",
+    "classify_hard",
+    "simulate_ticks",
+    "WFTask",
+    "WFResult",
+    "build_tasks",
+    "wf_trade",
+]

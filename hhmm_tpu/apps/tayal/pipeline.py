@@ -1,0 +1,129 @@
+"""Single-window Tayal pipeline — the TPU equivalent of
+`tayal2009/main.R`: ticks → zig-zag features → fit the lite model
+(in-sample) → OOS filtering → hard classification by median filtered
+probability → top-state mapping → ex-post bear/bull labeling → trading.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hhmm_tpu.apps.tayal.analytics import (
+    TopRuns,
+    map_to_topstate,
+    relabel_by_return,
+    topstate_runs,
+    topstate_summary,
+)
+from hhmm_tpu.apps.tayal.features import ZigZag, extract_features, to_model_inputs
+from hhmm_tpu.apps.tayal.trading import Trades, buyandhold, topstate_trading
+from hhmm_tpu.infer import SamplerConfig, sample_nuts
+from hhmm_tpu.models import TayalHHMMLite
+
+__all__ = ["TayalWindowResult", "run_window", "classify_hard"]
+
+
+def classify_hard(alpha_draws: np.ndarray) -> np.ndarray:
+    """Hard states from the median filtered probability across draws
+    (`tayal2009/main.R:130-135`). ``alpha_draws`` is [..., T, K] with
+    leading draw axes."""
+    a = np.asarray(alpha_draws)
+    med = np.median(a.reshape(-1, *a.shape[-2:]), axis=0)  # [T, K]
+    return np.argmax(med, axis=-1)
+
+
+@dataclass
+class TayalWindowResult:
+    zig: ZigZag
+    n_ins_legs: int
+    samples: np.ndarray  # [chains, draws, dim]
+    stats: Dict[str, np.ndarray]
+    leg_state: np.ndarray  # hard bottom states, all legs
+    leg_topstate: np.ndarray  # bear/bull per leg (after ex-post relabel)
+    runs: TopRuns
+    summary: Dict[str, Dict[str, float]]
+    trades: Dict[int, Trades]  # per lag
+    bnh: np.ndarray  # buy-and-hold per-tick returns over the OOS span
+    swapped: bool
+
+
+def run_window(
+    price: np.ndarray,
+    size: np.ndarray,
+    t_seconds: np.ndarray,
+    ins_end_tick: int,
+    alpha: float = 0.25,
+    config: SamplerConfig = SamplerConfig(num_warmup=250, num_samples=250, num_chains=1),
+    key: Optional[jax.Array] = None,
+    gate_mode: str = "stan",
+    lags: Sequence[int] = (0, 1, 2, 3, 4, 5),
+) -> TayalWindowResult:
+    """Fit on legs ending at/before ``ins_end_tick``; filter the rest
+    out-of-sample; trade the OOS span (`tayal2009/main.R:62-235`)."""
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    zig = extract_features(price, size, t_seconds, alpha=alpha)
+    x, sign = to_model_inputs(zig.feature)
+    ins = zig.end <= ins_end_tick
+    n_ins = int(ins.sum())
+    if n_ins < 10 or n_ins == len(zig):
+        raise ValueError(f"degenerate in-sample split: {n_ins}/{len(zig)} legs")
+
+    model = TayalHHMMLite(gate_mode=gate_mode)
+    data = {
+        "x": jnp.asarray(x[:n_ins]),
+        "sign": jnp.asarray(sign[:n_ins]),
+        "x_oos": jnp.asarray(x[n_ins:]),
+        "sign_oos": jnp.asarray(sign[n_ins:]),
+    }
+    init = jnp.stack(
+        [
+            model.init_unconstrained(k, data)
+            for k in jax.random.split(jax.random.fold_in(key, 1), config.num_chains)
+        ]
+    )
+    qs, stats = sample_nuts(model.make_logp(data), key, init, config)
+
+    # thin draws for generated quantities (reference computes per draw)
+    flat = np.asarray(qs).reshape(-1, qs.shape[-1])
+    gen = model.generated(jnp.asarray(flat[:: max(1, len(flat) // 100)]), data)
+    state_ins = classify_hard(gen["alpha"])
+    state_oos = classify_hard(gen["alpha_oos"])
+    leg_state = np.concatenate([state_ins, state_oos])
+
+    leg_top = map_to_topstate(leg_state)
+    runs = topstate_runs(leg_top, zig.start, zig.end, np.asarray(price))
+    run_top, leg_top, swapped = relabel_by_return(runs, leg_top)
+    runs = TopRuns(
+        topstate=run_top, start=runs.start, end=runs.end, length=runs.length, ret=runs.ret
+    )
+    summary = topstate_summary(runs)
+
+    # trade the OOS span at tick resolution
+    from hhmm_tpu.apps.tayal.features import expand_to_ticks
+
+    T = len(price)
+    tick_top = expand_to_ticks(leg_top, zig, T)
+    oos_slice = slice(ins_end_tick + 1, T)
+    trades = {
+        lag: topstate_trading(price[oos_slice], tick_top[oos_slice], lag=lag)
+        for lag in lags
+    }
+    return TayalWindowResult(
+        zig=zig,
+        n_ins_legs=n_ins,
+        samples=np.asarray(qs),
+        stats={k: np.asarray(v) for k, v in stats.items()},
+        leg_state=leg_state,
+        leg_topstate=leg_top,
+        runs=runs,
+        summary=summary,
+        trades=trades,
+        bnh=buyandhold(price[oos_slice]),
+        swapped=swapped,
+    )

@@ -1,0 +1,194 @@
+"""Walk-forward trading harness — the TPU-batched equivalent of
+`tayal2009/R/wf-trade.R` + `tayal2009/test-strategy.R`.
+
+The reference builds ~204 (stock, 5-day-train + 1-day-trade) tasks and
+farms full MCMC refits to a 4-worker socket cluster; this is the
+BASELINE.json north-star workload. Here every task becomes one series in
+a single batched NUTS program (``fit_batched``): ragged leg sequences
+are padded+masked, fits run vmapped in chunks (sharded over a mesh when
+given), and the digest cache provides the same crash-recovery semantics
+as the reference's per-task RDS files (`wf-trade.R:86-109`). Labeling,
+trading, and analytics stay on host per task.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hhmm_tpu.apps.tayal.analytics import (
+    TopRuns,
+    map_to_topstate,
+    relabel_by_return,
+    topstate_runs,
+    topstate_summary,
+)
+from hhmm_tpu.apps.tayal.features import expand_to_ticks, extract_features, to_model_inputs
+from hhmm_tpu.apps.tayal.pipeline import classify_hard
+from hhmm_tpu.apps.tayal.trading import Trades, buyandhold, topstate_trading
+from hhmm_tpu.batch import fit_batched, pad_datasets
+from hhmm_tpu.infer import SamplerConfig
+from hhmm_tpu.models import TayalHHMMLite
+
+__all__ = ["WFTask", "WFResult", "build_tasks", "wf_trade"]
+
+
+@dataclass
+class WFTask:
+    """One (symbol, train-span, trade-span) window
+    (`test-strategy.R:44-54`)."""
+
+    symbol: str
+    window: int
+    price: np.ndarray
+    size: np.ndarray
+    t_seconds: np.ndarray
+    ins_end_tick: int
+
+
+@dataclass
+class WFResult:
+    symbol: str
+    window: int
+    trades: Dict[int, Trades]
+    bnh: np.ndarray
+    summary: Dict[str, Dict[str, float]]
+    leg_topstate: np.ndarray
+    n_ins_legs: int
+    diverged: float
+    swapped: bool
+
+
+def build_tasks(
+    days: Dict[str, List[Dict[str, np.ndarray]]],
+    train_days: int = 5,
+    trade_days: int = 1,
+) -> List[WFTask]:
+    """Rolling windows per symbol from per-day tick dicts with keys
+    ``price``/``size``/``t_seconds`` (`test-strategy.R:44-54`)."""
+    tasks = []
+    for symbol, day_list in days.items():
+        n_windows = len(day_list) - train_days - trade_days + 1
+        for w in range(max(0, n_windows)):
+            span = day_list[w : w + train_days + trade_days]
+            price = np.concatenate([d["price"] for d in span])
+            size = np.concatenate([d["size"] for d in span])
+            t = np.concatenate([d["t_seconds"] for d in span])
+            ins_ticks = sum(len(d["price"]) for d in span[:train_days])
+            tasks.append(
+                WFTask(
+                    symbol=symbol,
+                    window=w,
+                    price=price,
+                    size=size,
+                    t_seconds=t,
+                    ins_end_tick=ins_ticks - 1,
+                )
+            )
+    return tasks
+
+
+def wf_trade(
+    tasks: Sequence[WFTask],
+    config: SamplerConfig = SamplerConfig(num_warmup=250, num_samples=250, num_chains=1),
+    key: Optional[jax.Array] = None,
+    alpha: float = 0.25,
+    gate_mode: str = "stan",
+    lags: Sequence[int] = (0, 1, 2, 3, 4, 5),
+    chunk_size: int = 64,
+    mesh=None,
+    cache_dir: Optional[str] = None,
+) -> List[WFResult]:
+    """Run all tasks as one batched fit + per-task host post-processing
+    (`wf-trade.R:30-179`, minus the socket cluster)."""
+    if key is None:
+        key = jax.random.PRNGKey(0)
+
+    model = TayalHHMMLite(gate_mode=gate_mode)
+    feats, datasets = [], []
+    for task in tasks:
+        zig = extract_features(task.price, task.size, task.t_seconds, alpha=alpha)
+        x, sign = to_model_inputs(zig.feature)
+        ins = zig.end <= task.ins_end_tick
+        n_ins = int(ins.sum())
+        feats.append((zig, x, sign, n_ins))
+        datasets.append(
+            {
+                "x": x[:n_ins],
+                "sign": sign[:n_ins],
+                "x_oos": x[n_ins:],
+                "sign_oos": sign[n_ins:],
+            }
+        )
+
+    padded_ins = pad_datasets(
+        [{"x": d["x"], "sign": d["sign"]} for d in datasets], time_keys=["x", "sign"]
+    )
+    padded_oos = pad_datasets(
+        [{"x_oos": d["x_oos"], "sign_oos": d["sign_oos"]} for d in datasets],
+        time_keys=["x_oos", "sign_oos"],
+    )
+    data = {
+        "x": padded_ins["x"],
+        "sign": padded_ins["sign"],
+        "mask": padded_ins["mask"],
+        "x_oos": padded_oos["x_oos"],
+        "sign_oos": padded_oos["sign_oos"],
+        "mask_oos": padded_oos["mask"],
+    }
+    qs, stats = fit_batched(
+        model,
+        data,
+        key,
+        config,
+        chunk_size=chunk_size,
+        mesh=mesh,
+        cache_dir=cache_dir,
+    )
+
+    results = []
+    for i, (task, (zig, x, sign, n_ins)) in enumerate(zip(tasks, feats)):
+        flat = np.asarray(qs[i]).reshape(-1, qs.shape[-1])
+        per_task = {
+            "x": jnp.asarray(x[:n_ins]),
+            "sign": jnp.asarray(sign[:n_ins]),
+            "x_oos": jnp.asarray(x[n_ins:]),
+            "sign_oos": jnp.asarray(sign[n_ins:]),
+        }
+        gen = model.generated(jnp.asarray(flat[:: max(1, len(flat) // 100)]), per_task)
+        leg_state = np.concatenate(
+            [classify_hard(gen["alpha"]), classify_hard(gen["alpha_oos"])]
+        )
+        leg_top = map_to_topstate(leg_state)
+        runs = topstate_runs(leg_top, zig.start, zig.end, task.price)
+        run_top, leg_top, swapped = relabel_by_return(runs, leg_top)
+        runs = TopRuns(
+            topstate=run_top,
+            start=runs.start,
+            end=runs.end,
+            length=runs.length,
+            ret=runs.ret,
+        )
+        tick_top = expand_to_ticks(leg_top, zig, len(task.price))
+        oos = slice(task.ins_end_tick + 1, len(task.price))
+        results.append(
+            WFResult(
+                symbol=task.symbol,
+                window=task.window,
+                trades={
+                    lag: topstate_trading(task.price[oos], tick_top[oos], lag=lag)
+                    for lag in lags
+                },
+                bnh=buyandhold(task.price[oos]),
+                summary=topstate_summary(runs),
+                leg_topstate=leg_top,
+                n_ins_legs=n_ins,
+                diverged=float(np.asarray(stats["diverging"][i]).mean()),
+                swapped=swapped,
+            )
+        )
+    return results

@@ -1,0 +1,16 @@
+"""Batch layer: ragged padding, digest-keyed result cache, and
+vmapped/mesh-sharded batched NUTS (SURVEY.md §7.1 item 6) — the TPU
+replacement for the reference's doParallel clusters, RStan multi-chain
+forking, and RDS memoization (SURVEY.md §2.9)."""
+
+from hhmm_tpu.batch.pad import pad_ragged, pad_datasets
+from hhmm_tpu.batch.cache import digest_key, ResultCache
+from hhmm_tpu.batch.fit import fit_batched
+
+__all__ = [
+    "pad_ragged",
+    "pad_datasets",
+    "digest_key",
+    "ResultCache",
+    "fit_batched",
+]

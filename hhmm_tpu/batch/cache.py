@@ -1,0 +1,75 @@
+"""Digest-keyed on-disk result cache.
+
+Parity with the reference's RDS memoization, its only
+checkpoint/restart mechanism (SURVEY.md §5): every expensive fit is
+keyed by a hash of (model identity, data, sampler config, seed) and
+skipped on re-run — `tayal2009/main.R:91-112`,
+`tayal2009/R/wf-trade.R:86-109`, `hassan2005/R/wf-forecast.R:27-35`.
+A crashed batch rerun resumes where it stopped, task by task.
+
+Stored as ``.npz`` of posterior/stat arrays under a content-addressed
+filename; the digest covers raw data bytes, so any change to inputs,
+budget, or model config is a cache miss (same semantics as the
+reference's ``digest()`` of its inputs).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+__all__ = ["digest_key", "ResultCache"]
+
+
+def _update(h, obj) -> None:
+    if isinstance(obj, dict):
+        for k in sorted(obj):
+            h.update(str(k).encode())
+            _update(h, obj[k])
+    elif isinstance(obj, (list, tuple)):
+        for v in obj:
+            _update(h, v)
+    elif isinstance(obj, np.ndarray):
+        h.update(str(obj.dtype).encode() + str(obj.shape).encode())
+        h.update(np.ascontiguousarray(obj).tobytes())
+    elif hasattr(obj, "tolist"):  # jax arrays and numpy scalars
+        _update(h, np.asarray(obj))
+    else:
+        h.update(json.dumps(obj, sort_keys=True, default=str).encode())
+
+
+def digest_key(*parts: Any) -> str:
+    """SHA-256 over a nested structure of dicts/arrays/scalars."""
+    h = hashlib.sha256()
+    for p in parts:
+        _update(h, p)
+    return h.hexdigest()[:32]
+
+
+class ResultCache:
+    """``get``/``put`` of dicts of arrays keyed by a digest."""
+
+    def __init__(self, cache_dir: Optional[str]):
+        self.cache_dir = cache_dir
+        if cache_dir:
+            os.makedirs(cache_dir, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.cache_dir, f"{key}.npz")
+
+    def get(self, key: str) -> Optional[Dict[str, np.ndarray]]:
+        if not self.cache_dir or not os.path.exists(self._path(key)):
+            return None
+        with np.load(self._path(key), allow_pickle=False) as z:
+            return {k: z[k] for k in z.files}
+
+    def put(self, key: str, value: Dict[str, np.ndarray]) -> None:
+        if not self.cache_dir:
+            return
+        tmp = self._path(key) + ".tmp.npz"
+        np.savez(tmp, **{k: np.asarray(v) for k, v in value.items()})
+        os.replace(tmp, self._path(key))

@@ -1,0 +1,169 @@
+"""Batched NUTS over independent series — the TPU replacement for the
+reference's ``doParallel`` socket clusters and per-core RStan chains
+(SURVEY.md §2.9): ``vmap`` over series × chains inside one jitted
+program, dispatched in chunks, optionally sharded over a device mesh.
+
+Key design points:
+
+- **Chunked dispatch**: one compiled executable is reused across
+  sequential chunks of the series axis. This bounds single-execution
+  wall-clock (device tunnels/watchdogs kill very long XLA executions)
+  and doubles as the granularity of crash recovery via the digest cache
+  — exactly the role of the reference's per-task RDS files
+  (`tayal2009/R/wf-trade.R:86-109`).
+- **Mesh sharding**: pass a ``jax.sharding.Mesh`` with a ``"series"``
+  axis and each chunk is laid out across devices with
+  ``NamedSharding``; per-series work is embarrassingly parallel so the
+  only communication is the result gather (SURVEY.md §2.9).
+- **Warm starts**: ``init`` can be given explicitly — the walk-forward
+  harness passes the previous window's posterior, the idiomatic
+  improvement over Stan's cold restarts the reference calls out as its
+  pain point (`hassan2005/main.Rmd:795`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hhmm_tpu.batch.cache import ResultCache, digest_key
+from hhmm_tpu.infer.run import SamplerConfig, sample_nuts
+
+__all__ = ["fit_batched"]
+
+
+def _model_fingerprint(model) -> Dict[str, Any]:
+    """Stable identity of a model instance for cache keys."""
+    attrs = {
+        k: v
+        for k, v in sorted(vars(model).items())
+        if isinstance(v, (int, float, str, bool, tuple, list, np.ndarray))
+    }
+    return {"class": type(model).__name__, **attrs}
+
+
+def _default_init(model, data_b, n_series, n_chains, key):
+    init = []
+    for i in range(n_series):
+        per_series = {k: np.asarray(v[i]) for k, v in data_b.items() if v is not None}
+        # data-driven inits (k-means etc.) must not see padding: drop the
+        # masked tail from every time-axis array before calling the model
+        mask = per_series.pop("mask", None)
+        if mask is not None:
+            T = mask.shape[0]
+            valid = int(mask.sum())
+            per_series = {
+                k: v[:valid] if (np.ndim(v) >= 1 and np.shape(v)[0] == T) else v
+                for k, v in per_series.items()
+            }
+        chains = [
+            model.init_unconstrained(k, per_series)
+            for k in jax.random.split(jax.random.fold_in(key, i), n_chains)
+        ]
+        init.append(jnp.stack(chains))
+    return jnp.stack(init)  # [B, C, dim]
+
+
+def fit_batched(
+    model,
+    data: Dict[str, Any],
+    key: jax.Array,
+    config: SamplerConfig = SamplerConfig(),
+    init: Optional[jnp.ndarray] = None,
+    chunk_size: int = 64,
+    mesh: Optional[jax.sharding.Mesh] = None,
+    cache_dir: Optional[str] = None,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Fit ``model`` independently to every series in ``data``.
+
+    ``data``: dict of arrays with a leading series axis [B, ...]
+    (build with :func:`hhmm_tpu.batch.pad_datasets` for ragged series).
+    Returns ``(samples [B, chains, draws, dim], stats)`` with per-series
+    leading axes.
+    """
+    data = {k: jnp.asarray(v) for k, v in data.items() if v is not None}
+    sizes = {v.shape[0] for v in data.values()}
+    if len(sizes) != 1:
+        raise ValueError(f"inconsistent series-axis sizes: {sizes}")
+    B = sizes.pop()
+    C = config.num_chains
+    if init is None:
+        init = _default_init(model, data, B, C, key)
+    init = jnp.asarray(init)
+    if init.shape[:2] != (B, C):
+        raise ValueError(f"init must be [B={B}, chains={C}, dim], got {init.shape}")
+    keys = jax.random.split(key, B)
+
+    cache = ResultCache(cache_dir)
+    chunk = min(chunk_size, B)
+    if mesh is not None:
+        n_series_dev = mesh.shape["series"]
+        if chunk % n_series_dev != 0:
+            raise ValueError(
+                f"chunk_size {chunk} not divisible by mesh series axis {n_series_dev}"
+            )
+
+    data_keys = list(data.keys())
+
+    def run_chunk(chunk_data, chunk_init, chunk_keys):
+        def one(args):
+            per_series, qi, ki = args
+            logp = model.make_logp(per_series)
+            return sample_nuts(logp, ki, qi, config, jit=False)
+
+        return jax.vmap(lambda *xs: one((dict(zip(data_keys, xs[:-2])), xs[-2], xs[-1])))(
+            *[chunk_data[k] for k in data_keys], chunk_init, chunk_keys
+        )
+
+    run = jax.jit(run_chunk)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def shard(x):
+            return NamedSharding(mesh, P("series", *([None] * (x.ndim - 1))))
+
+        in_shardings = (
+            {k: shard(v[:chunk]) for k, v in data.items()},
+            shard(init[:chunk]),
+            shard(keys[:chunk]),
+        )
+        run = jax.jit(run_chunk, in_shardings=in_shardings)
+
+    qs_parts, stats_parts = [], []
+    for s in range(0, B, chunk):
+        sl = slice(s, min(s + chunk, B))
+        n = sl.stop - s
+        chunk_data = {k: v[sl] for k, v in data.items()}
+        chunk_init, chunk_keys = init[sl], keys[sl]
+        if n < chunk:  # ragged final chunk: pad by repeating the last series
+            reps = chunk - n
+            chunk_data = {
+                k: jnp.concatenate([v, jnp.repeat(v[-1:], reps, 0)]) for k, v in chunk_data.items()
+            }
+            chunk_init = jnp.concatenate([chunk_init, jnp.repeat(chunk_init[-1:], reps, 0)])
+            chunk_keys = jnp.concatenate([chunk_keys, jnp.repeat(chunk_keys[-1:], reps, 0)])
+
+        ck = digest_key(
+            _model_fingerprint(model),
+            {k: np.asarray(v) for k, v in chunk_data.items()},
+            vars(config),
+            np.asarray(chunk_keys),
+        )
+        hit = cache.get(ck)
+        if hit is not None:
+            qs = jnp.asarray(hit.pop("samples"))
+            stats = {k: jnp.asarray(v) for k, v in hit.items()}
+        else:
+            qs, stats = jax.block_until_ready(run(chunk_data, chunk_init, chunk_keys))
+            cache.put(ck, {"samples": np.asarray(qs), **{k: np.asarray(v) for k, v in stats.items()}})
+        qs_parts.append(qs[:n])
+        stats_parts.append({k: v[:n] for k, v in stats.items()})
+
+    samples = jnp.concatenate(qs_parts)
+    stats = {
+        k: jnp.concatenate([p[k] for p in stats_parts]) for k in stats_parts[0]
+    }
+    return samples, stats
