@@ -62,9 +62,11 @@ def wf_forecast(
     mesh=None,
     cache_dir: Optional[str] = None,
 ) -> WFForecastResult:
-    """``ohlc`` [T_total, 4]; steps s = 1..S with S = T_total − train_len
-    (step s trains through day train_len + s − 1 and forecasts day
-    train_len + s, h=1)."""
+    """``ohlc`` [T_total, 4]; steps s = 0..S−1 with S = T_total − train_len.
+    Step s trains on the prefix ``ohlc[: train_len + s]`` (last observed
+    close = day ``train_len + s − 1``) and forecasts day ``train_len + s``
+    (h=1), so ``actual[s] = close[train_len + s]`` is strictly out of
+    sample for every step."""
     if key is None:
         key = jax.random.PRNGKey(0)
     ohlc = np.asarray(ohlc, dtype=np.float64)
@@ -74,7 +76,7 @@ def wf_forecast(
 
     model = IOHMMHMixLite(K=K, M=4, L=L, hyperparams=hyperparams)
 
-    datasets = [make_dataset(ohlc[: train_len + s], scale=True) for s in range(1, S + 1)]
+    datasets = [make_dataset(ohlc[: train_len + s], scale=True) for s in range(S)]
     T_max = len(datasets[-1].x)
     x_pad = np.zeros((S, T_max))
     u_pad = np.zeros((S, T_max, 4))

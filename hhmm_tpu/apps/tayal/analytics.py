@@ -18,10 +18,15 @@ def map_to_topstate(state: np.ndarray, pairs=((0, 1), (2, 3))) -> np.ndarray:
     pairing {0,1}→bear, {2,3}→bull (the reference's 1-indexed {1,2} /
     {3,4})."""
     state = np.asarray(state)
-    out = np.empty_like(state)
+    out = np.full_like(state, np.iinfo(np.asarray(state).dtype).min)
     codes = (STATE_BEAR, STATE_BULL)
     for code, pair in zip(codes, pairs):
         out[np.isin(state, pair)] = code
+    unmapped = ~np.isin(state, np.concatenate([np.asarray(p) for p in pairs]))
+    if np.any(unmapped):
+        raise ValueError(
+            f"states {sorted(set(state[unmapped].tolist()))} not covered by pairs {pairs}"
+        )
     return out
 
 

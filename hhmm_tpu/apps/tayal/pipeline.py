@@ -25,7 +25,14 @@ from hhmm_tpu.apps.tayal.trading import Trades, buyandhold, topstate_trading
 from hhmm_tpu.infer import SamplerConfig, sample_nuts
 from hhmm_tpu.models import TayalHHMMLite
 
-__all__ = ["TayalWindowResult", "run_window", "classify_hard"]
+__all__ = [
+    "TayalWindowResult",
+    "run_window",
+    "classify_hard",
+    "decode_states",
+    "label_and_trade",
+    "LabeledWindow",
+]
 
 
 def classify_hard(alpha_draws: np.ndarray) -> np.ndarray:
@@ -35,6 +42,63 @@ def classify_hard(alpha_draws: np.ndarray) -> np.ndarray:
     a = np.asarray(alpha_draws)
     med = np.median(a.reshape(-1, *a.shape[-2:]), axis=0)  # [T, K]
     return np.argmax(med, axis=-1)
+
+
+def decode_states(model, samples: np.ndarray, data: Dict, n_thin: int = 100) -> np.ndarray:
+    """Posterior draws → hard bottom states over in-sample + OOS legs:
+    thin the flattened draws, run the model's generated pass, classify
+    by median filtered probability (`tayal2009/main.R:113-135`)."""
+    flat = np.asarray(samples).reshape(-1, np.asarray(samples).shape[-1])
+    gen = model.generated(jnp.asarray(flat[:: max(1, len(flat) // n_thin)]), data)
+    return np.concatenate(
+        [classify_hard(gen["alpha"]), classify_hard(gen["alpha_oos"])]
+    )
+
+
+@dataclass
+class LabeledWindow:
+    """Output of the shared labeling/trading chain."""
+
+    leg_topstate: np.ndarray
+    runs: TopRuns
+    summary: Dict[str, Dict[str, float]]
+    trades: Dict[int, Trades]
+    bnh: np.ndarray
+    swapped: bool
+
+
+def label_and_trade(
+    price: np.ndarray,
+    zig: ZigZag,
+    leg_state: np.ndarray,
+    ins_end_tick: int,
+    lags: Sequence[int],
+) -> LabeledWindow:
+    """Bottom states → top states → ex-post bear/bull relabel → tick
+    expansion → per-lag OOS trades + buy-and-hold
+    (`tayal2009/main.R:157-235`); shared by the single-window pipeline
+    and the walk-forward harness."""
+    from hhmm_tpu.apps.tayal.features import expand_to_ticks
+
+    price = np.asarray(price)
+    leg_top = map_to_topstate(leg_state)
+    runs = topstate_runs(leg_top, zig.start, zig.end, price)
+    run_top, leg_top, swapped = relabel_by_return(runs, leg_top)
+    runs = TopRuns(
+        topstate=run_top, start=runs.start, end=runs.end, length=runs.length, ret=runs.ret
+    )
+    tick_top = expand_to_ticks(leg_top, zig, len(price))
+    oos = slice(ins_end_tick + 1, len(price))
+    return LabeledWindow(
+        leg_topstate=leg_top,
+        runs=runs,
+        summary=topstate_summary(runs),
+        trades={
+            lag: topstate_trading(price[oos], tick_top[oos], lag=lag) for lag in lags
+        },
+        bnh=buyandhold(price[oos]),
+        swapped=swapped,
+    )
 
 
 @dataclass
@@ -90,40 +154,18 @@ def run_window(
     qs, stats = sample_nuts(model.make_logp(data), key, init, config)
 
     # thin draws for generated quantities (reference computes per draw)
-    flat = np.asarray(qs).reshape(-1, qs.shape[-1])
-    gen = model.generated(jnp.asarray(flat[:: max(1, len(flat) // 100)]), data)
-    state_ins = classify_hard(gen["alpha"])
-    state_oos = classify_hard(gen["alpha_oos"])
-    leg_state = np.concatenate([state_ins, state_oos])
-
-    leg_top = map_to_topstate(leg_state)
-    runs = topstate_runs(leg_top, zig.start, zig.end, np.asarray(price))
-    run_top, leg_top, swapped = relabel_by_return(runs, leg_top)
-    runs = TopRuns(
-        topstate=run_top, start=runs.start, end=runs.end, length=runs.length, ret=runs.ret
-    )
-    summary = topstate_summary(runs)
-
-    # trade the OOS span at tick resolution
-    from hhmm_tpu.apps.tayal.features import expand_to_ticks
-
-    T = len(price)
-    tick_top = expand_to_ticks(leg_top, zig, T)
-    oos_slice = slice(ins_end_tick + 1, T)
-    trades = {
-        lag: topstate_trading(price[oos_slice], tick_top[oos_slice], lag=lag)
-        for lag in lags
-    }
+    leg_state = decode_states(model, qs, data)
+    lw = label_and_trade(price, zig, leg_state, ins_end_tick, lags)
     return TayalWindowResult(
         zig=zig,
         n_ins_legs=n_ins,
         samples=np.asarray(qs),
         stats={k: np.asarray(v) for k, v in stats.items()},
         leg_state=leg_state,
-        leg_topstate=leg_top,
-        runs=runs,
-        summary=summary,
-        trades=trades,
-        bnh=buyandhold(price[oos_slice]),
-        swapped=swapped,
+        leg_topstate=lw.leg_topstate,
+        runs=lw.runs,
+        summary=lw.summary,
+        trades=lw.trades,
+        bnh=lw.bnh,
+        swapped=lw.swapped,
     )

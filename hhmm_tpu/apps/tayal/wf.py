@@ -20,16 +20,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from hhmm_tpu.apps.tayal.analytics import (
-    TopRuns,
-    map_to_topstate,
-    relabel_by_return,
-    topstate_runs,
-    topstate_summary,
-)
-from hhmm_tpu.apps.tayal.features import expand_to_ticks, extract_features, to_model_inputs
-from hhmm_tpu.apps.tayal.pipeline import classify_hard
-from hhmm_tpu.apps.tayal.trading import Trades, buyandhold, topstate_trading
+from hhmm_tpu.apps.tayal.features import extract_features, to_model_inputs
+from hhmm_tpu.apps.tayal.pipeline import decode_states, label_and_trade
+from hhmm_tpu.apps.tayal.trading import Trades
 from hhmm_tpu.batch import fit_batched, pad_datasets
 from hhmm_tpu.infer import SamplerConfig
 from hhmm_tpu.models import TayalHHMMLite
@@ -152,43 +145,25 @@ def wf_trade(
 
     results = []
     for i, (task, (zig, x, sign, n_ins)) in enumerate(zip(tasks, feats)):
-        flat = np.asarray(qs[i]).reshape(-1, qs.shape[-1])
         per_task = {
             "x": jnp.asarray(x[:n_ins]),
             "sign": jnp.asarray(sign[:n_ins]),
             "x_oos": jnp.asarray(x[n_ins:]),
             "sign_oos": jnp.asarray(sign[n_ins:]),
         }
-        gen = model.generated(jnp.asarray(flat[:: max(1, len(flat) // 100)]), per_task)
-        leg_state = np.concatenate(
-            [classify_hard(gen["alpha"]), classify_hard(gen["alpha_oos"])]
-        )
-        leg_top = map_to_topstate(leg_state)
-        runs = topstate_runs(leg_top, zig.start, zig.end, task.price)
-        run_top, leg_top, swapped = relabel_by_return(runs, leg_top)
-        runs = TopRuns(
-            topstate=run_top,
-            start=runs.start,
-            end=runs.end,
-            length=runs.length,
-            ret=runs.ret,
-        )
-        tick_top = expand_to_ticks(leg_top, zig, len(task.price))
-        oos = slice(task.ins_end_tick + 1, len(task.price))
+        leg_state = decode_states(model, qs[i], per_task)
+        lw = label_and_trade(task.price, zig, leg_state, task.ins_end_tick, lags)
         results.append(
             WFResult(
                 symbol=task.symbol,
                 window=task.window,
-                trades={
-                    lag: topstate_trading(task.price[oos], tick_top[oos], lag=lag)
-                    for lag in lags
-                },
-                bnh=buyandhold(task.price[oos]),
-                summary=topstate_summary(runs),
-                leg_topstate=leg_top,
+                trades=lw.trades,
+                bnh=lw.bnh,
+                summary=lw.summary,
+                leg_topstate=lw.leg_topstate,
                 n_ins_legs=n_ins,
                 diverged=float(np.asarray(stats["diverging"][i]).mean()),
-                swapped=swapped,
+                swapped=lw.swapped,
             )
         )
     return results

@@ -36,12 +36,16 @@ __all__ = ["fit_batched"]
 
 
 def _model_fingerprint(model) -> Dict[str, Any]:
-    """Stable identity of a model instance for cache keys."""
-    attrs = {
-        k: v
-        for k, v in sorted(vars(model).items())
-        if isinstance(v, (int, float, str, bool, tuple, list, np.ndarray))
-    }
+    """Stable identity of a model instance for cache keys. Array-valued
+    attributes (numpy or jax — e.g. ``IOHMMHMixLite.hyperparams``) are
+    included by value: dropping them would alias cache entries across
+    models that differ only in priors."""
+    attrs = {}
+    for k, v in sorted(vars(model).items()):
+        if isinstance(v, (int, float, str, bool, tuple, list)):
+            attrs[k] = v
+        elif isinstance(v, (np.ndarray, jnp.ndarray)):
+            attrs[k] = np.asarray(v)
     return {"class": type(model).__name__, **attrs}
 
 

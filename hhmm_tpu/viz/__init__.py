@@ -1,0 +1,48 @@
+"""Diagnostics/plot library — the observability surface of the framework
+(SURVEY.md §2.1, §5). Matplotlib equivalents of the reference's
+``common/R/plots.R`` (9 functions) and ``tayal2009/R/state-plots.R``
+(6 plot functions; ``topstate_summary`` lives in
+:mod:`hhmm_tpu.apps.tayal.analytics`).
+
+Every function takes plain numpy arrays, draws on a freshly created (or
+caller-supplied) figure and returns the :class:`matplotlib.figure.Figure`
+— no global device state, unlike the base-R originals.
+"""
+
+from hhmm_tpu.viz.plots import (
+    plot_intervals,
+    plot_seqintervals,
+    plot_inputoutput,
+    plot_inputprob,
+    plot_stateprobability,
+    plot_statepath,
+    plot_outputfit,
+    plot_inputoutputprob,
+    plot_seqforecast,
+)
+from hhmm_tpu.viz.state_plots import (
+    plot_features,
+    plot_topstate_hist,
+    plot_topstate_seq,
+    plot_topstate_seqv,
+    plot_topstate_features,
+    plot_topstate_trading,
+)
+
+__all__ = [
+    "plot_intervals",
+    "plot_seqintervals",
+    "plot_inputoutput",
+    "plot_inputprob",
+    "plot_stateprobability",
+    "plot_statepath",
+    "plot_outputfit",
+    "plot_inputoutputprob",
+    "plot_seqforecast",
+    "plot_features",
+    "plot_topstate_hist",
+    "plot_topstate_seq",
+    "plot_topstate_seqv",
+    "plot_topstate_features",
+    "plot_topstate_trading",
+]

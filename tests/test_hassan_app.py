@@ -86,7 +86,14 @@ class TestWalkForward:
         from hhmm_tpu.infer import SamplerConfig
 
         rng = np.random.default_rng(5)
-        ohlc = simulate_ohlc(rng, T=120, vol=0.01)
+        # a trending series: over the 10 OOS days the level moves far
+        # more than the per-day noise, so a forecaster that tracks the
+        # level must get high R² vs the constant-mean baseline (matching
+        # the regime of the reference's real-close experiments, where
+        # R² ≈ 0.87-0.94 comes from trending price levels)
+        ohlc = simulate_ohlc(
+            rng, T=120, vol=0.008, regimes=1, drift_spread=-0.02
+        )
         res = wf_forecast(
             ohlc,
             train_len=110,
@@ -104,5 +111,10 @@ class TestWalkForward:
         assert res.diverged.mean() < 0.2
         # forecasts stay in a sane band around the realized closes
         assert res.errors["mape"] < 10.0
-        # daily closes are highly persistent: R2 must be high
+        # every forecast must be strictly out of sample: the anchor
+        # close (last training obs) differs from the realized target
+        anchors = ohlc[109:119, 3]
+        assert not np.allclose(res.actual, anchors)
+        # the level moves ~20% over the OOS span: tracking it beats the
+        # constant-mean baseline decisively
         assert res.errors["r2"] > 0.5

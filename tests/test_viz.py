@@ -1,0 +1,169 @@
+"""Smoke tests for the plot library (SURVEY.md §2.1: the reference's
+`common/R/plots.R` and `tayal2009/R/state-plots.R` surfaces). Each plot
+must build a Figure with the expected panel count on realistic inputs
+and close cleanly — no rendering golden-files, matching the reference's
+own (untested) plotting discipline."""
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+import numpy as np
+import pytest
+
+from hhmm_tpu import viz
+from hhmm_tpu.apps.tayal import (
+    extract_features,
+    map_to_topstate,
+    simulate_ticks,
+    topstate_trading,
+    expand_to_ticks,
+)
+
+
+@pytest.fixture(autouse=True)
+def _close_all():
+    yield
+    plt.close("all")
+
+
+@pytest.fixture(scope="module")
+def tick_data():
+    rng = np.random.default_rng(3)
+    price, size, tsec, leg_regime = simulate_ticks(rng, n_legs=120)
+    zig = extract_features(price, size, tsec)
+    return price, size, tsec, zig
+
+
+def _bands(mid):
+    return np.stack([mid - 1.0, mid, mid + 1.0])
+
+
+class TestCommonPlots:
+    def test_intervals(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=100)
+        fig = viz.plot_intervals(x, _bands(3 * x), z=(x > 0).astype(int))
+        assert len(fig.axes) == 1
+
+    def test_intervals_bad_bands(self):
+        with pytest.raises(ValueError):
+            viz.plot_intervals(np.zeros(5), np.zeros((2, 5)))
+
+    def test_seqintervals(self):
+        mid = np.sin(np.linspace(0, 6, 80))
+        z = (mid > 0).astype(int)
+        fig = viz.plot_seqintervals(_bands(mid), z=z, k=1)
+        assert len(fig.axes) == 1
+
+    def test_seqintervals_requires_k(self):
+        with pytest.raises(ValueError):
+            viz.plot_seqintervals(_bands(np.zeros(10)), z=np.zeros(10, int))
+
+    def test_inputoutput(self):
+        rng = np.random.default_rng(1)
+        T, M = 60, 3
+        u = rng.normal(size=(T, M))
+        x = u @ rng.normal(size=M)
+        fig = viz.plot_inputoutput(x, u, z=rng.integers(0, 2, T))
+        assert len(fig.axes) == 2 * (M + 1)
+
+    def test_inputprob(self):
+        rng = np.random.default_rng(2)
+        T, M, K = 50, 2, 3
+        p = rng.dirichlet(np.ones(K), size=T)
+        fig = viz.plot_inputprob(rng.normal(size=(T, M)), p)
+        assert len(fig.axes) == M * K
+
+    def test_stateprobability(self):
+        rng = np.random.default_rng(3)
+        N, T, K = 20, 40, 2
+        alpha = rng.dirichlet(np.ones(K), size=(N, T))
+        gamma = rng.dirichlet(np.ones(K), size=(N, T))
+        fig = viz.plot_stateprobability(alpha, gamma, z=rng.integers(0, K, T))
+        assert len(fig.axes) == 3
+
+    def test_statepath(self):
+        rng = np.random.default_rng(4)
+        zstar = rng.integers(0, 3, size=(25, 50))
+        fig = viz.plot_statepath(zstar, z=zstar[0])
+        assert len(fig.axes) == 2
+
+    def test_outputfit(self):
+        rng = np.random.default_rng(5)
+        T = 60
+        x = np.cumsum(rng.normal(size=T))
+        xhat = x + rng.normal(scale=0.3, size=(30, T))
+        fig = viz.plot_outputfit(x, xhat, z=(x > 0).astype(int), K=2)
+        assert len(fig.axes) == 1
+
+    def test_inputoutputprob(self):
+        rng = np.random.default_rng(6)
+        N, T, M, K = 15, 40, 2, 3
+        fig = viz.plot_inputoutputprob(
+            rng.normal(size=T),
+            rng.normal(size=(T, M)),
+            rng.dirichlet(np.ones(K), size=(N, T)),
+            rng.integers(0, K, size=(N, T)),
+        )
+        assert len(fig.axes) == M + 3
+
+    def test_inputoutputprob_length_mismatch(self):
+        rng = np.random.default_rng(7)
+        with pytest.raises(ValueError):
+            viz.plot_inputoutputprob(
+                rng.normal(size=10),
+                rng.normal(size=(10, 2)),
+                rng.dirichlet(np.ones(2), size=(5, 12)),
+                rng.integers(0, 2, size=(5, 12)),
+            )
+
+    def test_seqforecast(self):
+        rng = np.random.default_rng(8)
+        y = np.cumsum(rng.normal(size=50)) + 30
+        point = y[-1] + np.arange(1, 6) * 0.1
+        fig = viz.plot_seqforecast(y, np.stack([point - 1, point, point + 1]))
+        assert len(fig.axes) == 1
+
+
+class TestTayalPlots:
+    def test_features(self, tick_data):
+        price, size, _, zig = tick_data
+        for which in ("actual", "extrema", "trend", "all"):
+            fig = viz.plot_features(price, size, zig, which=which)
+            assert len(fig.axes) == 2
+
+    def test_topstate_hist(self, tick_data):
+        price, _, _, zig = tick_data
+        rng = np.random.default_rng(0)
+        top = map_to_topstate(rng.integers(0, 4, size=len(zig)))
+        leg_ret = np.diff(price[zig.end], prepend=price[zig.start[0]])
+        fig = viz.plot_topstate_hist(leg_ret, top)
+        assert len(fig.axes) == 2
+
+    def test_topstate_seq_and_seqv(self, tick_data):
+        price, _, _, zig = tick_data
+        rng = np.random.default_rng(1)
+        leg_top = map_to_topstate(rng.integers(0, 4, size=len(zig)))
+        tick_top = expand_to_ticks(leg_top, zig, price.size)
+        assert len(viz.plot_topstate_seq(price, tick_top).axes) == 1
+        assert len(viz.plot_topstate_seqv(price, zig, leg_top).axes) == 2
+
+    def test_topstate_features(self, tick_data):
+        _, _, _, zig = tick_data
+        rng = np.random.default_rng(2)
+        leg_top = map_to_topstate(rng.integers(0, 4, size=len(zig)))
+        fig = viz.plot_topstate_features(zig.feature, leg_top, L=18)
+        assert len(fig.axes) == 1
+
+    def test_topstate_trading(self, tick_data):
+        price, _, _, zig = tick_data
+        rng = np.random.default_rng(3)
+        leg_top = map_to_topstate(rng.integers(0, 4, size=len(zig)))
+        tick_top = expand_to_ticks(leg_top, zig, price.size)
+        trades = {
+            f"lag {lag}": topstate_trading(price, tick_top, lag=lag)
+            for lag in (0, 1)
+        }
+        fig = viz.plot_topstate_trading(price, tick_top, trades)
+        assert len(fig.axes) == 2
