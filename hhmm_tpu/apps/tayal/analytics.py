@@ -18,8 +18,10 @@ def map_to_topstate(state: np.ndarray, pairs=((0, 1), (2, 3))) -> np.ndarray:
     pairing {0,1}→bear, {2,3}→bull (the reference's 1-indexed {1,2} /
     {3,4})."""
     state = np.asarray(state)
-    out = np.full_like(state, np.iinfo(np.asarray(state).dtype).min)
+    out = np.full(state.shape, np.iinfo(np.int64).min, dtype=np.int64)
     codes = (STATE_BEAR, STATE_BULL)
+    if len(pairs) != len(codes):
+        raise ValueError(f"need exactly {len(codes)} state pairs, got {len(pairs)}")
     for code, pair in zip(codes, pairs):
         out[np.isin(state, pair)] = code
     unmapped = ~np.isin(state, np.concatenate([np.asarray(p) for p in pairs]))

@@ -20,7 +20,12 @@ from hhmm_tpu.apps.tayal.analytics import (
     topstate_runs,
     topstate_summary,
 )
-from hhmm_tpu.apps.tayal.features import ZigZag, extract_features, to_model_inputs
+from hhmm_tpu.apps.tayal.features import (
+    ZigZag,
+    expand_to_ticks,
+    extract_features,
+    to_model_inputs,
+)
 from hhmm_tpu.apps.tayal.trading import Trades, buyandhold, topstate_trading
 from hhmm_tpu.infer import SamplerConfig, sample_nuts
 from hhmm_tpu.models import TayalHHMMLite
@@ -78,8 +83,6 @@ def label_and_trade(
     expansion → per-lag OOS trades + buy-and-hold
     (`tayal2009/main.R:157-235`); shared by the single-window pipeline
     and the walk-forward harness."""
-    from hhmm_tpu.apps.tayal.features import expand_to_ticks
-
     price = np.asarray(price)
     leg_top = map_to_topstate(leg_state)
     runs = topstate_runs(leg_top, zig.start, zig.end, price)

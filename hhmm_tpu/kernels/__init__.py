@@ -6,6 +6,7 @@ from hhmm_tpu.kernels.filtering import (
 )
 from hhmm_tpu.kernels.viterbi import viterbi
 from hhmm_tpu.kernels.ffbs import ffbs_sample
+from hhmm_tpu.kernels.grad import forward_loglik
 
 __all__ = [
     "forward_filter",
@@ -14,4 +15,5 @@ __all__ = [
     "forward_backward",
     "viterbi",
     "ffbs_sample",
+    "forward_loglik",
 ]

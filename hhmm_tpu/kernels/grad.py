@@ -1,0 +1,96 @@
+"""Forward log-likelihood with an analytic (forward-backward) VJP.
+
+The forward recursion is the HMC target — it is re-evaluated and
+differentiated at every NUTS leapfrog step (the reference's hot loop:
+Stan autodiff through `hmm/stan/hmm.stan:27-46` at every leapfrog).
+Reverse-mode through a ``lax.scan`` makes XLA store every carry and
+replay T logsumexp steps backward through the chain rule. But the
+gradient of the marginal log-likelihood has a closed form in terms of
+the posterior state marginals — the classical Baum-Welch identities:
+
+- ``d loglik / d log_obs[t, j]  = gamma[t, j]``  (smoothed marginal),
+- ``d loglik / d log_pi[j]      = gamma[0, j]``,
+- ``d loglik / d log_A[i, j]    = sum_t xi[t, i, j]``  (expected
+  transition counts), with per-slice ``xi`` for time-varying ``log_A``,
+
+where ``xi[t, i, j] = exp(alpha[t-1, i] + A[i, j] + obs[t, j]
++ beta[t, j] - loglik)``. These identities are purely algebraic
+consequences of the recursion — they hold for arbitrary real matrices,
+including the unit-factor (0.0) and ``-inf``-masked entries produced by
+the Tayal sign gating and the semi-supervised group gating, so one VJP
+serves the whole model zoo.
+
+The custom VJP computes the backward pass once per gradient instead of
+replaying the chain rule step-by-step, vmaps cleanly over series /
+chains / windows, and frees XLA from keeping scan-residual logsumexp
+intermediates (only ``log_alpha`` is saved).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from hhmm_tpu.kernels.filtering import backward_pass, forward_filter
+
+__all__ = ["forward_loglik"]
+
+
+@jax.custom_vjp
+def _forward_loglik(log_pi, log_A, log_obs, mask):
+    _, ll = forward_filter(log_pi, log_A, log_obs, mask)
+    return ll
+
+
+def _fwd(log_pi, log_A, log_obs, mask):
+    log_alpha, ll = forward_filter(log_pi, log_A, log_obs, mask)
+    return ll, (log_pi, log_A, log_obs, mask, log_alpha, ll)
+
+
+def _bwd(res, g):
+    log_pi, log_A, log_obs, mask, log_alpha, ll = res
+    log_beta = backward_pass(log_A, log_obs, mask)
+
+    # Smoothed marginals; masked (padding) steps carry copied alpha/beta,
+    # so their would-be gamma is the last valid filter — zero it out.
+    gamma = jnp.exp(log_alpha + log_beta - ll) * mask[:, None]
+    d_obs = g * gamma
+
+    # alpha[0] = log_pi + obs[0] (or log_pi alone when step 0 is masked),
+    # so the pi cotangent is gamma at t=0 either way — except that with
+    # mask[0] == 0 the gamma above was zeroed; recompute from the carry.
+    gamma0 = jnp.exp(log_alpha[0] + log_beta[0] - ll)
+    d_pi = g * gamma0
+
+    # Expected transition counts. log_A is [K,K] (homogeneous; summed
+    # over t) or [T-1,K,K] (time-varying; per-slice).
+    lA = log_A if log_A.ndim == 3 else log_A[None]
+    xi = jnp.exp(
+        log_alpha[:-1, :, None]
+        + lA
+        + (log_obs[1:] + log_beta[1:])[:, None, :]
+        - ll
+    ) * mask[1:, None, None]
+    d_A = g * (xi if log_A.ndim == 3 else xi.sum(axis=0))
+
+    return d_pi, d_A, d_obs, jnp.zeros_like(mask)
+
+
+_forward_loglik.defvjp(_fwd, _bwd)
+
+
+def forward_loglik(
+    log_pi: jnp.ndarray,
+    log_A: jnp.ndarray,
+    log_obs: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Marginal log-likelihood ``logsumexp(alpha[T-1])`` with the analytic
+    forward-backward VJP. Same contract as
+    :func:`hhmm_tpu.kernels.filtering.forward_filter` (homogeneous or
+    time-varying ``log_A``, optional ragged-padding ``mask``)."""
+    if mask is None:
+        mask = jnp.ones(log_obs.shape[:1], log_obs.dtype)
+    return _forward_loglik(log_pi, log_A, log_obs, mask)

@@ -27,7 +27,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from hhmm_tpu.core.bijectors import Bijector
-from hhmm_tpu.kernels import forward_filter, backward_pass, smooth, viterbi
+from hhmm_tpu.kernels import (
+    forward_filter,
+    forward_loglik,
+    backward_pass,
+    smooth,
+    viterbi,
+)
 
 __all__ = ["BaseHMMModel"]
 
@@ -71,9 +77,11 @@ class BaseHMMModel:
         return jnp.concatenate([jnp.atleast_1d(p) for p in parts])
 
     def loglik(self, params: Dict[str, jnp.ndarray], data: Data) -> jnp.ndarray:
+        # forward_loglik carries the analytic forward-backward VJP — the
+        # NUTS leapfrog gradient costs one backward pass instead of an
+        # XLA replay of the whole scan (kernels/grad.py).
         log_pi, log_A, log_obs, mask = self.build(params, data)
-        _, ll = forward_filter(log_pi, log_A, log_obs, mask)
-        return ll
+        return forward_loglik(log_pi, log_A, log_obs, mask)
 
     def make_logp(self, data: Data) -> Callable[[jnp.ndarray], jnp.ndarray]:
         """The NUTS target on the unconstrained space (Stan's lp__)."""

@@ -21,9 +21,6 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-import matplotlib
-
-matplotlib.use("Agg", force=False)
 import matplotlib.pyplot as plt
 
 _STATE_CMAP = plt.get_cmap("tab10")
@@ -257,7 +254,6 @@ def plot_outputfit(
     xhat: np.ndarray,
     interval: float = 0.8,
     z: Optional[np.ndarray] = None,
-    K: Optional[int] = None,
 ):
     """Observed series with posterior-predictive fitted outputs (median
     dots colored by state + quantile band) (`common/R/plots.R:383-431`).

@@ -14,9 +14,7 @@ from typing import Dict, Optional, Sequence
 
 import numpy as np
 
-import matplotlib
-
-matplotlib.use("Agg", force=False)
+import matplotlib.collections
 import matplotlib.pyplot as plt
 
 from hhmm_tpu.apps.tayal.constants import STATE_BEAR, STATE_BULL
@@ -46,7 +44,6 @@ def _leg_segments(ax, price: np.ndarray, zig, leg_color, lw=1.0):
 
 def plot_features(
     price: np.ndarray,
-    size: np.ndarray,
     zig,
     which: str = "all",
 ):

@@ -94,7 +94,7 @@ class TestCommonPlots:
         T = 60
         x = np.cumsum(rng.normal(size=T))
         xhat = x + rng.normal(scale=0.3, size=(30, T))
-        fig = viz.plot_outputfit(x, xhat, z=(x > 0).astype(int), K=2)
+        fig = viz.plot_outputfit(x, xhat, z=(x > 0).astype(int))
         assert len(fig.axes) == 1
 
     def test_inputoutputprob(self):
@@ -130,7 +130,7 @@ class TestTayalPlots:
     def test_features(self, tick_data):
         price, size, _, zig = tick_data
         for which in ("actual", "extrema", "trend", "all"):
-            fig = viz.plot_features(price, size, zig, which=which)
+            fig = viz.plot_features(price, zig, which=which)
             assert len(fig.axes) == 2
 
     def test_topstate_hist(self, tick_data):
