@@ -42,7 +42,7 @@ def main() -> None:
     ap.add_argument(
         "--chunk",
         type=int,
-        default=64,
+        default=128,
         help="series per XLA execution; the device tunnel kills executions "
         "running longer than a few minutes, so the 256-series batch is "
         "dispatched as sequential chunks (throughput is unaffected: each "
@@ -82,8 +82,10 @@ def main() -> None:
 
     def run_chunk(x, sign, init, keys):
         def one(xi, si, qi, ki):
-            logp = model.make_logp({"x": xi, "sign": si})
-            qs, stats = sample_nuts(logp, ki, qi, cfg, jit=False)
+            # fused value-and-grad hot loop: Pallas TPU kernel under the
+            # series x chains vmap (kernels/vg.py)
+            vg = model.make_vg({"x": xi, "sign": si})
+            qs, stats = sample_nuts(None, ki, qi, cfg, jit=False, vg_fn=vg)
             return qs, stats["logp"], stats["diverging"]
 
         return jax.vmap(one)(x, sign, init, keys)

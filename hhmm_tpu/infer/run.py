@@ -124,6 +124,7 @@ def _welford_variance(s: _Welford):
 
 def _single_chain(
     logp_fn,
+    vg_fn,
     key,
     q0,
     num_warmup,
@@ -136,10 +137,7 @@ def _single_chain(
     dtype = q0.dtype
     update_mass, window_end = warmup_schedule(num_warmup)
 
-    value_and_grad = jax.value_and_grad(lambda q: logp_fn(q))
-
-    def lp(q):
-        return value_and_grad(q)
+    lp = vg_fn if vg_fn is not None else jax.value_and_grad(lambda q: logp_fn(q))
 
     logp0, grad0 = lp(q0)
     key, key_eps = jax.random.split(key)
@@ -215,16 +213,23 @@ def _single_chain(
 
 
 def sample_nuts(
-    logp_fn: Callable[[jnp.ndarray], jnp.ndarray],
+    logp_fn: Optional[Callable[[jnp.ndarray], jnp.ndarray]],
     key: jax.Array,
     init_q: jnp.ndarray,
     config: SamplerConfig = SamplerConfig(),
     jit: bool = True,
+    vg_fn: Optional[Callable] = None,
 ):
     """Run NUTS. ``init_q`` is [dim] (broadcast to chains) or [chains, dim].
 
+    ``vg_fn``, if given, is a fused ``q -> (logp, grad)`` (e.g.
+    ``model.make_vg(data)`` — the Pallas-accelerated hot loop) and takes
+    precedence over ``logp_fn``.
+
     Returns ``(samples [chains, num_samples, dim], stats dict)``.
     """
+    if logp_fn is None and vg_fn is None:
+        raise ValueError("need logp_fn or vg_fn")
     C = config.num_chains
     init_q = jnp.atleast_2d(jnp.asarray(init_q))
     if init_q.shape[0] == 1 and C > 1:
@@ -236,6 +241,7 @@ def sample_nuts(
     run = partial(
         _single_chain,
         logp_fn,
+        vg_fn,
         num_warmup=config.num_warmup,
         num_samples=config.num_samples,
         max_treedepth=config.max_treedepth,

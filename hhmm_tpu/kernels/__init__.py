@@ -7,8 +7,11 @@ from hhmm_tpu.kernels.filtering import (
 from hhmm_tpu.kernels.viterbi import viterbi
 from hhmm_tpu.kernels.ffbs import ffbs_sample
 from hhmm_tpu.kernels.grad import forward_loglik
+from hhmm_tpu.kernels.assoc import forward_filter_assoc, forward_filter_seqshard
 
 __all__ = [
+    "forward_filter_assoc",
+    "forward_filter_seqshard",
     "forward_filter",
     "backward_pass",
     "smooth",

@@ -1,0 +1,164 @@
+"""Long-sequence forward filtering: associative scan + sequence sharding.
+
+The reference's recursions are strictly sequential ``for (t in 2:T)``
+Stan loops (`hmm/stan/hmm.stan:32`, SURVEY.md §5). In log-space the
+forward recursion is a product in the (logsumexp, +) matrix semiring:
+
+    alpha_t = alpha_{t-1} (x) M_t,   M_t[i, j] = log_A[i, j] + log_obs[t, j]
+
+with ``(P (x) Q)[i, j] = logsumexp_k(P[i, k] + Q[k, j])``. Matrix
+products are associative, so the whole filter is a prefix-product scan:
+
+- :func:`forward_filter_assoc` uses ``jax.lax.associative_scan`` —
+  O(K^3 log T) work at O(log T) depth instead of a T-step dependency
+  chain. Worthwhile exactly when K is small (K<=4 here: a per-step
+  operand is 16 floats) and T is long — the zig-zag windows.
+- :func:`forward_filter_seqshard` shards the time axis over a mesh axis
+  (``shard_map``): each device prefix-scans its local chunk, the
+  per-chunk total operators are combined across devices with one
+  ``all_gather`` over ICI, and local prefixes are corrected by the
+  exclusive cross-device product. This is the sequence-parallelism
+  analog for scan models (ring-attention's role for attention,
+  SURVEY.md §5) and composes with batch sharding on an orthogonal mesh
+  axis.
+
+Masked (padding) steps are semiring identities (0 diagonal, -inf off),
+reproducing the carry-copy semantics of the sequential kernel, so both
+variants accept the same ragged-batch masks.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from hhmm_tpu.core.lmath import logsumexp, log_vecmat
+
+__all__ = ["forward_filter_assoc", "forward_filter_seqshard"]
+
+
+def _semiring_matmul(Pm: jnp.ndarray, Qm: jnp.ndarray) -> jnp.ndarray:
+    """(P (x) Q)[..., i, j] = logsumexp_k(P[..., i, k] + Q[..., k, j])."""
+    return logsumexp(Pm[..., :, :, None] + Qm[..., None, :, :], axis=-2)
+
+
+def _semiring_eye(K: int, dtype) -> jnp.ndarray:
+    return jnp.where(jnp.eye(K, dtype=bool), 0.0, -jnp.inf).astype(dtype)
+
+
+def _alpha0(log_pi, log_obs0, mask0):
+    a0 = log_pi + log_obs0
+    if mask0 is not None:
+        a0 = jnp.where(mask0 > 0, a0, log_pi)
+    return a0
+
+
+def forward_filter_assoc(
+    log_pi: jnp.ndarray,
+    log_A: jnp.ndarray,
+    log_obs: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Same contract and outputs as
+    :func:`hhmm_tpu.kernels.filtering.forward_filter` (homogeneous or
+    time-varying ``log_A``, optional mask), computed by an
+    O(log T)-depth associative prefix scan."""
+    T, K = log_obs.shape
+    if log_A.ndim == 3 and log_A.shape[0] != T - 1:
+        raise ValueError(
+            f"time-varying log_A must have T-1={T - 1} slices, got {log_A.shape[0]}"
+        )
+    a0 = _alpha0(log_pi, log_obs[0], None if mask is None else mask[0])
+    if T == 1:
+        return a0[None], logsumexp(a0)
+
+    lA = log_A if log_A.ndim == 3 else jnp.broadcast_to(log_A, (T - 1, K, K))
+    M = lA + log_obs[1:, None, :]
+    if mask is not None:
+        M = jnp.where(mask[1:, None, None] > 0, M, _semiring_eye(K, log_obs.dtype)[None])
+    prefix = lax.associative_scan(_semiring_matmul, M, axis=0)  # [T-1, K, K]
+    alpha_rest = log_vecmat(a0, prefix)
+    log_alpha = jnp.concatenate([a0[None], alpha_rest], axis=0)
+    return log_alpha, logsumexp(log_alpha[-1])
+
+
+def _seqshard_body(axis_name, log_pi, log_A, log_obs, mask):
+    """Per-device body. ``log_obs``/``mask`` are the local time chunk;
+    ``log_pi``/``log_A`` replicated.
+
+    Uniform chunk algebra: the filter is ``alpha_t = a0 (x) M_1 ... M_t``.
+    Chunk d owns operators M_t for its local time range; the global M_0
+    does not exist, so device 0's first operator is the semiring
+    identity. Then every device's carry-in is ``a0 (x) excl`` where
+    ``excl`` is the product of all previous chunks' totals.
+    """
+    d = lax.axis_index(axis_name)
+    D = lax.axis_size(axis_name)
+    Tl, K = log_obs.shape
+    eye = _semiring_eye(K, log_obs.dtype)
+
+    M = log_A[None] + log_obs[:, None, :]  # [Tl, K, K]
+    M = jnp.where(mask[:, None, None] > 0, M, eye[None])
+    # device 0: global M_0 doesn't exist — replace with identity
+    M = M.at[0].set(jnp.where(d == 0, eye, M[0]))
+
+    prefix = lax.associative_scan(_semiring_matmul, M, axis=0)  # [Tl, K, K]
+    totals = lax.all_gather(prefix[-1], axis_name)  # [D, K, K]
+
+    def fold(carry, i):
+        return jnp.where(i < d, _semiring_matmul(carry, totals[i]), carry), None
+
+    # the fold result varies per device (depends on d) — mark the init so
+    eye_v = lax.pcast(eye, (axis_name,), to="varying")
+    excl, _ = lax.scan(fold, eye_v, jnp.arange(D))
+
+    # a0 lives on device 0 (needs global obs[0]/mask[0]); broadcast by
+    # summing a zero contribution from every other device.
+    a0_local = _alpha0(log_pi, log_obs[0], mask[0])
+    a0 = lax.psum(jnp.where(d == 0, a0_local, jnp.zeros_like(a0_local)), axis_name)
+
+    carry_in = log_vecmat(a0, excl)
+    log_alpha = log_vecmat(carry_in, prefix)  # [Tl, K]
+
+    ll_local = logsumexp(log_alpha[-1])
+    ll = lax.psum(jnp.where(d == D - 1, ll_local, 0.0), axis_name)
+    return log_alpha, ll
+
+
+def forward_filter_seqshard(
+    log_pi: jnp.ndarray,
+    log_A: jnp.ndarray,
+    log_obs: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+    *,
+    mesh: Mesh,
+    axis_name: str = "sp",
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Sequence-parallel forward filter: the time axes of ``log_obs`` and
+    ``mask`` are sharded over ``axis_name`` of ``mesh``; returns
+    (time-sharded ``log_alpha`` [T, K], replicated ``loglik``). T must
+    divide evenly by the axis size. Homogeneous ``log_A`` only — the
+    time-varying IOHMM case has T-1 operator slices that misalign with
+    T-length chunks; shard the batch axis instead (SURVEY.md §2.9:
+    batching dominates at these sizes)."""
+    T, K = log_obs.shape
+    D = mesh.shape[axis_name]
+    if T % D != 0:
+        raise ValueError(f"T={T} must be divisible by mesh axis {axis_name}={D}")
+    if log_A.ndim != 2:
+        raise ValueError("forward_filter_seqshard supports homogeneous log_A only")
+    if mask is None:
+        mask = jnp.ones((T,), log_obs.dtype)
+
+    fn = jax.shard_map(
+        partial(_seqshard_body, axis_name),
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis_name, None), P(axis_name)),
+        out_specs=(P(axis_name, None), P()),
+    )
+    return fn(log_pi, log_A, log_obs, mask)

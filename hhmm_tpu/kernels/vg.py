@@ -1,0 +1,188 @@
+"""Fused value-and-gradient of the forward log-likelihood, batch-aware.
+
+``forward_value_and_grad(log_pi, log_A, log_obs, mask[, gate_key,
+state_key])`` returns ``(loglik, d_pi, d_A, d_obs)`` — the NUTS leapfrog
+needs exactly this pair at every step (`infer/nuts.py` consumes
+``lp(q) -> (logp, grad)``). The gradients are the closed Baum-Welch
+forms (see :mod:`hhmm_tpu.kernels.grad`).
+
+Gated transitions. The reference's semi-supervised and Tayal forward
+passes apply the transition factor only on *consistent* destination
+states — inconsistent ones keep their emission term with a unit factor
+(`hmm-multinom-semisup.stan:42-44`, `hhmm-tayal2009.stan:46-70`). That
+is a per-(step, destination) 0/1 gate ``c[t, j]`` on ``log_A``:
+
+    alpha_t[j] = logsumexp_i(alpha_{t-1}[i] + c[t,j] * log_A[i,j]) + obs[t,j]
+
+Here the gate is expressed by two small arrays — ``c[t, j] =
+(gate_key[t] == state_key[j])`` — which keeps ``log_A`` homogeneous
+(Pallas-eligible) instead of materializing a [T-1,K,K] time-varying
+matrix on every leapfrog. This covers both reference gating patterns
+(Tayal: per-leg sign vs state sign group; semisup: observed group label
+vs state group). Gated inputs must be finite (models use ``safe_log`` /
+``MASK_NEG``, never -inf: ``-inf * 0`` would poison the unit factor).
+
+The ops are :func:`jax.custom_batching.custom_vmap`: when the sampler is
+vmapped over chains and again over series/windows, every nested batch
+axis is folded into ONE flat leading batch dimension, and the batched
+implementation dispatches to the fused Pallas TPU kernel
+(:mod:`hhmm_tpu.kernels.pallas_forward`) when eligible — one kernel
+launch runs the whole forward+backward time loop in VMEM for 128 series
+per grid step, instead of XLA sequencing 2(T-1) tiny scan iterations.
+Ineligible cases (CPU, time-varying transitions, T too long for VMEM)
+fall back to the vmapped lax.scan implementation — identical semantics
+and masking rules.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.custom_batching import custom_vmap
+
+from hhmm_tpu.kernels.filtering import backward_pass, forward_filter
+
+__all__ = ["forward_value_and_grad"]
+
+
+def _vg_core(log_pi, log_A, log_obs, mask, cA):
+    """Shared scan-based implementation. ``cA`` is the [T-1, 1, K]
+    transition gate (None = ungated)."""
+    lA3 = log_A if log_A.ndim == 3 else log_A[None]
+    A_eff = lA3 if cA is None else jnp.where(cA > 0, lA3, 0.0)
+    if A_eff.shape[0] == 1:
+        A_eff_scan = A_eff[0]  # homogeneous: keep 2-D for the scan kernels
+    else:
+        A_eff_scan = A_eff
+    log_alpha, ll = forward_filter(log_pi, A_eff_scan, log_obs, mask)
+    log_beta = backward_pass(A_eff_scan, log_obs, mask)
+    gamma = jnp.exp(log_alpha + log_beta - ll) * mask[:, None]
+    d_pi = jnp.exp(log_alpha[0] + log_beta[0] - ll)
+    xi = jnp.exp(
+        log_alpha[:-1, :, None]
+        + A_eff
+        + (log_obs[1:] + log_beta[1:])[:, None, :]
+        - ll
+    ) * mask[1:, None, None]
+    if cA is not None:
+        xi = xi * (cA > 0)  # chain rule: dA_eff/dA = c
+    d_A = xi if log_A.ndim == 3 else xi.sum(axis=0)
+    return ll, d_pi, d_A, gamma
+
+
+def _vg_single(log_pi, log_A, log_obs, mask):
+    return _vg_core(log_pi, log_A, log_obs, mask, None)
+
+
+def _vg_single_gated(log_pi, log_A, log_obs, mask, gate_key, state_key):
+    c = gate_key[:, None] == state_key[None, :]  # [T, K]
+    return _vg_core(log_pi, log_A, log_obs, mask, c[1:, None, :])
+
+
+def _broadcast_unbatched(axis_size, in_batched, args):
+    """Give every arg the new leading batch axis."""
+    return tuple(
+        a if b else jnp.broadcast_to(a, (axis_size,) + a.shape)
+        for a, b in zip(args, in_batched)
+    )
+
+
+def _pallas_eligible(log_A_b, log_obs_b) -> bool:
+    """Batched shapes: homogeneous A [B,K,K], f32, T*K small enough that
+    the fused kernel's per-tile VMEM blocks (obs, alpha scratch, d_obs,
+    each T*K*128*4 bytes, double-buffered) fit comfortably."""
+    if jax.default_backend() != "tpu":
+        return False
+    if log_A_b.ndim != 3:  # [B, T-1, K, K] time-varying
+        return False
+    T, K = log_obs_b.shape[1], log_obs_b.shape[2]
+    if log_obs_b.dtype != jnp.float32:
+        return False
+    return T * K <= 4096
+
+
+@custom_vmap
+def _vg_batched(log_pi, log_A, log_obs, mask):
+    """One flat leading batch axis on every arg."""
+    if _pallas_eligible(log_A, log_obs):
+        from hhmm_tpu.kernels.pallas_forward import pallas_forward_vg
+
+        return pallas_forward_vg(log_pi, log_A, log_obs, mask)
+    return jax.vmap(_vg_single)(log_pi, log_A, log_obs, mask)
+
+
+@_vg_batched.def_vmap
+def _vg_batched_rule(axis_size, in_batched, *args):
+    # Fold the extra axis into the flat batch: [B2, B1, ...] -> [B2*B1, ...]
+    args = _broadcast_unbatched(axis_size, in_batched, args)
+    flat = tuple(a.reshape((-1,) + a.shape[2:]) for a in args)
+    outs = _vg_batched(*flat)
+    outs = tuple(o.reshape((axis_size, -1) + o.shape[1:]) for o in outs)
+    return outs, (True, True, True, True)
+
+
+@custom_vmap
+def _vg_batched_gated(log_pi, log_A, log_obs, mask, gate_key, state_key):
+    if _pallas_eligible(log_A, log_obs):
+        from hhmm_tpu.kernels.pallas_forward import pallas_forward_vg
+
+        return pallas_forward_vg(
+            log_pi, log_A, log_obs, mask, gate_key=gate_key, state_key=state_key
+        )
+    return jax.vmap(_vg_single_gated)(log_pi, log_A, log_obs, mask, gate_key, state_key)
+
+
+@_vg_batched_gated.def_vmap
+def _vg_batched_gated_rule(axis_size, in_batched, *args):
+    args = _broadcast_unbatched(axis_size, in_batched, args)
+    flat = tuple(a.reshape((-1,) + a.shape[2:]) for a in args)
+    outs = _vg_batched_gated(*flat)
+    outs = tuple(o.reshape((axis_size, -1) + o.shape[1:]) for o in outs)
+    return outs, (True, True, True, True)
+
+
+@custom_vmap
+def _fvg(log_pi, log_A, log_obs, mask):
+    return _vg_single(log_pi, log_A, log_obs, mask)
+
+
+@_fvg.def_vmap
+def _fvg_rule(axis_size, in_batched, *args):
+    args = _broadcast_unbatched(axis_size, in_batched, args)
+    return _vg_batched(*args), (True, True, True, True)
+
+
+@custom_vmap
+def _fvg_gated(log_pi, log_A, log_obs, mask, gate_key, state_key):
+    return _vg_single_gated(log_pi, log_A, log_obs, mask, gate_key, state_key)
+
+
+@_fvg_gated.def_vmap
+def _fvg_gated_rule(axis_size, in_batched, *args):
+    args = _broadcast_unbatched(axis_size, in_batched, args)
+    return _vg_batched_gated(*args), (True, True, True, True)
+
+
+def forward_value_and_grad(
+    log_pi: jnp.ndarray,
+    log_A: jnp.ndarray,
+    log_obs: jnp.ndarray,
+    mask: jnp.ndarray,
+    gate_key: Optional[jnp.ndarray] = None,
+    state_key: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Returns ``(loglik, d_pi, d_A, d_obs)`` for one series; under any
+    nesting of ``vmap`` the batched TPU path is used. ``mask`` is
+    required (pass ones for dense series) so the op's batching stays
+    uniform; gradients flow to ``log_pi``/``log_A``/``log_obs`` only.
+
+    ``gate_key [T]`` / ``state_key [K]`` (together or not at all) select
+    the gated-transition semantics described in the module docstring.
+    """
+    if (gate_key is None) != (state_key is None):
+        raise ValueError("gate_key and state_key must be given together")
+    if gate_key is None:
+        return _fvg(log_pi, log_A, log_obs, mask)
+    return _fvg_gated(log_pi, log_A, log_obs, mask, gate_key, state_key)

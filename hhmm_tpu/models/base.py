@@ -92,6 +92,60 @@ class BaseHMMModel:
 
         return logp
 
+    def build_vg(self, params: Dict[str, jnp.ndarray], data: Data):
+        """Hot-loop variant of :meth:`build` — must be consistent with
+        :meth:`gate_keys`: when gating keys are provided, the returned
+        ``log_A`` stays homogeneous and UNGATED (the vg op applies the
+        gate). Default: same as ``build`` (no gating)."""
+        return self.build(params, data)
+
+    def gate_keys(self, data: Data):
+        """Per-step transition gate for the vg op (see
+        :mod:`hhmm_tpu.kernels.vg`): ``None`` (default) or a pair
+        ``(gate_key [T], state_key [K])`` of float arrays with
+        ``c[t, j] = (gate_key[t] == state_key[j])``."""
+        return None
+
+    def make_vg(self, data: Data) -> Callable[[jnp.ndarray], Tuple[jnp.ndarray, jnp.ndarray]]:
+        """Fused ``theta -> (logp, grad)`` for the sampler's hot loop.
+
+        Routes the forward recursion through
+        :func:`hhmm_tpu.kernels.vg.forward_value_and_grad` — a
+        custom-vmap op that collapses the sampler's series x chains
+        nesting into one flat batch and runs the fused Pallas TPU
+        kernel when eligible. The chain rule from the recursion inputs
+        back to ``theta`` (bijectors, priors, emission/transition
+        builders) is ordinary ``jax.vjp`` — elementwise work XLA
+        handles well; only the sequential scan is special-cased.
+        """
+        from hhmm_tpu.kernels.vg import forward_value_and_grad
+
+        gk = self.gate_keys(data)
+
+        def vg(theta):
+            def to_terms(th):
+                params, ldj = self.unpack(th)
+                log_pi, log_A, log_obs, mask = self.build_vg(params, data)
+                if mask is None:
+                    mask = jnp.ones(log_obs.shape[:1], log_obs.dtype)
+                return log_pi, log_A, log_obs, mask, self.log_prior(params) + ldj
+
+            (log_pi, log_A, log_obs, mask, extra), vjp_fn = jax.vjp(to_terms, theta)
+            if gk is None:
+                ll, d_pi, d_A, d_obs = forward_value_and_grad(
+                    log_pi, log_A, log_obs, mask
+                )
+            else:
+                ll, d_pi, d_A, d_obs = forward_value_and_grad(
+                    log_pi, log_A, log_obs, mask, gk[0], gk[1]
+                )
+            (d_theta,) = vjp_fn(
+                (d_pi, d_A, d_obs, jnp.zeros_like(mask), jnp.ones_like(extra))
+            )
+            return ll + extra, d_theta
+
+        return vg
+
     def init_unconstrained(self, key: jax.Array, data: Data) -> jnp.ndarray:
         """Default init: standard normal draw on the unconstrained space
         (Stan's default is uniform(-2,2); models override with k-means

@@ -52,7 +52,9 @@ class MultinomialHMM(BaseHMMModel):
     def build(self, params, data):
         x = data["x"].astype(jnp.int32)  # [T] in 0..L-1
         log_phi = safe_log(params["phi_k"])  # [K, L]
-        log_obs = log_phi.T[x]  # [T, K]
+        # one-hot matmul rather than a gather: the VJP is an MXU matmul
+        # instead of an XLA scatter (see models/tayal.py)
+        log_obs = jax.nn.one_hot(x, self.L, dtype=log_phi.dtype) @ log_phi.T  # [T, K]
         return (
             safe_log(params["p_1k"]),
             safe_log(params["A_ij"]),
@@ -93,7 +95,8 @@ class SemisupMultinomialHMM(MultinomialHMM):
         x = data["x"].astype(jnp.int32)
         g = data["g"].astype(jnp.int32)  # [T] observed group labels
         log_phi = safe_log(params["phi_k"])
-        log_obs = log_phi.T[x]  # [T, K]
+        # one-hot matmul rather than a gather: MXU-matmul VJP (see build)
+        log_obs = jax.nn.one_hot(x, self.L, dtype=log_phi.dtype) @ log_phi.T  # [T, K]
         consistent = g[:, None] == jnp.asarray(self.groups)[None, :]  # [T, K]
         log_pi = safe_log(params["p_1k"])
         log_A = safe_log(params["A_ij"])
@@ -112,3 +115,17 @@ class SemisupMultinomialHMM(MultinomialHMM):
         # matrix A_t[i, j] = consistent[t+1, j] ? A[i, j] : 1.
         log_A_t = jnp.where(consistent[1:, None, :], log_A[None, :, :], 0.0)
         return log_pi, log_A_t, log_obs
+
+    def build_vg(self, params, data):
+        """Hot-loop build: stan-mode group gating via gate keys (the vg
+        op applies the gate; ``log_A`` stays homogeneous)."""
+        if self.gate_mode == "hard":
+            return self.build(params, data)
+        base = MultinomialHMM.build(self, params, data)
+        return base  # ungated homogeneous terms; gate via gate_keys
+
+    def gate_keys(self, data):
+        if self.gate_mode == "hard":
+            return None
+        g = jnp.asarray(data["g"], jnp.float32)  # [T] observed group labels
+        return g, jnp.asarray(self.groups, jnp.float32)

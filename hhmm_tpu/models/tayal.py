@@ -87,31 +87,58 @@ class TayalHHMM(BaseHMMModel):
         sign = sign.astype(jnp.int32)
         pi, A = self.assemble(params)
         log_phi = safe_log(params["phi_k"])
-        log_obs = log_phi.T[x]  # [T, K]
+        # one-hot matmul rather than a gather: the VJP becomes an MXU
+        # matmul (onehot^T @ d_obs) instead of an XLA scatter — the
+        # scatter was the single most expensive op in the leapfrog chain
+        log_obs = jax.nn.one_hot(x, self.L, dtype=log_phi.dtype) @ log_phi.T  # [T, K]
         up = jnp.asarray(_UP_STATES)
         consistent = jnp.where(sign[:, None] == UP, up[None, :], ~up[None, :])
         return pi, A, log_obs, consistent
 
+    @staticmethod
+    def _stan_pi(pi, sign):
+        """Stan-parity t=0 factor: log π only on the sign-matching entry
+        state (`hhmm-tayal2009.stan:50-54`); unit factor elsewhere."""
+        sign = jnp.asarray(sign)
+        entry = jnp.where(sign.reshape(-1)[0] == UP, _ENTRY_UP, _ENTRY_DOWN)
+        return jnp.where(jnp.arange(4) == entry, safe_log(pi), 0.0)
+
     def _gated(self, params, x, sign):
         """(log_pi, log_A_t, log_obs) with the selected gating semantics."""
         pi, A, log_obs, consistent = self._terms(params, x, sign)
-        log_pi = safe_log(pi)
         log_A = safe_log(A)
         if self.gate_mode == "hard":
             # homogeneous 2-D log_A: the scan kernels keep it closed over
             # instead of threading T-1 slices through xs on the hot path
             log_obs = jnp.where(consistent, log_obs, MASK_NEG)
-            return log_pi, log_A, log_obs
+            return safe_log(pi), log_A, log_obs
         # Stan parity: pi factor only on the sign-matching entry state;
         # transition factor only on sign-consistent destinations.
-        entry = jnp.where(sign[0] == UP, _ENTRY_UP, _ENTRY_DOWN)
-        log_pi_g = jnp.where(jnp.arange(4) == entry, log_pi, 0.0)
         log_A_t = jnp.where(consistent[1:, None, :], log_A[None], 0.0)
-        return log_pi_g, log_A_t, log_obs
+        return self._stan_pi(pi, sign), log_A_t, log_obs
 
     def build(self, params, data):
         log_pi, log_A_t, log_obs = self._gated(params, data["x"], data["sign"])
         return log_pi, log_A_t, log_obs, data.get("mask")
+
+    def build_vg(self, params, data):
+        """Hot-loop build: in stan mode the sign gate is expressed by
+        gate keys (see :meth:`gate_keys`) so ``log_A`` stays homogeneous
+        and the fused Pallas kernel applies; only the t=0 entry-state
+        restriction on π is baked in here."""
+        if self.gate_mode == "hard":
+            return self.build(params, data)
+        pi, A, log_obs, _ = self._terms(params, data["x"], data["sign"])
+        return self._stan_pi(pi, data["sign"]), safe_log(A), log_obs, data.get("mask")
+
+    def gate_keys(self, data):
+        if self.gate_mode == "hard":
+            return None
+        sign = jnp.asarray(data["sign"], jnp.float32)  # [T]: 0=up, 1=down
+        state_sign = jnp.where(
+            jnp.asarray(_UP_STATES), float(UP), float(DOWN)
+        ).astype(jnp.float32)  # [K]
+        return sign, state_sign
 
     def init_unconstrained(self, key, data):
         """Informed chain init: phi rows start at the empirical symbol

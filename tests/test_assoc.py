@@ -1,0 +1,122 @@
+"""Associative-scan and sequence-sharded forward filters vs the
+sequential lax.scan kernel (kernels/assoc.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hhmm_tpu.core.lmath import MASK_NEG, log_normalize
+from hhmm_tpu.kernels import (
+    forward_filter,
+    forward_filter_assoc,
+    forward_filter_seqshard,
+)
+
+
+def _inputs(rng, T, K, time_varying=False):
+    log_pi = log_normalize(jnp.asarray(rng.normal(size=(K,))))
+    shape = (T - 1, K, K) if time_varying else (K, K)
+    log_A = log_normalize(jnp.asarray(rng.normal(size=shape)), axis=-1)
+    log_obs = jnp.asarray(rng.normal(size=(T, K)) - 1.0)
+    return log_pi, log_A, log_obs
+
+
+class TestAssoc:
+    @pytest.mark.parametrize("time_varying", [False, True])
+    @pytest.mark.parametrize("T", [1, 2, 7, 64])
+    def test_matches_sequential(self, rng, T, time_varying):
+        if T == 1 and time_varying:
+            pytest.skip("no transitions")
+        log_pi, log_A, log_obs = _inputs(rng, T, 3, time_varying)
+        a_ref, ll_ref = forward_filter(log_pi, log_A, log_obs)
+        a, ll = forward_filter_assoc(log_pi, log_A, log_obs)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(a_ref), rtol=2e-5, atol=1e-5)
+        np.testing.assert_allclose(float(ll), float(ll_ref), rtol=1e-6)
+
+    def test_masked_matches_sequential(self, rng):
+        T, K = 33, 4
+        log_pi, log_A, log_obs = _inputs(rng, T, K)
+        mask = jnp.asarray((np.arange(T) < 21).astype(np.float32))
+        a_ref, ll_ref = forward_filter(log_pi, log_A, log_obs, mask)
+        a, ll = forward_filter_assoc(log_pi, log_A, log_obs, mask)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(a_ref), rtol=2e-5, atol=1e-5)
+        np.testing.assert_allclose(float(ll), float(ll_ref), rtol=1e-6)
+
+    def test_gated_entries(self, rng):
+        """MASK_NEG-gated transitions (Tayal hard gating) agree."""
+        T, K = 40, 4
+        log_pi, log_A, log_obs = _inputs(rng, T, K)
+        log_A = log_A.at[0, 3].set(MASK_NEG).at[2, 1].set(MASK_NEG)
+        a_ref, ll_ref = forward_filter(log_pi, log_A, log_obs)
+        a, ll = forward_filter_assoc(log_pi, log_A, log_obs)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(a_ref), rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(float(ll), float(ll_ref), rtol=1e-6)
+
+    def test_grad_matches_sequential(self, rng):
+        log_pi, log_A, log_obs = _inputs(rng, 24, 3)
+
+        def ll_assoc(*a):
+            return forward_filter_assoc(*a)[1]
+
+        def ll_seq(*a):
+            return forward_filter(*a)[1]
+
+        g = jax.grad(ll_assoc, argnums=(0, 1, 2))(log_pi, log_A, log_obs)
+        g_ref = jax.grad(ll_seq, argnums=(0, 1, 2))(log_pi, log_A, log_obs)
+        for a, b in zip(g, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5)
+
+    def test_vmap(self, rng):
+        B, T, K = 6, 16, 3
+        packs = [_inputs(np.random.default_rng(i), T, K) for i in range(B)]
+        lp, lA, lo = (jnp.stack([p[i] for p in packs]) for i in range(3))
+        a, ll = jax.vmap(forward_filter_assoc)(lp, lA, lo)
+        a_ref, ll_ref = jax.vmap(forward_filter)(lp, lA, lo)
+        np.testing.assert_allclose(np.asarray(ll), np.asarray(ll_ref), rtol=1e-5)
+
+
+class TestSeqShard:
+    @pytest.fixture
+    def mesh(self):
+        from jax.sharding import Mesh
+
+        devs = jax.devices()
+        if len(devs) < 4:
+            pytest.skip("needs >=4 virtual devices")
+        return Mesh(np.asarray(devs[:4]), ("sp",))
+
+    def test_matches_sequential(self, rng, mesh):
+        T, K = 64, 4
+        log_pi, log_A, log_obs = _inputs(rng, T, K)
+        a_ref, ll_ref = forward_filter(log_pi, log_A, log_obs)
+        a, ll = forward_filter_seqshard(log_pi, log_A, log_obs, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(a_ref), rtol=2e-5, atol=1e-5)
+        np.testing.assert_allclose(float(ll), float(ll_ref), rtol=1e-6)
+
+    def test_masked(self, rng, mesh):
+        """Tail padding crossing chunk boundaries."""
+        T, K = 64, 3
+        log_pi, log_A, log_obs = _inputs(rng, T, K)
+        mask = jnp.asarray((np.arange(T) < 37).astype(np.float32))
+        a_ref, ll_ref = forward_filter(log_pi, log_A, log_obs, mask)
+        a, ll = forward_filter_seqshard(log_pi, log_A, log_obs, mask, mesh=mesh)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(a_ref), rtol=2e-5, atol=1e-5)
+        np.testing.assert_allclose(float(ll), float(ll_ref), rtol=1e-6)
+
+    def test_jit_composes(self, rng, mesh):
+        T, K = 32, 3
+        log_pi, log_A, log_obs = _inputs(rng, T, K)
+        fn = jax.jit(
+            lambda *a: forward_filter_seqshard(*a, mesh=mesh)[1]
+        )
+        _, ll_ref = forward_filter(log_pi, log_A, log_obs)
+        np.testing.assert_allclose(float(fn(log_pi, log_A, log_obs)), float(ll_ref), rtol=1e-6)
+
+    def test_rejects_bad_shapes(self, rng, mesh):
+        log_pi, log_A, log_obs = _inputs(rng, 30, 3)
+        with pytest.raises(ValueError):
+            forward_filter_seqshard(log_pi, log_A, log_obs, mesh=mesh)  # 30 % 4 != 0
+        log_pi, lA_t, log_obs = _inputs(rng, 32, 3, time_varying=True)
+        with pytest.raises(ValueError):
+            forward_filter_seqshard(log_pi, lA_t, log_obs, mesh=mesh)

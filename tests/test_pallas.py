@@ -1,0 +1,269 @@
+"""Fused Pallas forward+backward kernel (kernels/pallas_forward.py) and
+the custom_vmap dispatcher (kernels/vg.py), in interpreter mode on CPU.
+The real-TPU path is exercised by bench.py on hardware."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hhmm_tpu.core.lmath import MASK_NEG, log_normalize, safe_log
+from hhmm_tpu.kernels.pallas_forward import pallas_forward_vg
+from hhmm_tpu.kernels.vg import _vg_single, forward_value_and_grad
+
+
+def _batch(rng, B, T, K, ragged=False):
+    log_pi = log_normalize(jnp.asarray(rng.normal(size=(B, K))))
+    log_A = log_normalize(jnp.asarray(rng.normal(size=(B, K, K))), axis=-1)
+    log_obs = jnp.asarray(rng.normal(size=(B, T, K)) - 1.0)
+    if ragged:
+        lengths = rng.integers(T // 2, T + 1, size=B)
+        mask = jnp.asarray((np.arange(T)[None] < lengths[:, None]).astype(np.float32))
+    else:
+        mask = jnp.ones((B, T), jnp.float32)
+    return log_pi.astype(jnp.float32), log_A.astype(jnp.float32), log_obs.astype(
+        jnp.float32
+    ), mask
+
+
+def _ref(log_pi, log_A, log_obs, mask):
+    return jax.vmap(_vg_single)(log_pi, log_A, log_obs, mask)
+
+
+def _assert_close(out, ref, rtol=3e-4, atol=3e-5):
+    for a, b, name in zip(out, ref, ("ll", "d_pi", "d_A", "d_obs")):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=rtol, atol=atol, err_msg=name
+        )
+
+
+class TestPallasKernel:
+    @pytest.mark.parametrize("B", [1, 5, 128, 130])
+    def test_matches_reference(self, rng, B):
+        args = _batch(rng, B, 33, 4)
+        out = pallas_forward_vg(*args, interpret=True)
+        _assert_close(out, _ref(*args))
+
+    def test_ragged_masks(self, rng):
+        args = _batch(rng, 9, 40, 4, ragged=True)
+        out = pallas_forward_vg(*args, interpret=True)
+        _assert_close(out, _ref(*args))
+        # padding steps have zero obs-gradient
+        dobs = np.asarray(out[3])
+        m = np.asarray(args[3])
+        assert np.all(dobs[m == 0.0] == 0.0)
+
+    def test_gated_tayal_shapes(self, rng):
+        """Sparse MASK_NEG-gated transitions (hard-gated Tayal sparse A)."""
+        B, T, K = 4, 50, 4
+        log_pi, log_A, log_obs, mask = _batch(rng, B, T, K)
+        gate = jnp.asarray(rng.random((B, K, K)) < 0.4)
+        log_A = jnp.where(gate, MASK_NEG, log_A)
+        pi_gate = jnp.asarray(rng.random((B, K)) < 0.3)
+        log_pi = jnp.where(pi_gate, safe_log(jnp.zeros(())), log_pi)
+        out = pallas_forward_vg(log_pi, log_A, log_obs, mask, interpret=True)
+        ref = _ref(log_pi, log_A, log_obs, mask)
+        for o in out:
+            assert np.all(np.isfinite(np.asarray(o)))
+        _assert_close(out, ref)
+
+    def test_K3(self, rng):
+        args = _batch(rng, 3, 21, 3)
+        out = pallas_forward_vg(*args, interpret=True)
+        _assert_close(out, _ref(*args))
+
+
+class TestDispatcher:
+    def test_single_equals_reference(self, rng):
+        lp, lA, lo, m = _batch(rng, 1, 19, 3)
+        out = forward_value_and_grad(lp[0], lA[0], lo[0], m[0])
+        ref = _vg_single(lp[0], lA[0], lo[0], m[0])
+        _assert_close(out, ref)
+
+    def test_vmap_once(self, rng):
+        args = _batch(rng, 6, 17, 4)
+        out = jax.vmap(forward_value_and_grad)(*args)
+        _assert_close(out, _ref(*args))
+
+    def test_vmap_nested_folds(self, rng):
+        """series x chains nesting — the bench/sampler structure."""
+        S, C, T, K = 3, 2, 15, 4
+        lp, lA, lo, m = _batch(rng, S * C, T, K)
+        lp2, lA2, lo2 = (
+            x.reshape((S, C) + x.shape[1:]) for x in (lp, lA, lo)
+        )
+        m2 = m.reshape(S, C, T)
+        out = jax.vmap(jax.vmap(forward_value_and_grad))(lp2, lA2, lo2, m2)
+        ref = _ref(lp, lA, lo, m)
+        ref2 = tuple(r.reshape((S, C) + r.shape[1:]) for r in ref)
+        _assert_close(out, ref2)
+
+    def test_vmap_unbatched_args_broadcast(self, rng):
+        """mask shared across chains (the in-sampler case)."""
+        lp, lA, lo, m = _batch(rng, 4, 12, 3)
+        out = jax.vmap(forward_value_and_grad, in_axes=(0, 0, 0, None))(
+            lp, lA, lo, m[0]
+        )
+        ref = _ref(lp, lA, lo, jnp.broadcast_to(m[0], m.shape))
+        _assert_close(out, ref)
+
+    def test_time_varying_falls_back(self, rng):
+        B, T, K = 3, 11, 3
+        lp = log_normalize(jnp.asarray(rng.normal(size=(B, K)))).astype(jnp.float32)
+        lA = log_normalize(
+            jnp.asarray(rng.normal(size=(B, T - 1, K, K))), axis=-1
+        ).astype(jnp.float32)
+        lo = jnp.asarray(rng.normal(size=(B, T, K))).astype(jnp.float32)
+        m = jnp.ones((B, T), jnp.float32)
+        out = jax.vmap(forward_value_and_grad)(lp, lA, lo, m)
+        ref = _ref(lp, lA, lo, m)
+        _assert_close(out, ref)
+
+    def test_jit_compatible(self, rng):
+        args = _batch(rng, 5, 13, 4)
+        out = jax.jit(jax.vmap(forward_value_and_grad))(*args)
+        _assert_close(out, _ref(*args))
+
+
+class TestSamplerVgPath:
+    def test_vg_matches_logp_path(self, rng):
+        """sample_nuts(vg_fn=...) reproduces the logp path exactly on CPU
+        (identical numerics -> identical chains)."""
+        from hhmm_tpu.infer import SamplerConfig, sample_nuts
+        from hhmm_tpu.models import TayalHHMM
+
+        model = TayalHHMM()
+        T = 60
+        x = jnp.asarray(rng.integers(0, 9, size=T))
+        sign = jnp.asarray(np.arange(T) % 2)
+        data = {"x": x, "sign": sign}
+        theta0 = model.init_unconstrained(jax.random.PRNGKey(0), data)
+        cfg = SamplerConfig(num_warmup=30, num_samples=30, num_chains=2, max_treedepth=6)
+        key = jax.random.PRNGKey(1)
+
+        qs_a, st_a = sample_nuts(model.make_logp(data), key, theta0, cfg)
+        qs_b, st_b = sample_nuts(None, key, theta0, cfg, vg_fn=model.make_vg(data))
+        np.testing.assert_allclose(
+            np.asarray(qs_a), np.asarray(qs_b), rtol=1e-4, atol=1e-5
+        )
+
+    def test_vg_vmapped_over_series(self, rng):
+        """The bench structure: vmap over series around sample_nuts."""
+        from hhmm_tpu.infer import SamplerConfig, sample_nuts
+        from hhmm_tpu.models import TayalHHMM
+
+        model = TayalHHMM()
+        B, T = 3, 40
+        x = jnp.asarray(rng.integers(0, 9, size=(B, T)))
+        sign = jnp.asarray(np.broadcast_to(np.arange(T) % 2, (B, T)))
+        init = jnp.stack(
+            [
+                model.init_unconstrained(jax.random.PRNGKey(i), {"x": x[i], "sign": sign[i]})
+                for i in range(B)
+            ]
+        )[:, None, :]
+        keys = jax.random.split(jax.random.PRNGKey(5), B)
+        cfg = SamplerConfig(num_warmup=20, num_samples=10, num_chains=1, max_treedepth=5)
+
+        def one(xi, si, qi, ki):
+            vg = model.make_vg({"x": xi, "sign": si})
+            qs, stats = sample_nuts(None, ki, qi, cfg, jit=False, vg_fn=vg)
+            return qs, stats["logp"]
+
+        qs, logps = jax.jit(jax.vmap(one))(x, sign, init, keys)
+        assert qs.shape == (B, 1, cfg.num_samples, model.n_free)
+        assert np.all(np.isfinite(np.asarray(logps)))
+
+
+class TestGatedPath:
+    def _gated_args(self, rng, B, T, K):
+        lp, lA, lo, m = _batch(rng, B, T, K)
+        gate_key = jnp.asarray((rng.integers(0, 2, size=(B, T))).astype(np.float32))
+        state_key = jnp.asarray((rng.integers(0, 2, size=(B, K))).astype(np.float32))
+        return lp, lA, lo, m, gate_key, state_key
+
+    def test_kernel_matches_reference(self, rng):
+        from hhmm_tpu.kernels.vg import _vg_single_gated
+
+        args = self._gated_args(rng, 7, 29, 4)
+        out = pallas_forward_vg(args[0], args[1], args[2], args[3],
+                                gate_key=args[4], state_key=args[5], interpret=True)
+        ref = jax.vmap(_vg_single_gated)(*args)
+        _assert_close(out, ref)
+
+    def test_kernel_gated_ragged_masks(self, rng):
+        """Gate x ragged-mask interaction in the fused kernel: padded
+        steps must carry alpha/beta through and contribute no gradient
+        even while gating is active."""
+        from hhmm_tpu.kernels.vg import _vg_single_gated
+
+        lp, lA, lo, m = _batch(rng, 9, 40, 4, ragged=True)
+        gate_key = jnp.asarray((rng.integers(0, 2, size=(9, 40))).astype(np.float32))
+        state_key = jnp.asarray((rng.integers(0, 2, size=(9, 4))).astype(np.float32))
+        out = pallas_forward_vg(lp, lA, lo, m, gate_key=gate_key,
+                                state_key=state_key, interpret=True)
+        ref = jax.vmap(_vg_single_gated)(lp, lA, lo, m, gate_key, state_key)
+        _assert_close(out, ref)
+        dobs = np.asarray(out[3])
+        assert np.all(dobs[np.asarray(m) == 0.0] == 0.0)
+
+    def test_gated_op_vmap(self, rng):
+        from hhmm_tpu.kernels.vg import _vg_single_gated
+
+        args = self._gated_args(rng, 5, 18, 4)
+        out = jax.vmap(forward_value_and_grad)(*args)
+        ref = jax.vmap(_vg_single_gated)(*args)
+        _assert_close(out, ref)
+
+    def test_tayal_stan_vg_matches_autodiff(self, rng):
+        """make_vg (gated op + onehot emissions) == grad(make_logp)
+        (time-varying gated A + custom VJP) for the stan-parity mode."""
+        from hhmm_tpu.models import TayalHHMM
+
+        model = TayalHHMM(gate_mode="stan")
+        T = 70
+        x = jnp.asarray(rng.integers(0, 9, size=T))
+        sign = jnp.asarray(np.arange(T) % 2)
+        data = {"x": x, "sign": sign}
+        logp = model.make_logp(data)
+        vg = model.make_vg(data)
+        for seed in range(3):
+            theta = model.init_unconstrained(jax.random.PRNGKey(seed), data)
+            v, g = vg(theta)
+            v_ref, g_ref = jax.value_and_grad(logp)(theta)
+            np.testing.assert_allclose(float(v), float(v_ref), rtol=1e-5)
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(g_ref), rtol=3e-4, atol=1e-5
+            )
+
+    def test_semisup_stan_vg_matches_autodiff(self, rng):
+        from hhmm_tpu.models import SemisupMultinomialHMM
+
+        model = SemisupMultinomialHMM(K=4, L=5, groups=(0, 1, 1, 0), gate_mode="stan")
+        T = 50
+        z_groups = rng.integers(0, 2, size=T)
+        data = {
+            "x": jnp.asarray(rng.integers(0, 5, size=T)),
+            "g": jnp.asarray(z_groups),
+        }
+        logp = model.make_logp(data)
+        vg = model.make_vg(data)
+        theta = model.init_unconstrained(jax.random.PRNGKey(0), data)
+        v, g = vg(theta)
+        v_ref, g_ref = jax.value_and_grad(logp)(theta)
+        np.testing.assert_allclose(float(v), float(v_ref), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), rtol=3e-4, atol=1e-5)
+
+    def test_hard_mode_vg_matches_autodiff(self, rng):
+        from hhmm_tpu.models import TayalHHMM
+
+        model = TayalHHMM(gate_mode="hard")
+        T = 40
+        x = jnp.asarray(rng.integers(0, 9, size=T))
+        sign = jnp.asarray(np.arange(T) % 2)
+        data = {"x": x, "sign": sign}
+        theta = model.init_unconstrained(jax.random.PRNGKey(0), data)
+        v, g = model.make_vg(data)(theta)
+        v_ref, g_ref = jax.value_and_grad(model.make_logp(data))(theta)
+        np.testing.assert_allclose(float(v), float(v_ref), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), rtol=3e-4, atol=1e-5)
