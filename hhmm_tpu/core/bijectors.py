@@ -179,3 +179,40 @@ class Simplex(Bijector):
         offsets = -jnp.log(jnp.arange(K - 1, 0, -1, dtype=y.dtype))
         x = jnp.log(z) - jnp.log1p(-z) - offsets
         return x.reshape(-1)
+
+
+class MaskedSimplex(Bijector):
+    """Simplex over a static support subset of a length-n vector.
+
+    Entries off the support are exactly 0 — the structural sparsity of
+    an HHMM tree's transition rows (the Tayal expansion's forced zeros,
+    `tayal2009/main.Rmd:306-345`, generalized). A support of size m
+    costs m-1 free parameters; m == 1 is a deterministic row with no
+    free parameters.
+    """
+
+    def __init__(self, support):
+        self.support = np.asarray(support, dtype=bool)
+        if self.support.ndim != 1:
+            raise ValueError("support must be 1-D")
+        m = int(self.support.sum())
+        if m < 1:
+            raise ValueError("support must have at least one entry")
+        self.shape = self.support.shape
+        self._idx = np.flatnonzero(self.support)
+        self._inner = Simplex(shape=(m,))
+        self.n_free = self._inner.n_free
+
+    def forward(self, x):
+        vals, ldj = self._inner.forward(x)
+        y = jnp.zeros(self.shape, vals.dtype).at[jnp.asarray(self._idx)].set(vals)
+        return y, ldj
+
+    def inverse(self, y):
+        y = jnp.asarray(y).reshape(self.shape)
+        vals = y[jnp.asarray(self._idx)]
+        # renormalize the on-support mass: a caller packing an init with
+        # (small) mass on structural zeros gets the nearest valid point
+        # instead of the stick-breaking tail silently absorbing the gap
+        vals = vals / jnp.sum(vals)
+        return self._inner.inverse(vals)

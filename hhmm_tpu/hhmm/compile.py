@@ -28,7 +28,13 @@ import numpy as np
 
 from hhmm_tpu.hhmm.structure import End, Internal, Production, iter_leaves, leaf_groups
 
-__all__ = ["FlatHMM", "compile_hhmm", "gaussian_leaf_params", "categorical_leaf_params"]
+__all__ = [
+    "FlatHMM",
+    "compile_hhmm",
+    "compile_params",
+    "gaussian_leaf_params",
+    "categorical_leaf_params",
+]
 
 
 @dataclass(frozen=True)
@@ -115,3 +121,76 @@ def gaussian_leaf_params(flat: FlatHMM) -> Tuple[np.ndarray, np.ndarray]:
 def categorical_leaf_params(flat: FlatHMM) -> np.ndarray:
     """Stack per-leaf categorical emission rows ``phi [K, L]``."""
     return np.stack([np.asarray(leaf.obs[1]["phi"], dtype=np.float64) for leaf in flat.leaves])
+
+
+def compile_params(root: Internal, pi_of, A_of):
+    """Differentiable compile: same expansion algebra as
+    :func:`compile_hhmm`, but per-node (pi, A) values come from the
+    callables ``pi_of(node) -> [n]`` / ``A_of(node) -> [n, n]`` (jnp
+    arrays, possibly JAX tracers). The *structure* — which entries are
+    reachable, where End exits route — is taken from the spec's numeric
+    arrays, so tracing never branches on traced values. Returns
+    ``(pi [K], A [K, K])`` as jnp arrays.
+
+    This is what makes the tree fittable: a model exposes the free
+    probability slots as constrained parameters and assembles the flat
+    sparse HMM inside the NUTS target (the capability the reference's
+    missing `hhmm/stan/hhmm-unsup.stan` / `hhmm-semisup.stan` were meant
+    to provide, `hhmm/main.R:129,280`).
+    """
+    import jax.numpy as jnp
+
+    leaves = iter_leaves(root)
+    K = len(leaves)
+    ent_cache = {}
+    A_cache = {}
+
+    def A_at(node):
+        # one materialization per node: A_of may stack rows / convert
+        # constants, and it is consulted once per (leaf, ancestor) pair
+        key = id(node)
+        if key not in A_cache:
+            A_cache[key] = A_of(node)
+        return A_cache[key]
+
+    def ent(node):
+        if isinstance(node, Production):
+            return jnp.zeros(K).at[node.leaf_id].set(1.0)
+        key = id(node)
+        if key not in ent_cache:
+            pi_val = pi_of(node)
+            e = jnp.zeros(K)
+            for j, child in enumerate(node.children):
+                if node.pi[j] > 0.0 and not isinstance(child, End):
+                    e = e + pi_val[j] * ent(child)
+            ent_cache[key] = e
+        return ent_cache[key]
+
+    rows = []
+    for p in leaves:
+        acc = jnp.zeros(K)
+        mult = jnp.ones(())
+        cur = p
+        while True:
+            parent = cur.parent
+            if parent is None:  # exited at root level → restart via pi
+                acc = acc + mult * ent(cur)
+                break
+            row_spec = parent.A[cur.index]
+            row_val = A_at(parent)[cur.index]
+            end_struct = 0.0
+            end_val = jnp.zeros(())
+            for j, sib in enumerate(parent.children):
+                if isinstance(sib, End):
+                    if row_spec[j] > 0.0:
+                        end_struct += row_spec[j]
+                        end_val = end_val + row_val[j]
+                elif row_spec[j] > 0.0:
+                    acc = acc + mult * row_val[j] * ent(sib)
+            if end_struct == 0.0:
+                break
+            mult = mult * end_val
+            cur = parent
+        rows.append(acc)
+
+    return ent(root), jnp.stack(rows)

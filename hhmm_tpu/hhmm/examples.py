@@ -26,7 +26,13 @@ import numpy as np
 
 from hhmm_tpu.hhmm.structure import End, Internal, Production, finalize
 
-__all__ = ["hmix_tree", "fine1998_tree", "tayal_tree", "jangmin2004_tree"]
+__all__ = [
+    "hmix_tree",
+    "hier2x2_tree",
+    "fine1998_tree",
+    "tayal_tree",
+    "jangmin2004_tree",
+]
 
 
 def _g(mu: float, sigma: float, name: str = "") -> Production:
@@ -48,6 +54,30 @@ def hmix_tree() -> Internal:
         pi=[1.0, 0.0],
         A=[[0.0, 1.0], [0.0, 1.0]],
         children=[comp, End("q2e")],
+    )
+    return finalize(root)
+
+
+def hier2x2_tree() -> Internal:
+    """2×2 hierarchical Gaussian mixture — the structure of the
+    `hhmm/main.R:17-91` example: two sticky regimes, each a 2-component
+    Gaussian mixture; a regime runs its mixture until the End exit
+    fires, then the root alternates regimes. Means are separated by
+    regime (negative vs positive) with overlap between components."""
+
+    def regime(mus: Tuple[float, float], name: str) -> Internal:
+        return Internal(
+            name=name,
+            pi=[0.5, 0.5, 0.0],
+            A=[[0.80, 0.10, 0.10], [0.10, 0.80, 0.10], [0.0, 0.0, 1.0]],
+            children=[_g(mus[0], 0.6, f"{name}_a"), _g(mus[1], 0.6, f"{name}_b"), End()],
+        )
+
+    root = Internal(
+        name="root",
+        pi=[0.5, 0.5],
+        A=[[0.2, 0.8], [0.8, 0.2]],
+        children=[regime((-3.0, -1.0), "lo"), regime((1.0, 3.0), "hi")],
     )
     return finalize(root)
 

@@ -3,8 +3,10 @@ from hhmm_tpu.models.gaussian_hmm import GaussianHMM
 from hhmm_tpu.models.multinomial_hmm import MultinomialHMM, SemisupMultinomialHMM
 from hhmm_tpu.models.iohmm import IOHMMReg, IOHMMMix, IOHMMHMix, IOHMMHMixLite
 from hhmm_tpu.models.tayal import TayalHHMM, TayalHHMMLite
+from hhmm_tpu.models.tree import TreeHMM
 
 __all__ = [
+    "TreeHMM",
     "BaseHMMModel",
     "GaussianHMM",
     "MultinomialHMM",

@@ -35,9 +35,28 @@ from hhmm_tpu.kernels import (
     viterbi,
 )
 
-__all__ = ["BaseHMMModel"]
+__all__ = ["BaseHMMModel", "semisup_gate"]
 
 Data = Dict[str, jnp.ndarray]
+
+
+def semisup_gate(log_pi, log_A, log_obs, consistent, gate_mode: str):
+    """Observed-group evidence gating, shared by every semisup-style
+    model (`hmm-multinom-semisup.stan:42-44` semantics).
+
+    ``consistent [T, K]``: whether state j may own step t. ``"stan"``
+    keeps the emission term on inconsistent destinations with a *unit*
+    transition factor (time-varying ``A_t[i, j] = consistent[t+1, j] ?
+    A[i, j] : 1``; π stays ungated); ``"hard"`` forbids them outright
+    (additive MASK_NEG on emissions, ``log_A`` stays homogeneous).
+    Returns the gated ``(log_pi, log_A, log_obs)``.
+    """
+    from hhmm_tpu.core.lmath import MASK_NEG
+
+    if gate_mode == "hard":
+        return log_pi, log_A, jnp.where(consistent, log_obs, MASK_NEG)
+    log_A_t = jnp.where(consistent[1:, None, :], log_A[None], 0.0)
+    return log_pi, log_A_t, log_obs
 
 
 class BaseHMMModel:

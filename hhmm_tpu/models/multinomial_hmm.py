@@ -31,7 +31,7 @@ import numpy as np
 from hhmm_tpu.core.bijectors import Bijector, Simplex
 from hhmm_tpu.core.lmath import logsumexp, safe_log, MASK_NEG
 from hhmm_tpu.kernels.filtering import forward_filter
-from hhmm_tpu.models.base import BaseHMMModel
+from hhmm_tpu.models.base import BaseHMMModel, semisup_gate
 
 __all__ = ["MultinomialHMM", "SemisupMultinomialHMM"]
 
@@ -98,23 +98,13 @@ class SemisupMultinomialHMM(MultinomialHMM):
         # one-hot matmul rather than a gather: MXU-matmul VJP (see build)
         log_obs = jax.nn.one_hot(x, self.L, dtype=log_phi.dtype) @ log_phi.T  # [T, K]
         consistent = g[:, None] == jnp.asarray(self.groups)[None, :]  # [T, K]
-        log_pi = safe_log(params["p_1k"])
-        log_A = safe_log(params["A_ij"])
-        T = log_obs.shape[0]
-
-        if self.gate_mode == "hard":
-            # impossible destinations: masked emission (clean gating);
-            # log_A stays homogeneous 2-D so the scan kernels keep it
-            # closed over instead of threading T-1 slices through xs
-            log_obs = jnp.where(consistent, log_obs, MASK_NEG)
-            return log_pi, log_A, log_obs
-
-        # Stan-parity mode: transition factor applied only on consistent
-        # destinations; inconsistent ones keep the emission term with a
-        # unit transition factor — expressed as a per-step transition
-        # matrix A_t[i, j] = consistent[t+1, j] ? A[i, j] : 1.
-        log_A_t = jnp.where(consistent[1:, None, :], log_A[None, :, :], 0.0)
-        return log_pi, log_A_t, log_obs
+        return semisup_gate(
+            safe_log(params["p_1k"]),
+            safe_log(params["A_ij"]),
+            log_obs,
+            consistent,
+            self.gate_mode,
+        )
 
     def build_vg(self, params, data):
         """Hot-loop build: stan-mode group gating via gate keys (the vg

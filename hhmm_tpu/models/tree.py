@@ -1,0 +1,312 @@
+"""Fit any HHMM structure directly — the reference's missing capability.
+
+`hhmm/main.R:129,280` and `hhmm/sim-jangmin2004.R:1965` call Stan models
+(`hhmm/stan/hhmm-semisup.stan`, `hhmm-unsup.stan`) that do not exist in
+the repository (SURVEY.md §2.8 item 4); the closest analog is the flat
+4-state `hmm-multinom-semisup.stan`. :class:`TreeHMM` provides what
+those files were meant to: given a finalized
+:class:`~hhmm_tpu.hhmm.structure.Internal` tree, it
+
+- treats the tree's numeric pi/A entries as *structure* (zero = forced,
+  nonzero = free) and as chain-init values,
+- exposes one constrained parameter per free slot: a
+  :class:`~hhmm_tpu.core.bijectors.MaskedSimplex` per internal-node pi
+  and per sibling-transition row (deterministic rows — support size
+  1 — cost no parameters, exactly like the Tayal sparse A's forced
+  entries),
+- assembles the flat sparse (π, A) *inside the NUTS target* via the
+  differentiable :func:`~hhmm_tpu.hhmm.compile.compile_params`, so HMC
+  samples the hierarchy's own parameters, not the expanded matrix
+  (gradients flow through the expansion algebra),
+- supports Gaussian leaves (ordered-mean identifiability, globally or
+  per top-state group — Stan's ``ordered[K] mu_k``, `hmm/stan/hmm.stan:20`)
+  and categorical leaves (per-leaf simplex rows,
+  `hmm/stan/hmm-multinom.stan:21`),
+- optionally conditions on observed top-state labels g[t]
+  (``semisup=True``) with the reference's gating semantics
+  (`hmm/stan/hmm-multinom-semisup.stan:42-44`): ``gate_mode="stan"``
+  skips the transition factor on inconsistent destinations (and is
+  Pallas-eligible via gate keys on the fused hot loop);
+  ``gate_mode="hard"`` forbids them.
+
+The hierarchy stays the source of truth for model structure; the TPU
+only ever sees a flat HMM driven by the scan kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hhmm_tpu.core.bijectors import (
+    Bijector,
+    Identity,
+    MaskedSimplex,
+    Ordered,
+    Positive,
+    Simplex,
+)
+from hhmm_tpu.core.dists import normal_logpdf
+from hhmm_tpu.core.lmath import safe_log
+from hhmm_tpu.hhmm.compile import (
+    categorical_leaf_params,
+    compile_hhmm,
+    compile_params,
+    gaussian_leaf_params,
+)
+from hhmm_tpu.hhmm.structure import End, Internal, Production, iter_leaves
+from hhmm_tpu.models.base import BaseHMMModel, semisup_gate
+
+__all__ = ["TreeHMM"]
+
+
+def _internal_nodes(root: Internal) -> List[Internal]:
+    out = [root]
+
+    def visit(node: Internal):
+        for child in node.children:
+            if isinstance(child, Internal):
+                out.append(child)
+                visit(child)
+
+    visit(root)
+    return out
+
+
+class TreeHMM(BaseHMMModel):
+    """NUTS-fittable model over an HHMM structure tree.
+
+    ``root`` must be finalized; its numeric pi/A double as structural
+    support and chain-init values. ``order_mu`` ∈ {"global", "group",
+    "none"} (Gaussian leaves only; default "group" when ``semisup``
+    else "global").
+    """
+
+    def __init__(
+        self,
+        root: Internal,
+        semisup: bool = False,
+        gate_mode: str = "stan",
+        order_mu: Optional[str] = None,
+    ):
+        if gate_mode not in ("stan", "hard"):
+            raise ValueError("gate_mode must be 'stan' or 'hard'")
+        self.root = root
+        self.flat0 = compile_hhmm(root)  # numeric spec compile: init + groups
+        self.K = self.flat0.K
+        self.leaves = self.flat0.leaves
+        self.groups = self.flat0.groups
+        self.semisup = semisup
+        self.gate_mode = gate_mode
+
+        fams = {(leaf.obs[0] if isinstance(leaf.obs, tuple) else "callable") for leaf in self.leaves}
+        if fams == {"gaussian"}:
+            self.family = "gaussian"
+        elif fams == {"categorical"}:
+            self.family = "categorical"
+        else:
+            raise ValueError(
+                f"TreeHMM needs homogeneous gaussian or categorical leaves, got {fams}"
+            )
+        if order_mu is None:
+            order_mu = "group" if semisup else "global"
+        if order_mu not in ("global", "group", "none"):
+            raise ValueError("order_mu must be 'global', 'group', or 'none'")
+        self.order_mu = order_mu
+        if self.family == "categorical":
+            Ls = {len(np.asarray(leaf.obs[1]["phi"])) for leaf in self.leaves}
+            if len(Ls) != 1:
+                raise ValueError(f"categorical leaves disagree on L: {Ls}")
+            self.L = Ls.pop()
+
+        # group blocks must be contiguous in leaf (DFS) order for the
+        # per-group ordered-mean bijectors
+        self._group_sizes = []
+        g = np.asarray(self.groups)
+        if self.order_mu == "group":
+            boundaries = np.flatnonzero(np.diff(g)) + 1
+            blocks = np.split(g, boundaries)
+            if len({b[0] for b in blocks}) != len(blocks):
+                raise ValueError("top-state groups are not contiguous in leaf order")
+            self._group_sizes = [len(b) for b in blocks]
+
+        # free probability slots, in deterministic node-DFS order
+        self._inodes = _internal_nodes(root)
+        self._slots: List[Tuple[str, str, int, int, np.ndarray]] = []
+        # (param_name, kind, node_idx, row_idx, support)
+        for d, node in enumerate(self._inodes):
+            pi_support = np.asarray(node.pi) > 0.0
+            if pi_support.sum() > 1:
+                self._slots.append((f"pi_n{d}", "pi", d, -1, pi_support))
+            for i, child in enumerate(node.children):
+                if isinstance(child, End):
+                    continue
+                row_support = np.asarray(node.A[i]) > 0.0
+                if row_support.sum() > 1:
+                    self._slots.append((f"A_n{d}_r{i}", "A", d, i, row_support))
+
+    # ---- parameters ----
+
+    def specs(self) -> List[Tuple[str, Bijector]]:
+        out: List[Tuple[str, Bijector]] = [
+            (name, MaskedSimplex(support)) for name, _, _, _, support in self._slots
+        ]
+        if self.family == "gaussian":
+            if self.order_mu == "global":
+                out.append(("mu", Ordered(shape=(self.K,))))
+            elif self.order_mu == "group":
+                for gi, sz in enumerate(self._group_sizes):
+                    out.append((f"mu_g{gi}", Ordered(shape=(sz,))))
+            else:
+                out.append(("mu", Identity(shape=(self.K,))))
+            out.append(("sigma", Positive(shape=(self.K,), lower=1e-4)))
+        else:
+            out.append(("phi_k", Simplex(shape=(self.K, self.L))))
+        return out
+
+    def spec_params(self) -> Dict[str, np.ndarray]:
+        """Constrained parameter dict at the tree's own numeric values —
+        chain-init center and the fixture for structure tests."""
+        params: Dict[str, np.ndarray] = {}
+        for name, kind, d, i, _ in self._slots:
+            node = self._inodes[d]
+            params[name] = np.asarray(node.pi if kind == "pi" else node.A[i], dtype=np.float64)
+        if self.family == "gaussian":
+            mu, sigma = gaussian_leaf_params(self.flat0)
+            if self.order_mu == "group":
+                start = 0
+                for gi, sz in enumerate(self._group_sizes):
+                    params[f"mu_g{gi}"] = np.sort(mu[start : start + sz])
+                    start += sz
+            elif self.order_mu == "global":
+                params["mu"] = np.sort(mu)
+            else:
+                params["mu"] = mu
+            params["sigma"] = sigma
+        else:
+            params["phi_k"] = categorical_leaf_params(self.flat0)
+        return params
+
+    def _mu(self, params) -> jnp.ndarray:
+        if self.order_mu == "group":
+            return jnp.concatenate(
+                [params[f"mu_g{gi}"] for gi in range(len(self._group_sizes))]
+            )
+        return params["mu"]
+
+    # ---- assembly ----
+
+    def assemble(self, params) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Flat (pi, A) from the free slots via the differentiable
+        tree expansion."""
+        pi_vals: Dict[int, jnp.ndarray] = {}
+        A_rows: Dict[Tuple[int, int], jnp.ndarray] = {}
+        for name, kind, d, i, _ in self._slots:
+            if kind == "pi":
+                pi_vals[d] = params[name]
+            else:
+                A_rows[(d, i)] = params[name]
+        node_idx = {id(n): d for d, n in enumerate(self._inodes)}
+
+        def pi_of(node):
+            d = node_idx[id(node)]
+            if d in pi_vals:
+                return pi_vals[d]
+            return jnp.asarray(node.pi)  # deterministic (support size 1)
+
+        def A_of(node):
+            d = node_idx[id(node)]
+            rows = []
+            for i in range(len(node.children)):
+                if (d, i) in A_rows:
+                    rows.append(A_rows[(d, i)])
+                else:
+                    rows.append(jnp.asarray(node.A[i]))  # deterministic or End row
+            return jnp.stack(rows)
+
+        return compile_params(self.root, pi_of, A_of)
+
+    def _log_obs(self, params, x) -> jnp.ndarray:
+        if self.family == "gaussian":
+            mu, sigma = self._mu(params), params["sigma"]
+            return normal_logpdf(x[:, None], mu[None, :], sigma[None, :])
+        x = x.astype(jnp.int32)
+        log_phi = safe_log(params["phi_k"])  # [K, L]
+        # one-hot matmul: MXU-matmul VJP instead of a scatter
+        return jax.nn.one_hot(x, self.L, dtype=log_phi.dtype) @ log_phi.T
+
+    def build(self, params, data):
+        pi, A = self.assemble(params)
+        log_obs = self._log_obs(params, data["x"])
+        log_pi, log_A = safe_log(pi), safe_log(A)
+        if not self.semisup:
+            return log_pi, log_A, log_obs, data.get("mask")
+        g = data["g"].astype(jnp.int32)  # [T] observed top-state labels
+        consistent = g[:, None] == jnp.asarray(self.groups)[None, :]  # [T, K]
+        gated = semisup_gate(log_pi, log_A, log_obs, consistent, self.gate_mode)
+        return (*gated, data.get("mask"))
+
+    def build_vg(self, params, data):
+        """Hot-loop build: semisup stan-mode gating moves to gate keys so
+        ``log_A`` stays homogeneous (Pallas-eligible)."""
+        if not self.semisup or self.gate_mode == "hard":
+            return self.build(params, data)
+        pi, A = self.assemble(params)
+        log_obs = self._log_obs(params, data["x"])
+        return safe_log(pi), safe_log(A), log_obs, data.get("mask")
+
+    def gate_keys(self, data):
+        if not self.semisup or self.gate_mode == "hard":
+            return None
+        g = jnp.asarray(data["g"], jnp.float32)
+        return g, jnp.asarray(self.groups, jnp.float32)
+
+    # ---- init ----
+
+    def init_unconstrained(self, key, data):
+        """Chain init mirroring the reference's k-means discipline
+        (`hmm/main.R:37-47`, `iohmm-mix/R/iohmm-mix-init.R`): probability
+        slots start at the tree's own values; Gaussian means at ordered
+        k-means centers (assigned to group blocks in order for
+        ``order_mu="group"`` — the nested-k-means analog), sigmas at
+        within-cluster sds; categorical rows at the leaf spec with
+        Dirichlet jitter."""
+        params = self.spec_params()
+        x = np.asarray(data["x"], dtype=np.float64)
+        if self.family == "gaussian":
+            from scipy.cluster.vq import kmeans2
+
+            centers, labels = kmeans2(x.reshape(-1, 1), self.K, minit="++", seed=0)
+            order = np.argsort(centers[:, 0])
+            centers = centers[order, 0]
+            sds = np.array(
+                [
+                    max(float(np.std(x[labels == order[k]])), 1e-2)
+                    if np.any(labels == order[k])
+                    else float(np.std(x))
+                    for k in range(self.K)
+                ]
+            )
+            # break ties so Ordered.inverse sees strict increase
+            centers = centers + 1e-6 * np.arange(self.K)
+            jit = 0.05 * np.asarray(jax.random.normal(key, (self.K,)))
+            if self.order_mu == "group":
+                start = 0
+                for gi, sz in enumerate(self._group_sizes):
+                    params[f"mu_g{gi}"] = np.sort(centers[start : start + sz] + jit[start : start + sz])
+                    start += sz
+            elif self.order_mu == "global":
+                params["mu"] = np.sort(centers + jit)
+            else:
+                params["mu"] = centers + jit
+            params["sigma"] = sds
+        else:
+            noise = np.asarray(
+                jax.random.dirichlet(key, jnp.ones(self.L) * 20.0, (self.K,))
+            )
+            params["phi_k"] = 0.8 * params["phi_k"] + 0.2 * noise
+            params["phi_k"] /= params["phi_k"].sum(axis=1, keepdims=True)
+        return self.pack(params)
