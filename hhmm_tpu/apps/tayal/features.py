@@ -82,10 +82,26 @@ def extract_features(
     size: np.ndarray,
     t_seconds: np.ndarray,
     alpha: float = 0.25,
+    engine: str = "auto",
 ) -> ZigZag:
     """``price``/``size``/``t_seconds`` are per-tick arrays (timestamps
     in seconds, any origin). ``alpha`` is the volume-ratio threshold
-    (`tayal2009/main.R:24` uses 0.25)."""
+    (`tayal2009/main.R:24` uses 0.25).
+
+    ``engine``: "auto" uses the native C++ extractor
+    (:mod:`hhmm_tpu.native.zigzag`) when its library is available and
+    falls back to NumPy; "native" requires it; "numpy" forces the
+    reference implementation (the oracle the native path is pinned to).
+    """
+    if engine not in ("auto", "native", "numpy"):
+        raise ValueError("engine must be 'auto', 'native', or 'numpy'")
+    if engine != "numpy":
+        from hhmm_tpu.native import zigzag as _nz
+
+        if _nz.available():
+            return _nz.extract_features_native(price, size, t_seconds, alpha)
+        if engine == "native":
+            raise RuntimeError("native zigzag library unavailable")
     price = np.asarray(price, dtype=np.float64)
     size = np.asarray(size, dtype=np.float64)
     t_seconds = np.asarray(t_seconds, dtype=np.float64)

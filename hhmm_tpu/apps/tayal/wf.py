@@ -102,9 +102,27 @@ def wf_trade(
         key = jax.random.PRNGKey(0)
 
     model = TayalHHMMLite(gate_mode=gate_mode)
+
+    # feature extraction for the whole task list in one native threaded
+    # batch when the C++ extractor is available (the reference runs this
+    # per-task inside its socket workers, `wf-trade.R:44-61`)
+    from hhmm_tpu.native import zigzag as _nz
+
+    if _nz.available():
+        zigs = _nz.extract_features_batch(
+            [(t.price, t.size, t.t_seconds) for t in tasks], alpha=alpha
+        )
+        for z in zigs:
+            if isinstance(z, Exception):
+                raise z
+    else:
+        zigs = [
+            extract_features(t.price, t.size, t.t_seconds, alpha=alpha, engine="numpy")
+            for t in tasks
+        ]
+
     feats, datasets = [], []
-    for task in tasks:
-        zig = extract_features(task.price, task.size, task.t_seconds, alpha=alpha)
+    for task, zig in zip(tasks, zigs):
         x, sign = to_model_inputs(zig.feature)
         ins = zig.end <= task.ins_end_tick
         n_ins = int(ins.sum())

@@ -1,0 +1,75 @@
+"""Native (C++) host-side runtime components.
+
+The reference's only native layer is the Stan C++ sampler RStan compiles
+per model (SURVEY.md §1); in the TPU framework the sampler lives on
+device (JAX/Pallas), and the native layer instead covers the host-side
+data path: zig-zag feature extraction and the threaded batch loader
+(`hhmm_tpu/native/zigzag.cpp`), the stage the reference itself flags as
+its bottleneck (`tayal2009/R/feature-extraction.R:112`).
+
+The shared library is compiled on first import with the system g++
+(`-O3 -shared -fPIC -pthread`) and cached next to the source keyed by
+source mtime. :func:`load` returns the ctypes handle or ``None`` when no
+compiler is available — callers fall back to the NumPy implementations.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "zigzag.cpp")
+_LIB = os.path.join(_DIR, "_zigzag.so")
+
+_handle: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    # compile to a process-private temp path then os.replace (atomic on
+    # POSIX): a concurrent builder must never expose a half-written ELF
+    # to another process's dlopen
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+             _SRC, "-o", tmp],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.replace(tmp, _LIB)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The compiled library handle, building it if stale/missing;
+    ``None`` if compilation is unavailable."""
+    global _handle, _tried
+    if _handle is not None:
+        return _handle
+    if _tried:
+        return None
+    _tried = True
+    stale = (
+        not os.path.exists(_LIB)
+        or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+    )
+    if stale and not _build():
+        return None
+    try:
+        _handle = ctypes.CDLL(_LIB)
+    except OSError:
+        return None
+    return _handle

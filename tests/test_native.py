@@ -1,0 +1,90 @@
+"""Native C++ zig-zag extractor (native/zigzag.cpp) vs the NumPy oracle
+(apps/tayal/features.py). Skipped when no compiler is available."""
+
+import numpy as np
+import pytest
+
+from hhmm_tpu.apps.tayal.features import extract_features, to_model_inputs
+from hhmm_tpu.native import zigzag as nz
+
+pytestmark = pytest.mark.skipif(
+    not nz.available(), reason="native zigzag library unavailable"
+)
+
+_FIELDS = ("price", "start", "end", "size_av", "f0", "f1", "f2", "feature", "trend")
+
+
+def _sim(rng, T):
+    price = 10 + 0.01 * np.round(
+        np.cumsum(rng.choice([-1, 0, 1], T, p=[0.4, 0.2, 0.4])), 2
+    )
+    size = rng.integers(1, 500, T).astype(float)
+    t = np.cumsum(rng.exponential(2.0, T))
+    return price, size, t
+
+
+class TestNativeParity:
+    def test_random_series_exact_match(self, rng):
+        checked = 0
+        for _ in range(25):
+            T = int(rng.integers(60, 4000))
+            p, s, t = _sim(rng, T)
+            try:
+                ref = extract_features(p, s, t, engine="numpy")
+            except ValueError as e:
+                with pytest.raises(ValueError, match=str(e)):
+                    nz.extract_features_native(p, s, t)
+                continue
+            nat = nz.extract_features_native(p, s, t)
+            for f in _FIELDS:
+                np.testing.assert_array_equal(
+                    getattr(ref, f), getattr(nat, f), err_msg=f
+                )
+            checked += 1
+        assert checked >= 10
+
+    def test_alpha_sensitivity(self, rng):
+        p, s, t = _sim(rng, 2000)
+        for alpha in (0.1, 0.25, 0.6):
+            ref = extract_features(p, s, t, alpha=alpha, engine="numpy")
+            nat = nz.extract_features_native(p, s, t, alpha=alpha)
+            np.testing.assert_array_equal(ref.feature, nat.feature)
+
+    def test_error_codes(self):
+        with pytest.raises(ValueError, match="at least 3 ticks"):
+            nz.extract_features_native(
+                np.array([1.0, 2.0]), np.ones(2), np.arange(2.0)
+            )
+        flat = np.full(100, 5.0)
+        with pytest.raises(ValueError, match="too few direction changes"):
+            nz.extract_features_native(flat, np.ones(100), np.arange(100.0))
+
+    def test_auto_engine_dispatches_native(self, rng):
+        p, s, t = _sim(rng, 1500)
+        auto = extract_features(p, s, t)  # engine="auto"
+        ref = extract_features(p, s, t, engine="numpy")
+        np.testing.assert_array_equal(auto.feature, ref.feature)
+        x, sign = to_model_inputs(auto.feature)
+        assert x.min() >= 0 and x.max() <= 8
+        assert set(np.unique(sign)) <= {0, 1}
+
+
+class TestBatch:
+    def test_batch_matches_single(self, rng):
+        batch = [_sim(rng, int(rng.integers(400, 2500))) for _ in range(16)]
+        outs = nz.extract_features_batch(batch, n_threads=4)
+        for (p, s, t), o in zip(batch, outs):
+            ref = extract_features(p, s, t, engine="numpy")
+            for f in _FIELDS:
+                np.testing.assert_array_equal(getattr(ref, f), getattr(o, f))
+
+    def test_batch_per_series_errors(self, rng):
+        good = _sim(rng, 800)
+        bad = (np.full(50, 3.0), np.ones(50), np.arange(50.0))
+        outs = nz.extract_features_batch([good, bad, good])
+        assert not isinstance(outs[0], Exception)
+        assert isinstance(outs[1], ValueError)
+        assert not isinstance(outs[2], Exception)
+
+    def test_empty_batch(self):
+        assert nz.extract_features_batch([]) == []
