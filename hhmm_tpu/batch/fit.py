@@ -115,8 +115,11 @@ def fit_batched(
     def run_chunk(chunk_data, chunk_init, chunk_keys):
         def one(args):
             per_series, qi, ki = args
-            logp = model.make_logp(per_series)
-            return sample_nuts(logp, ki, qi, config, jit=False)
+            # fused value-and-grad hot loop (kernels/vg.py): the nested
+            # series x chains vmap collapses into one flat batch and runs
+            # the Pallas TPU kernel when eligible
+            vg = model.make_vg(per_series)
+            return sample_nuts(None, ki, qi, config, jit=False, vg_fn=vg)
 
         return jax.vmap(lambda *xs: one((dict(zip(data_keys, xs[:-2])), xs[-2], xs[-1])))(
             *[chunk_data[k] for k in data_keys], chunk_init, chunk_keys
@@ -155,6 +158,10 @@ def fit_batched(
             {k: np.asarray(v) for k, v in chunk_data.items()},
             vars(config),
             np.asarray(chunk_keys),
+            "sampler=vg-v1",  # sampling-path identity: bump when the
+            # draw-producing path changes so stale cache entries from a
+            # numerically different (if statistically equivalent) path
+            # are never mixed into a resumed sweep
         )
         hit = cache.get(ck)
         if hit is not None:

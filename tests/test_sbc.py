@@ -1,0 +1,179 @@
+"""Simulation-based calibration (Cook, Gelman & Rubin 2006) as a test
+suite — the reference's core verification discipline (SURVEY.md §4.1)
+promoted to an automated check.
+
+For models whose priors are proper (flat Dirichlet/uniform on the
+constrained space), draw theta ~ prior, simulate data | theta, fit the
+posterior, and rank theta among (thinned) posterior draws: over
+replications the ranks must be uniform. All replications run as ONE
+batched NUTS program (`fit_batched`), so the suite doubles as an
+integration test of the batch engine on heterogeneous simulated data.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.stats import kstest
+
+from hhmm_tpu.batch import fit_batched
+from hhmm_tpu.infer import SamplerConfig
+from hhmm_tpu.models import MultinomialHMM, TayalHHMM
+from hhmm_tpu.models.tayal import _UP_STATES, UP
+from hhmm_tpu.sim import hmm_sim, obsmodel_categorical
+
+N_REPS = 12
+THIN = 4
+
+
+def _ranks(theta_true: np.ndarray, draws: np.ndarray) -> np.ndarray:
+    """Rank of each true scalar among its thinned posterior draws,
+    normalized to (0, 1). ``theta_true`` [P], ``draws`` [S, P]."""
+    thinned = draws[::THIN]
+    r = (thinned < theta_true[None, :]).sum(axis=0)
+    return (r + 0.5) / (thinned.shape[0] + 1)
+
+
+def _uniformity_ok(u: np.ndarray) -> None:
+    # loose gates: tiny-budget MCMC ranks are noisy; catastrophic
+    # miscalibration (systematic bias, over/under-dispersion) still fails
+    assert 0.30 < u.mean() < 0.70, f"rank mean {u.mean():.3f}"
+    p = kstest(u, "uniform").pvalue
+    assert p > 1e-3, f"KS uniformity p={p:.2e}"
+
+
+class TestSBCTayal:
+    def test_rank_uniformity(self, rng):
+        """Tayal sparse HMM, hard gating: priors are uniform on (0,1) /
+        the simplex, so prior draws + `hmm_sim` from the assembled
+        sparse (pi, A) give exact joint samples."""
+        model = TayalHHMM(gate_mode="hard")
+        datasets, trues = [], []
+        for _ in range(N_REPS):
+            p11 = rng.uniform()
+            A_row = rng.dirichlet(np.ones(2), size=2)
+            phi = rng.dirichlet(np.ones(9), size=4)
+            params = {
+                "p_11": jnp.asarray(p11),
+                "A_row": jnp.asarray(A_row),
+                "phi_k": jnp.asarray(phi),
+            }
+            pi, A = model.assemble(params)
+            z, x = hmm_sim(
+                jax.random.PRNGKey(int(rng.integers(1 << 30))),
+                300,
+                np.asarray(A),
+                np.asarray(pi),
+                obsmodel_categorical(phi),
+                validate=False,
+            )
+            sign = np.where(_UP_STATES[np.asarray(z)], UP, 1 - UP)
+            datasets.append(
+                {
+                    "x": np.asarray(x, dtype=np.int32),
+                    "sign": sign.astype(np.int32),
+                    "mask": np.ones(300, np.float32),
+                }
+            )
+            trues.append(
+                np.concatenate([[p11], [A_row[0, 0], A_row[1, 0]], phi[:, 0], [phi[2, 4]]])
+            )
+        data = {
+            k: jnp.asarray(np.stack([d[k] for d in datasets])) for k in datasets[0]
+        }
+        cfg = SamplerConfig(
+            num_warmup=150, num_samples=200, num_chains=1, max_treedepth=7
+        )
+        qs, stats = fit_batched(model, data, jax.random.PRNGKey(0), cfg, chunk_size=N_REPS)
+        assert float(np.asarray(stats["diverging"]).mean()) < 0.1
+
+        units = []
+        for i in range(N_REPS):
+            draws = model.constrained_draws(qs[i])
+            flat = np.column_stack(
+                [
+                    np.asarray(draws["p_11"]).reshape(-1),
+                    np.asarray(draws["A_row"]).reshape(-1, 4)[:, 0],
+                    np.asarray(draws["A_row"]).reshape(-1, 4)[:, 2],
+                    *[np.asarray(draws["phi_k"]).reshape(-1, 4, 9)[:, k, 0] for k in range(4)],
+                    np.asarray(draws["phi_k"]).reshape(-1, 4, 9)[:, 2, 4],
+                ]
+            )
+            units.append(_ranks(trues[i], flat))
+        _uniformity_ok(np.concatenate(units))
+
+
+class TestSBCMultinomial:
+    def test_rank_uniformity(self, rng):
+        K, L, T = 2, 3, 250
+        model = MultinomialHMM(K=K, L=L)
+        datasets, trues = [], []
+        for _ in range(N_REPS):
+            p1 = rng.dirichlet(np.ones(K))
+            A = rng.dirichlet(np.ones(K), size=K)
+            phi = rng.dirichlet(np.ones(L), size=K)
+            z, x = hmm_sim(
+                jax.random.PRNGKey(int(rng.integers(1 << 30))),
+                T,
+                A,
+                p1,
+                obsmodel_categorical(phi),
+                validate=False,
+            )
+            datasets.append(
+                {"x": np.asarray(x, dtype=np.int32), "mask": np.ones(T, np.float32)}
+            )
+            trues.append(np.concatenate([[p1[0]], [A[0, 0], A[1, 1]], phi[:, 0]]))
+        data = {
+            k: jnp.asarray(np.stack([d[k] for d in datasets])) for k in datasets[0]
+        }
+        cfg = SamplerConfig(
+            num_warmup=150, num_samples=200, num_chains=1, max_treedepth=7
+        )
+        qs, stats = fit_batched(model, data, jax.random.PRNGKey(1), cfg, chunk_size=N_REPS)
+        assert float(np.asarray(stats["diverging"]).mean()) < 0.1
+
+        # label switching: the multinomial posterior is invariant under
+        # state permutation; canonicalize each draw by sorting states on
+        # phi[:, 0] and canonicalize the truth identically
+        units = []
+        for i in range(N_REPS):
+            draws = model.constrained_draws(qs[i])
+            p1d = np.asarray(draws["p_1k"]).reshape(-1, K)
+            Ad = np.asarray(draws["A_ij"]).reshape(-1, K, K)
+            phid = np.asarray(draws["phi_k"]).reshape(-1, K, L)
+            order = np.argsort(phid[:, :, 0], axis=1)  # [S, K]
+            s_idx = np.arange(p1d.shape[0])[:, None]
+            p1d = np.take_along_axis(p1d, order, axis=1)
+            phid = phid[s_idx, order]
+            Ad = Ad[s_idx[:, :, None], order[:, :, None], order[:, None, :]]
+            # canonical truth from the stored raw values
+            raw_p1 = np.array([trues[i][0], 1 - trues[i][0]])
+            raw_A = np.array(
+                [
+                    [trues[i][1], 1 - trues[i][1]],
+                    [1 - trues[i][2], trues[i][2]],
+                ]
+            )
+            raw_phi0 = trues[i][3:5]
+            torder = np.argsort(raw_phi0)
+            flat = np.column_stack(
+                [
+                    p1d[:, 0],
+                    Ad[:, 0, 0],
+                    Ad[:, 1, 1],
+                    phid[:, 0, 0],
+                    phid[:, 1, 0],
+                ]
+            )
+            truth = np.array(
+                [
+                    raw_p1[torder][0],
+                    raw_A[torder][:, torder][0, 0],
+                    raw_A[torder][:, torder][1, 1],
+                    raw_phi0[torder][0],
+                    raw_phi0[torder][1],
+                ]
+            )
+            units.append(_ranks(truth, flat))
+        _uniformity_ok(np.concatenate(units))
