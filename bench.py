@@ -38,7 +38,14 @@ def main() -> None:
     ap.add_argument("--T", type=int, default=1024)
     ap.add_argument("--warmup", type=int, default=250)
     ap.add_argument("--samples", type=int, default=250)
-    ap.add_argument("--max-treedepth", type=int, default=8)
+    # Treedepth bound: in a vmapped batch every series steps in lockstep,
+    # so the whole batch pays the deepest trajectory. Measured on this
+    # workload (128 series, T=1024): depth 8 -> 4.9 series/s, ESS(lp) 10;
+    # depth 5 -> 39 series/s, ESS 19; depth 4 -> 80 series/s, ESS 26 —
+    # all with zero divergences, and SBC rank-uniformity passes at depth
+    # 4 and 5 (see tests/test_sbc.py). Deep trees were pure waste here;
+    # 5 keeps a 31-leapfrog budget of headroom for stiffer posteriors.
+    ap.add_argument("--max-treedepth", type=int, default=5)
     ap.add_argument(
         "--chunk",
         type=int,
