@@ -1,0 +1,83 @@
+"""Shared helpers for the example drivers (the reference's `main.R`
+"Set up" + diagnostics blocks, `hmm/main.R:7-18,59-87`)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+# run from anywhere: the repo root precedes the examples dir on sys.path
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def standard_parser(description: str) -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(description=description)
+    ap.add_argument("--warmup", type=int, default=300)
+    ap.add_argument("--samples", type=int, default=300)
+    ap.add_argument("--chains", type=int, default=2)
+    ap.add_argument("--max-treedepth", type=int, default=6)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--cpu", action="store_true", help="force the CPU backend")
+    ap.add_argument(
+        "--quick", action="store_true", help="tiny budgets for smoke runs"
+    )
+    ap.add_argument(
+        "--plots-dir",
+        default=None,
+        help="write diagnostic PNGs here (default: no plots)",
+    )
+    return ap
+
+
+def configure(args):
+    """Apply --cpu/--quick and return a SamplerConfig."""
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    if args.quick:
+        args.warmup, args.samples, args.chains = 50, 50, 1
+    from hhmm_tpu.infer import SamplerConfig
+
+    return SamplerConfig(
+        num_warmup=args.warmup,
+        num_samples=args.samples,
+        num_chains=args.chains,
+        max_treedepth=args.max_treedepth,
+    )
+
+
+def print_summary(samples: dict, top: int = 12) -> None:
+    """The drivers' `summary(stan.fit)` table."""
+    from hhmm_tpu.infer import summary
+
+    table = summary(samples)
+    print(f"{'param':<18}{'mean':>9}{'sd':>9}{'2.5%':>9}{'50%':>9}{'97.5%':>9}{'n_eff':>8}{'Rhat':>7}")
+    shown = 0
+    for name, st in table.items():
+        means = np.atleast_1d(st["mean"])
+        for i in range(means.shape[0]):
+            if shown >= top:
+                print(f"... ({sum(np.atleast_1d(s['mean']).size for s in table.values())} scalars total)")
+                return
+            label = name if means.shape[0] == 1 else f"{name}[{i}]"
+            print(
+                f"{label:<18}"
+                f"{np.atleast_1d(st['mean'])[i]:>9.3f}{np.atleast_1d(st['sd'])[i]:>9.3f}"
+                f"{np.atleast_1d(st['q2.5'])[i]:>9.3f}{np.atleast_1d(st['q50'])[i]:>9.3f}"
+                f"{np.atleast_1d(st['q97.5'])[i]:>9.3f}"
+                f"{np.atleast_1d(st['n_eff'])[i]:>8.0f}{np.atleast_1d(st['rhat'])[i]:>7.3f}"
+            )
+            shown += 1
+
+
+def save_figure(fig, plots_dir: str | None, name: str) -> None:
+    if plots_dir is None:
+        return
+    os.makedirs(plots_dir, exist_ok=True)
+    path = os.path.join(plots_dir, name)
+    fig.savefig(path, dpi=110, bbox_inches="tight")
+    print(f"wrote {path}")

@@ -1,0 +1,90 @@
+"""CSV data loaders (apps/data_io.py) — the stand-in for the
+reference's quantmod downloads and per-day tick files."""
+
+import numpy as np
+import pytest
+
+from hhmm_tpu.apps.data_io import load_ohlc_csv, load_tick_days, load_ticks_csv
+
+
+@pytest.fixture
+def ohlc_csv(tmp_path):
+    p = tmp_path / "luv.csv"
+    p.write_text(
+        "Date,Open,High,Low,Close,Volume\n"
+        "2005-01-03,16.0,16.5,15.8,16.2,1000\n"
+        "2005-01-04,16.2,16.4,15.9,16.0,1200\n"
+    )
+    return str(p)
+
+
+class TestOHLC:
+    def test_roundtrip(self, ohlc_csv):
+        ohlc = load_ohlc_csv(ohlc_csv)
+        np.testing.assert_allclose(
+            ohlc, [[16.0, 16.5, 15.8, 16.2], [16.2, 16.4, 15.9, 16.0]]
+        )
+
+    def test_feeds_make_dataset(self, ohlc_csv):
+        from hhmm_tpu.apps.hassan.data import make_dataset
+
+        ds = make_dataset(load_ohlc_csv(ohlc_csv), scale=False)
+        np.testing.assert_allclose(ds.x, [16.0])
+        np.testing.assert_allclose(ds.u, [[16.0, 16.5, 15.8, 16.2]])
+
+    def test_high_below_low_rejected(self, tmp_path):
+        p = tmp_path / "bad.csv"
+        p.write_text("open,high,low,close\n10,9,11,10\n")
+        with pytest.raises(ValueError, match="high < low"):
+            load_ohlc_csv(str(p))
+
+    def test_missing_column(self, tmp_path):
+        p = tmp_path / "bad.csv"
+        p.write_text("open,high,low\n1,2,3\n")
+        with pytest.raises(ValueError, match="close"):
+            load_ohlc_csv(str(p))
+
+    def test_exact_name_beats_dotted_suffix(self, tmp_path):
+        """An earlier 'adj.close' must not shadow the exact 'close'."""
+        p = tmp_path / "adj.csv"
+        p.write_text(
+            "date,adj.close,open,high,low,close\n"
+            "2005-01-03,15.0,16.0,16.5,15.8,16.2\n"
+        )
+        ohlc = load_ohlc_csv(str(p))
+        assert ohlc[0, 3] == 16.2
+
+
+class TestTicks:
+    def test_hms_and_numeric_times(self, tmp_path):
+        p = tmp_path / "t.csv"
+        p.write_text(
+            "time,price,size\n09:30:00,20.00,100\n09:30:01.5,20.01,50\n09:30:03,20.00,75\n"
+        )
+        d = load_ticks_csv(str(p))
+        np.testing.assert_allclose(d["t_seconds"], [34200.0, 34201.5, 34203.0])
+        np.testing.assert_allclose(d["price"], [20.0, 20.01, 20.0])
+        p2 = tmp_path / "n.csv"
+        p2.write_text("time,price,size\n0,20.0,1\n2.5,20.1,2\n")
+        np.testing.assert_allclose(load_ticks_csv(str(p2))["t_seconds"], [0.0, 2.5])
+
+    def test_unsorted_rejected(self, tmp_path):
+        p = tmp_path / "t.csv"
+        p.write_text("time,price,size\n5,20.0,1\n3,20.1,2\n")
+        with pytest.raises(ValueError, match="not sorted"):
+            load_ticks_csv(str(p))
+
+    def test_day_directory(self, tmp_path):
+        for day, px in (("2007.05.02", 20.0), ("2007.05.01", 19.0)):
+            (tmp_path / f"G.TO.{day}.csv").write_text(
+                f"time,price,size\n1,{px},10\n2,{px + 0.01},20\n"
+            )
+        days = load_tick_days(str(tmp_path), symbol="G.TO")
+        assert len(days) == 2
+        # ordered by embedded date, not listing order
+        assert days[0]["price"][0] == 19.0
+        assert days[1]["price"][0] == 20.0
+
+    def test_day_directory_empty(self, tmp_path):
+        with pytest.raises(ValueError, match="no matching"):
+            load_tick_days(str(tmp_path))

@@ -1,0 +1,34 @@
+"""Example driver scripts (examples/) — compile-check all, run one end
+to end (the rest exercise the same library surface already covered by
+the app tests; a full subprocess run of each would dominate suite
+time)."""
+
+import os
+import py_compile
+import subprocess
+import sys
+
+import pytest
+
+_EXAMPLES = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples")
+
+
+def test_all_examples_compile():
+    scripts = [f for f in os.listdir(_EXAMPLES) if f.endswith(".py")]
+    assert len(scripts) >= 7
+    for f in scripts:
+        py_compile.compile(os.path.join(_EXAMPLES, f), doraise=True)
+
+
+def test_hmm_main_quick_runs():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_EXAMPLES, "hmm_main.py"), "--cpu", "--quick", "--T", "300"],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "filtered accuracy" in out.stdout
+    assert "divergence rate" in out.stdout
