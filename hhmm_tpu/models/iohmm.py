@@ -61,11 +61,15 @@ class _IOHMMBase(BaseHMMModel):
         self.M = M
         self.trans_mode = trans_mode
 
+    def _log_a(self, params, data):
+        """Per-step transition vector ``log softmax(u_t · w)`` [T, K] —
+        the single source of the transition parameterization for both
+        likelihood paths (build / build_vg)."""
+        return jax.nn.log_softmax(data["u"] @ params["w_km"].T, axis=-1)
+
     def _log_A_t(self, params, data):
         """Rank-1 time-varying transition matrices [T-1, K, K]."""
-        u = data["u"]  # [T, M]
-        logits = u @ params["w_km"].T  # [T, K]
-        log_a = jax.nn.log_softmax(logits, axis=-1)[1:]  # slices for t=1..T-1
+        log_a = self._log_a(params, data)[1:]  # slices for t=1..T-1
         if self.trans_mode == "stan":
             # indexed by previous state i (`iohmm-reg.stan:71`)
             return jnp.broadcast_to(
@@ -84,6 +88,43 @@ class _IOHMMBase(BaseHMMModel):
             self._log_obs(params, data),
             data.get("mask"),
         )
+
+    def build_vg(self, params, data):
+        """Hot-loop build: the rank-1 transition collapses into the
+        emissions, so the fused homogeneous-A kernel applies.
+
+        With every row of ``A_t`` identical (stan mode: constant over
+        the destination j; gen mode: constant over the source i), the
+        forward update factorizes as ``alpha_t = logsumexp(alpha_{t-1})
+        + (a-term) + obs_t``, which is exactly the homogeneous recursion
+        with ``log_A = 0`` and the per-step vector folded into an
+        effective emission:
+
+        - gen:  ``obs'[t] = obs[t] + log a_t`` (t >= 1);
+        - stan: ``a_t`` is indexed by the PREVIOUS state, so it attaches
+          to step t-1's alpha: ``obs'[t-1] = obs[t-1] + mask[t]*log a_t``
+          (the mask factor drops transition terms of padding steps,
+          which the masked time-varying recursion never applies).
+
+        Only the final alpha (the loglik) is preserved by this
+        rewriting — intermediate filters differ — which is all the vg
+        op reports; gradients to w/b/obs flow through the same fold via
+        the vjp in :meth:`BaseHMMModel.make_vg`.
+        """
+        log_pi = safe_log(params["p_1k"])
+        log_obs = self._log_obs(params, data)
+        log_a = self._log_a(params, data)  # [T, K]
+        mask = data.get("mask")
+        if log_obs.shape[0] > 1:
+            if self.trans_mode == "stan":
+                nxt = log_a[1:]
+                if mask is not None:
+                    nxt = nxt * mask[1:, None]
+                log_obs = log_obs.at[:-1].add(nxt)
+            else:
+                log_obs = log_obs.at[1:].add(log_a[1:])
+        log_A0 = jnp.zeros((self.K, self.K), log_obs.dtype)
+        return log_pi, log_A0, log_obs, mask
 
     def oblik_t(self, params, data):
         """Per-step observation log-likelihood weighted by the normalized

@@ -267,3 +267,52 @@ class TestGatedPath:
         v_ref, g_ref = jax.value_and_grad(model.make_logp(data))(theta)
         np.testing.assert_allclose(float(v), float(v_ref), rtol=1e-5)
         np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), rtol=3e-4, atol=1e-5)
+
+
+class TestIOHMMFold:
+    """The rank-1 IOHMM transition collapses into effective emissions
+    (models/iohmm.py build_vg), making the family homogeneous-A and
+    Pallas-eligible. Exact in f64; f32 tolerances cover reassociation."""
+
+    @pytest.mark.parametrize("mode", ["stan", "gen"])
+    @pytest.mark.parametrize("ragged", [False, True], ids=["dense", "ragged"])
+    def test_vg_matches_autodiff(self, rng, mode, ragged):
+        from hhmm_tpu.apps.hassan.wf import DEFAULT_HYPERPARAMS
+        from hhmm_tpu.models import IOHMMHMix, IOHMMReg
+        from hhmm_tpu.sim import iohmm_sim, obsmodel_reg
+
+        K, M, T = 3, 4, 120
+        u = np.column_stack([np.ones(T), rng.normal(size=(T, M - 1))])
+        sim = iohmm_sim(
+            jax.random.PRNGKey(0), u, rng.normal(size=(K, M)),
+            obsmodel_reg(rng.normal(size=(K, M)), np.full(K, 0.4)),
+        )
+        for model in (
+            IOHMMReg(K=K, M=M, trans_mode=mode),
+            IOHMMHMix(K=K, M=M, L=3, hyperparams=DEFAULT_HYPERPARAMS, trans_mode=mode),
+        ):
+            data = {"u": jnp.asarray(sim["u"]), "x": jnp.asarray(sim["x"])}
+            if ragged:
+                data["mask"] = jnp.asarray((np.arange(T) < 87).astype(np.float32))
+            theta = jnp.asarray(model.init_unconstrained(jax.random.PRNGKey(1), data))
+            v_ref, g_ref = jax.value_and_grad(model.make_logp(data))(theta)
+            v_vg, g_vg = model.make_vg(data)(theta)
+            np.testing.assert_allclose(float(v_ref), float(v_vg), rtol=2e-5)
+            np.testing.assert_allclose(
+                np.asarray(g_ref), np.asarray(g_vg), rtol=2e-3, atol=1e-3
+            )
+
+    def test_single_step_series(self, rng):
+        """T=1: no transitions to fold."""
+        from hhmm_tpu.models import IOHMMReg
+
+        model = IOHMMReg(K=2, M=2)
+        data = {
+            "u": jnp.asarray(rng.normal(size=(1, 2))),
+            "x": jnp.asarray(rng.normal(size=(1,))),
+        }
+        theta = jnp.asarray(model.init_unconstrained(jax.random.PRNGKey(0), data))
+        v_ref, g_ref = jax.value_and_grad(model.make_logp(data))(theta)
+        v_vg, g_vg = model.make_vg(data)(theta)
+        np.testing.assert_allclose(float(v_ref), float(v_vg), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(g_ref), np.asarray(g_vg), rtol=1e-3, atol=1e-4)
