@@ -56,6 +56,13 @@ def main() -> None:
         "chunk saturates the chip)",
     )
     ap.add_argument("--quick", action="store_true", help="tiny config for smoke tests")
+    ap.add_argument(
+        "--profile",
+        default=None,
+        metavar="DIR",
+        help="capture a jax.profiler trace of the timed execution to DIR "
+        "(view with TensorBoard / xprof; SURVEY.md §5 tracing parity)",
+    )
     args = ap.parse_args()
     if args.quick:
         args.series, args.T, args.warmup, args.samples = 8, 128, 20, 20
@@ -114,6 +121,14 @@ def main() -> None:
         logps.append(lp)
         div.append(dv)
     exec_s = time.time() - t0
+
+    if args.profile:
+        # separate non-timed pass: tracing overhead must never distort
+        # the published metric; fresh keys defeat request memoization
+        prof_keys = jax.random.split(jax.random.PRNGKey(1234), chunk)
+        with jax.profiler.trace(args.profile):
+            jax.block_until_ready(run(x[:chunk], sign[:chunk], init[:chunk], prof_keys))
+        print(f"profiler trace written to {args.profile}", file=sys.stderr)
     logps = jnp.concatenate(logps)
     div = jnp.concatenate(div)
 

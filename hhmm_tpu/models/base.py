@@ -28,6 +28,7 @@ import numpy as np
 
 from hhmm_tpu.core.bijectors import Bijector
 from hhmm_tpu.kernels import (
+    ffbs_sample,
     forward_filter,
     forward_loglik,
     backward_pass,
@@ -38,6 +39,23 @@ from hhmm_tpu.kernels import (
 __all__ = ["BaseHMMModel", "semisup_gate"]
 
 Data = Dict[str, jnp.ndarray]
+
+
+def _vmap_over_draws(fn, theta_draws: jnp.ndarray, *extra):
+    """vmap ``fn`` over posterior draws with arbitrary leading axes:
+    ``theta_draws`` is [..., dim] (and each ``extra`` arg [..., rest]);
+    the leading axes are flattened, ``fn`` is vmapped over the flat
+    draw axis, and every output leaf gets the leading axes back."""
+    lead = theta_draws.shape[:-1]
+    flat = theta_draws.reshape(-1, theta_draws.shape[-1])
+    flat_extra = [
+        jnp.asarray(e).reshape((-1,) + jnp.asarray(e).shape[len(lead) :])
+        for e in extra
+    ]
+    out = jax.vmap(fn)(flat, *flat_extra)
+    return jax.tree_util.tree_map(
+        lambda v: v.reshape(lead + v.shape[1:]), out
+    )
 
 
 def semisup_gate(log_pi, log_A, log_obs, consistent, gate_mode: str):
@@ -196,15 +214,32 @@ class BaseHMMModel:
                 "loglik": ll,
             }
 
-        lead = theta_draws.shape[:-1]
-        flat = theta_draws.reshape(-1, theta_draws.shape[-1])
-        out = jax.vmap(one)(flat)
-        return {k: v.reshape(lead + v.shape[1:]) for k, v in out.items()}
+        return _vmap_over_draws(one, theta_draws)
+
+    def state_draws(
+        self, key: jax.Array, theta_draws: jnp.ndarray, data: Data
+    ) -> jnp.ndarray:
+        """Exact joint posterior draws of the state path: one FFBS
+        (forward-filter backward-sample) path per posterior parameter
+        draw — P(z_{1:T} | x, theta_draw) marginal-correctly, unlike the
+        per-step argmax of ``alpha``/``gamma``. The reference reaches
+        state draws implicitly through per-draw generated quantities
+        (SURVEY.md §7.1 item 2); this is the explicit TPU-native path.
+
+        ``theta_draws`` [..., dim]; returns int32 paths [..., T].
+        """
+        n_draws = int(np.prod(theta_draws.shape[:-1], dtype=np.int64))
+        keys = jax.random.split(key, n_draws)
+        keys = keys.reshape(theta_draws.shape[:-1] + keys.shape[1:])
+
+        def one(theta, k):
+            params, _ = self.unpack(theta)
+            log_pi, log_A, log_obs, mask = self.build(params, data)
+            return ffbs_sample(k, log_pi, log_A, log_obs, mask)
+
+        return _vmap_over_draws(one, theta_draws, keys)
 
     def constrained_draws(self, theta_draws: jnp.ndarray) -> Dict[str, jnp.ndarray]:
         """Map [chains, draws, dim] (or [draws, dim]) unconstrained draws to
         constrained parameter arrays with the same leading axes."""
-        lead = theta_draws.shape[:-1]
-        flat = theta_draws.reshape(-1, theta_draws.shape[-1])
-        params = jax.vmap(lambda t: self.unpack(t)[0])(flat)
-        return {k: v.reshape(lead + v.shape[1:]) for k, v in params.items()}
+        return _vmap_over_draws(lambda t: self.unpack(t)[0], theta_draws)

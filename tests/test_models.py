@@ -321,3 +321,21 @@ def test_hyperparams_arity_enforced():
     (SURVEY.md §2.8 item 5) must be a hard error here."""
     with pytest.raises(ValueError, match="9 elements"):
         IOHMMHMix(K=2, M=2, L=2, hyperparams=[0, 5, 1, 0, 3, 1, 1])
+
+
+def test_state_draws_ffbs():
+    """FFBS posterior path draws through the model surface: marginal
+    frequencies of sampled paths must match the smoothed gamma."""
+    rng = np.random.default_rng(3)
+    K, L, T = 2, 3, 80
+    model = MultinomialHMM(K=K, L=L)
+    x = jnp.asarray(rng.integers(0, L, size=T))
+    data = {"x": x}
+    theta = model.init_unconstrained(jax.random.PRNGKey(0), data)
+    draws = jnp.broadcast_to(theta, (2, 200, theta.shape[0]))  # fixed params
+    z = model.state_draws(jax.random.PRNGKey(1), draws, data)
+    assert z.shape == (2, 200, T)
+    gen = model.generated(theta[None, None], data)
+    gamma = np.asarray(gen["gamma"])[0, 0]  # [T, K]
+    freq = np.stack([(np.asarray(z).reshape(-1, T) == k).mean(axis=0) for k in range(K)], axis=1)
+    np.testing.assert_allclose(freq, gamma, atol=0.09)
