@@ -30,6 +30,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from hhmm_tpu.batch.cache import ResultCache, digest_key
+from hhmm_tpu.infer.chees import (
+    ChEESConfig,
+    make_lp_bc,
+    sample_chees,
+    sample_chees_batched,
+)
 from hhmm_tpu.infer.run import SamplerConfig, sample_nuts
 
 __all__ = ["fit_batched"]
@@ -87,6 +93,11 @@ def fit_batched(
     (build with :func:`hhmm_tpu.batch.pad_datasets` for ragged series).
     Returns ``(samples [B, chains, draws, dim], stats)`` with per-series
     leading axes.
+
+    The sampler is selected by the type of ``config``: a
+    :class:`SamplerConfig` runs NUTS, a :class:`ChEESConfig` runs
+    cross-chain-adaptive ChEES-HMC (`infer/chees.py` — the chain axis is
+    per-series, so its adaptation reductions stay within each series).
     """
     data = {k: jnp.asarray(v) for k, v in data.items() if v is not None}
     sizes = {v.shape[0] for v in data.values()}
@@ -112,14 +123,35 @@ def fit_batched(
 
     data_keys = list(data.keys())
 
-    def run_chunk(chunk_data, chunk_init, chunk_keys):
+    chees = isinstance(config, ChEESConfig)
+
+    def run_chunk(chunk_data, chunk_init, chunk_keys, chunk_w):
+        # fused value-and-grad hot loop (kernels/vg.py): the nested
+        # series x chains vmap collapses into one flat batch and runs
+        # the Pallas TPU kernel when eligible
+        if chees and config.shared_adaptation:
+            # one program over the whole chunk: ε and trajectory length
+            # are shared, so every chain takes the identical leapfrog
+            # count per transition — no lockstep waste (infer/chees.py).
+            # chunk_w zeroes padding series out of the pooled adaptation
+            # statistics (the repeated tail of a ragged final chunk must
+            # not skew the shared tuning).
+            return sample_chees_batched(
+                make_lp_bc(model, chunk_data),
+                chunk_keys[0],
+                chunk_init,
+                config,
+                jit=False,
+                series_weight=chunk_w,
+                probe_vg=model.make_vg({k: v[0] for k, v in chunk_data.items()}),
+            )
+
+        sampler = sample_chees if chees else sample_nuts
+
         def one(args):
             per_series, qi, ki = args
-            # fused value-and-grad hot loop (kernels/vg.py): the nested
-            # series x chains vmap collapses into one flat batch and runs
-            # the Pallas TPU kernel when eligible
             vg = model.make_vg(per_series)
-            return sample_nuts(None, ki, qi, config, jit=False, vg_fn=vg)
+            return sampler(None, ki, qi, config, jit=False, vg_fn=vg)
 
         return jax.vmap(lambda *xs: one((dict(zip(data_keys, xs[:-2])), xs[-2], xs[-1])))(
             *[chunk_data[k] for k in data_keys], chunk_init, chunk_keys
@@ -136,6 +168,7 @@ def fit_batched(
             {k: shard(v[:chunk]) for k, v in data.items()},
             shard(init[:chunk]),
             shard(keys[:chunk]),
+            NamedSharding(mesh, P("series")),  # chunk_w [chunk]
         )
         run = jax.jit(run_chunk, in_shardings=in_shardings)
 
@@ -145,6 +178,7 @@ def fit_batched(
         n = sl.stop - s
         chunk_data = {k: v[sl] for k, v in data.items()}
         chunk_init, chunk_keys = init[sl], keys[sl]
+        chunk_w = jnp.ones((chunk,), jnp.float32)
         if n < chunk:  # ragged final chunk: pad by repeating the last series
             reps = chunk - n
             chunk_data = {
@@ -152,13 +186,16 @@ def fit_batched(
             }
             chunk_init = jnp.concatenate([chunk_init, jnp.repeat(chunk_init[-1:], reps, 0)])
             chunk_keys = jnp.concatenate([chunk_keys, jnp.repeat(chunk_keys[-1:], reps, 0)])
+            chunk_w = chunk_w.at[n:].set(0.0)
 
         ck = digest_key(
             _model_fingerprint(model),
             {k: np.asarray(v) for k, v in chunk_data.items()},
             vars(config),
             np.asarray(chunk_keys),
-            "sampler=vg-v1",  # sampling-path identity: bump when the
+            # v2: the _da_init log_eps_bar fix (infer/run.py) changed
+            # short-warmup draws for both samplers
+            "sampler=chees-vg-v2" if chees else "sampler=vg-v2",  # sampling-path identity: bump when the
             # draw-producing path changes so stale cache entries from a
             # numerically different (if statistically equivalent) path
             # are never mixed into a resumed sweep
@@ -168,7 +205,7 @@ def fit_batched(
             qs = jnp.asarray(hit.pop("samples"))
             stats = {k: jnp.asarray(v) for k, v in hit.items()}
         else:
-            qs, stats = jax.block_until_ready(run(chunk_data, chunk_init, chunk_keys))
+            qs, stats = jax.block_until_ready(run(chunk_data, chunk_init, chunk_keys, chunk_w))
             cache.put(ck, {"samples": np.asarray(qs), **{k: np.asarray(v) for k, v in stats.items()}})
         qs_parts.append(qs[:n])
         stats_parts.append({k: v[:n] for k, v in stats.items()})

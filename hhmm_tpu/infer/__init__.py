@@ -1,10 +1,20 @@
 from hhmm_tpu.infer.run import sample_nuts, SamplerConfig
+from hhmm_tpu.infer.chees import (
+    sample_chees,
+    sample_chees_batched,
+    make_lp_bc,
+    ChEESConfig,
+)
 from hhmm_tpu.infer.diagnostics import split_rhat, ess, summary
 from hhmm_tpu.infer.relabel import greedy_relabel, confusion_matrix, apply_relabel
 
 __all__ = [
     "sample_nuts",
     "SamplerConfig",
+    "sample_chees",
+    "sample_chees_batched",
+    "make_lp_bc",
+    "ChEESConfig",
     "split_rhat",
     "ess",
     "summary",

@@ -1,0 +1,450 @@
+"""ChEES-HMC: cross-chain adaptive HMC for vmapped batches.
+
+The reference's sampler is Stan's NUTS (every ``rstan::stan`` call). NUTS
+adapts trajectory length *per transition* by doubling a tree — on a CPU,
+per chain, that is free; in a vmapped TPU batch every series steps in
+lockstep, so the whole batch pays the deepest tree any member grows
+(measured in ``bench.py``: treedepth 8 costs 16x the throughput of
+treedepth 4 for no ESS gain on this workload). ChEES-HMC (Hoffman, Radul
+& Sountsov, AISTATS 2021) is the accelerator-native answer: *fixed*
+jittered trajectory lengths shared by all chains, adapted during warmup
+by gradient ascent on the Change in the Estimator of the Expected Square
+(ChEES) criterion, using cross-chain expectations — exactly the
+statistics a batched sampler has for free. Every transition then costs
+the same number of leapfrogs for every chain, there is no lockstep tax,
+and the adapted length maximizes large-scale mixing instead of a worst-
+case U-turn bound.
+
+:func:`sample_chees_batched` is the core implementation: one program
+over a whole series×chains batch with ONE shared (step size, trajectory
+length) pair pooled over every chain — all chains take the identical
+leapfrog count per transition, so the vmapped program has zero lockstep
+waste by construction. ChEES proposals are centered *per-series*, so the
+criterion never mixes different posteriors; mass matrices are per-series.
+:func:`sample_chees` is the single-posterior form (a B=1 wrapper).
+
+Scope note: adaptation needs ≥2 chains per posterior. For
+single-chain-per-series runs use NUTS (`infer/run.py`).
+
+Implementation details follow the paper:
+
+- trajectory jitter ``t_i = u_i * t`` with ``u_i`` a quasi-random Halton
+  (van der Corput base-2) sequence, shared by all chains at step i;
+- per-chain Metropolis accept (not multinomial);
+- dual-averaging step-size adaptation toward the HMC-optimal 0.651
+  acceptance. The paper pools chains with a harmonic mean; that assumes
+  many chains — with few chains per posterior a single near-zero accept
+  (f32 energy noise at T~1e3 makes ΔH noisy) collapses it and step size
+  spirals down, so the arithmetic mean is used (the same statistic
+  Stan's NUTS averages over a trajectory);
+- trajectory-length ascent with Adam on ``d/dt E[(||q'-m'||^2 -
+  ||q-m||^2)^2]`` where the per-chain gradient is
+  ``accept_prob * (||q'-m'||^2 - ||q-m||^2) * ((q'-m') . v') * u_i``,
+  means over chains (per-series centering, pooled gradient);
+- per-series diagonal mass matrices from cross-chain Welford estimates
+  over Stan's expanding windows (`infer/run.py::warmup_schedule`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from hhmm_tpu.infer.nuts import find_reasonable_step_size
+from hhmm_tpu.infer.run import (
+    _da_init,
+    _da_update,
+    _welford_init,
+    _welford_update,
+    _welford_variance,
+    warmup_schedule,
+)
+
+__all__ = ["ChEESConfig", "make_lp_bc", "sample_chees", "sample_chees_batched"]
+
+
+@dataclass(frozen=True)
+class ChEESConfig:
+    """Budget + adaptation knobs. Defaults follow Hoffman et al. (2021)
+    and Stan's warmup structure.
+
+    ``shared_adaptation``: in :func:`hhmm_tpu.batch.fit_batched`, adapt
+    ONE (step size, trajectory length) pair from statistics pooled over
+    the entire series×chains chunk (:func:`sample_chees_batched`). With
+    it off, each series adapts independently inside the vmap and the
+    batch pays the per-transition max trajectory across series.
+
+    ``max_leapfrogs`` bounds the leapfrogs per transition (static
+    shapes; the trajectory-length ascent is clipped to ``eps *
+    max_leapfrogs``). The measured throughput/ESS ladder on the
+    north-star workload is in the ``bench.py`` docstring.
+    """
+
+    num_warmup: int = 250
+    num_samples: int = 250
+    num_chains: int = 4
+    target_accept: float = 0.651
+    init_step_size: float = 0.1
+    init_traj_length: float = 1.0
+    max_leapfrogs: int = 256
+    adam_lr: float = 0.025
+    adam_b1: float = 0.9
+    adam_b2: float = 0.999
+    shared_adaptation: bool = True
+
+
+def halton_base2(n: int) -> np.ndarray:
+    """First ``n`` points of the van der Corput base-2 sequence in (0, 1):
+    bit-reversed integers. Quasi-random trajectory jitter (paper §4)."""
+    out = np.zeros(n)
+    for i in range(n):
+        x, f, k = 0.0, 0.5, i + 1
+        while k:
+            x += f * (k & 1)
+            k >>= 1
+            f *= 0.5
+        out[i] = x
+    return out
+
+
+def make_lp_bc(model, data) -> Callable:
+    """Build the chain-batched log-density ``q [B, C, dim] -> (logp
+    [B, C], grad [B, C, dim])`` for :func:`sample_chees_batched` from a
+    model and a dict of series-leading data arrays [B, ...].
+
+    The nesting (vmap over series of vmap over chains of the fused
+    ``model.make_vg``) is the contract the flat-batch Pallas dispatcher
+    (`kernels/vg.py`) collapses — every caller must build it the same
+    way, hence this single helper.
+    """
+    keys = list(data.keys())
+
+    def lp_bc(q):
+        def per_series(*xs):
+            vg = model.make_vg(dict(zip(keys, xs[:-1])))
+            return jax.vmap(vg)(xs[-1])
+
+        return jax.vmap(per_series)(*[data[k] for k in keys], q)
+
+    return lp_bc
+
+
+def sample_chees_batched(
+    lp_bc: Callable,
+    key: jax.Array,
+    init_q: jnp.ndarray,
+    config: ChEESConfig = ChEESConfig(),
+    jit: bool = True,
+    series_weight: Optional[jnp.ndarray] = None,
+    probe_vg: Optional[Callable] = None,
+):
+    """ChEES-HMC over a series×chains batch with SHARED step-size and
+    trajectory-length adaptation (see module docstring).
+
+    ``lp_bc``: ``q [B, C, dim] -> (logp [B, C], grad [B, C, dim])`` — the
+    chain-batched joint density (each series closes over its own data;
+    build it by nesting vmaps so the fused kernel sees one flat batch).
+    ``init_q``: [B, C, dim] with C == ``config.num_chains``.
+    ``series_weight``: optional [B] weights for the pooled adaptation
+    statistics — pass 0 for padding series (e.g. the repeated tail of a
+    ragged final chunk in `batch/fit.py`) so duplicates don't skew the
+    shared ε/trajectory tuning. Defaults to all-ones.
+    ``probe_vg``: optional single-point ``q [dim] -> (logp, grad)`` used
+    by the initial step-size search; without it the search evaluates
+    ``lp_bc`` on a broadcast batch and keeps one element (correct but
+    B·C times the needed work for those ~10 probe iterations).
+
+    Returns ``(samples [B, C, num_samples, dim], stats)``; every stats
+    entry carries a leading series axis so chunked dispatch can slice
+    and re-concatenate uniformly.
+
+    Sharing semantics: ε and t are single scalars adapted from pooled
+    statistics; during sampling everything is frozen, so each series'
+    chain is a valid MH kernel for its own posterior.
+    """
+    B, C, dim = init_q.shape
+    if C < 2:
+        raise ValueError(
+            "ChEES adaptation needs >=2 chains per series (cross-chain "
+            "expectations); use sample_nuts for single-chain runs"
+        )
+    if C != config.num_chains:
+        raise ValueError(
+            f"init_q has {C} chains per series, config.num_chains={config.num_chains}"
+        )
+    dtype = init_q.dtype
+    if series_weight is None:
+        series_weight = jnp.ones((B,), dtype)
+    w_bc = jnp.broadcast_to(jnp.asarray(series_weight, dtype)[:, None], (B, C))
+    halton = jnp.asarray(halton_base2(config.num_warmup + config.num_samples), dtype)
+    update_mass, window_end = warmup_schedule(config.num_warmup)
+
+    def kinetic(inv_mass, p):  # inv_mass [B, dim], p [B, C, dim] -> [B, C]
+        return 0.5 * jnp.sum(inv_mass[:, None, :] * p * p, axis=-1)
+
+    def leapfrogs(inv_mass, eps, n_steps, q, p, logp, grad):
+        def body(state):
+            i, q, p, _, grad = state
+            p_half = p + 0.5 * eps * grad
+            q = q + eps * inv_mass[:, None, :] * p_half
+            logp, grad = lp_bc(q)
+            p = p_half + 0.5 * eps * grad
+            return i + 1, q, p, logp, grad
+
+        def cond(state):
+            return state[0] < n_steps
+
+        _, q, p, logp, grad = lax.while_loop(
+            cond, body, (jnp.zeros((), jnp.int32), q, p, logp, grad)
+        )
+        return q, p, logp, grad
+
+    def transition(key, qs, logps, grads, eps, inv_mass, traj, u):
+        key, key_mom, key_acc = jax.random.split(key, 3)
+        p0 = jax.random.normal(key_mom, (B, C, dim), dtype) / jnp.sqrt(inv_mass)[
+            :, None, :
+        ]
+        energy0 = -logps + kinetic(inv_mass, p0)  # [B, C]
+        # SCALAR step count — identical for every chain in the batch
+        n_steps = jnp.clip(
+            jnp.ceil(u * traj / eps).astype(jnp.int32), 1, config.max_leapfrogs
+        )
+        q1, p1, logp1, grad1 = leapfrogs(inv_mass, eps, n_steps, qs, p0, logps, grads)
+        energy1 = -logp1 + kinetic(inv_mass, p1)
+        delta = energy1 - energy0
+        diverging = (delta > 1000.0) | jnp.isnan(delta)
+        accept_prob = jnp.where(diverging, 0.0, jnp.minimum(1.0, jnp.exp(-delta)))
+        accept = jax.random.uniform(key_acc, (B, C)) < accept_prob
+        q_new = jnp.where(accept[..., None], q1, qs)
+        logp_new = jnp.where(accept, logp1, logps)
+        grad_new = jnp.where(accept[..., None], grad1, grads)
+
+        # ChEES gradient pooled over series: center per-series (axis=1)
+        m0 = qs.mean(axis=1, keepdims=True)
+        m1 = q1.mean(axis=1, keepdims=True)
+        dsq = jnp.sum((q1 - m1) ** 2, -1) - jnp.sum((qs - m0) ** 2, -1)  # [B, C]
+        v1 = inv_mass[:, None, :] * p1
+        proj = jnp.sum((q1 - m1) * v1, axis=-1)
+        per_chain = accept_prob * dsq * proj * u
+        finite = jnp.isfinite(per_chain)
+        w = jnp.where(finite, accept_prob, 0.0) * w_bc
+        g = jnp.where(finite, per_chain, 0.0) * w_bc
+        chees_grad = jnp.sum(g) / jnp.maximum(jnp.sum(w), 1e-6)
+        mean_accept = jnp.sum(accept_prob * w_bc) / jnp.maximum(jnp.sum(w_bc), 1e-6)
+        return (
+            key,
+            q_new,
+            logp_new,
+            grad_new,
+            accept_prob,
+            mean_accept,
+            chees_grad,
+            diverging,
+            n_steps,
+        )
+
+    def run(key, init_q):
+        logps0, grads0 = lp_bc(init_q)
+        key, key_eps = jax.random.split(key)
+        inv_mass0 = jnp.ones((B, dim), dtype)
+
+        # shared ε₀ from one representative chain (cheap heuristic; DA
+        # converges within the first warmup window regardless)
+        if probe_vg is not None:
+            single = probe_vg
+        else:
+
+            def single(q):
+                lps, gs = lp_bc(jnp.broadcast_to(q, (B, C, dim)).astype(dtype))
+                return lps[0, 0], gs[0, 0]
+
+        eps0 = find_reasonable_step_size(
+            single,
+            jnp.ones((dim,), dtype),
+            init_q[0, 0],
+            logps0[0, 0],
+            grads0[0, 0],
+            key_eps,
+            config.init_step_size,
+        )
+
+        adam0 = (jnp.zeros((), dtype), jnp.zeros((), dtype), jnp.zeros((), dtype))
+        warm_init = (
+            key,
+            init_q,
+            logps0,
+            grads0,
+            _da_init(eps0),
+            jnp.log(jnp.asarray(config.init_traj_length, dtype)),
+            adam0,
+            inv_mass0,
+            _welford_init((B, dim), dtype),
+        )
+
+        def warm_step(carry, xs):
+            key, qs, logps, grads, da, log_traj, adam, inv_mass, wf = carry
+            u, upd_mass, win_end = xs
+            eps = jnp.exp(da.log_eps)
+            traj = jnp.exp(log_traj)
+            (
+                key,
+                qs,
+                logps,
+                grads,
+                _,
+                mean_accept,
+                chees_grad,
+                diverging,
+                n_steps,
+            ) = transition(key, qs, logps, grads, eps, inv_mass, traj, u)
+            da = _da_update(da, mean_accept, config.target_accept)
+
+            m, v, t = adam
+            g = chees_grad * traj  # d/d(log t): scale-free ascent
+            t = t + 1.0
+            m = config.adam_b1 * m + (1.0 - config.adam_b1) * g
+            v = config.adam_b2 * v + (1.0 - config.adam_b2) * g * g
+            mhat = m / (1.0 - config.adam_b1**t)
+            vhat = v / (1.0 - config.adam_b2**t)
+            log_traj = log_traj + config.adam_lr * mhat / (jnp.sqrt(vhat) + 1e-8)
+            log_traj = jnp.clip(
+                log_traj, jnp.log(eps), jnp.log(eps * config.max_leapfrogs)
+            )
+            adam = (m, v, t)
+
+            # per-series mass: one Welford update per chain per step
+            def upd(wf_state):
+                def body(c, s):
+                    return _welford_update(s, qs[:, c, :])
+
+                return lax.fori_loop(0, C, body, wf_state)
+
+            wf = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(upd_mass, new, old), upd(wf), wf
+            )
+            new_inv_mass = _welford_variance(wf)
+            inv_mass = jnp.where(win_end, new_inv_mass, inv_mass)
+            fresh_da = _da_init(jnp.exp(da.log_eps))
+            da = jax.tree_util.tree_map(
+                lambda f, o: jnp.where(win_end, f, o), fresh_da, da
+            )
+            wf = jax.tree_util.tree_map(
+                lambda f, o: jnp.where(win_end, f, o), _welford_init((B, dim), dtype), wf
+            )
+            return (key, qs, logps, grads, da, log_traj, adam, inv_mass, wf), (
+                diverging,
+                n_steps,
+            )
+
+        (key, qs, logps, grads, da, log_traj, _, inv_mass, _), (warm_div, warm_steps) = (
+            lax.scan(
+                warm_step,
+                warm_init,
+                (halton[: config.num_warmup], update_mass, window_end),
+            )
+        )
+
+        eps_final = jnp.exp(da.log_eps_bar)
+        traj_final = jnp.exp(log_traj)
+
+        def samp_step(carry, u):
+            key, qs, logps, grads = carry
+            (
+                key,
+                qs,
+                logps,
+                grads,
+                accept_prob,
+                _,
+                _,
+                diverging,
+                n_steps,
+            ) = transition(key, qs, logps, grads, eps_final, inv_mass, traj_final, u)
+            return (key, qs, logps, grads), (qs, logps, accept_prob, diverging, n_steps)
+
+        _, (qs_out, logps_out, acc, div, n_steps) = lax.scan(
+            samp_step, (key, qs, logps, grads), halton[config.num_warmup :]
+        )
+
+        # [S, B, C, ...] -> [B, C, S, ...]; every entry gets a leading
+        # series axis so chunked dispatch (batch/fit.py) slices uniformly
+        def scd(x):
+            return jnp.moveaxis(x, 0, 2)
+
+        stats = {
+            "accept_prob": scd(acc),
+            "num_leaves": jnp.broadcast_to(
+                n_steps[None, None, :], (B, C, n_steps.shape[0])
+            ),
+            "diverging": scd(div),
+            "logp": scd(logps_out),
+            "step_size": jnp.broadcast_to(eps_final, (B, C)),
+            "inv_mass": inv_mass,
+            "traj_length": jnp.broadcast_to(traj_final, (B, C)),
+            "warmup_diverging": scd(warm_div),
+            "warmup_num_leaves": jnp.broadcast_to(
+                warm_steps[None, :], (B, warm_steps.shape[0])
+            ),
+        }
+        return jnp.moveaxis(qs_out, 0, 2), stats
+
+    fn = run
+    if jit:
+        fn = jax.jit(run)
+    return fn(key, init_q)
+
+
+def sample_chees(
+    logp_fn: Optional[Callable],
+    key: jax.Array,
+    init_q: jnp.ndarray,
+    config: ChEESConfig = ChEESConfig(),
+    jit: bool = True,
+    vg_fn: Optional[Callable] = None,
+):
+    """ChEES-HMC on a single posterior: ``init_q`` is [chains, dim] (or
+    [dim], broadcast — but chains should start dispersed for the
+    cross-chain criterion).
+
+    Mirrors :func:`hhmm_tpu.infer.sample_nuts`: ``vg_fn`` is the fused
+    ``q -> (logp, grad)`` hot loop and takes precedence over ``logp_fn``.
+    Returns ``(samples [chains, num_samples, dim], stats dict)``.
+
+    This is :func:`sample_chees_batched` with a series batch of one —
+    the two paths cannot drift apart statistically.
+    """
+    if logp_fn is None and vg_fn is None:
+        raise ValueError("need logp_fn or vg_fn")
+    C = config.num_chains
+    init_q = jnp.atleast_2d(jnp.asarray(init_q))
+    if init_q.shape[0] == 1 and C > 1:
+        init_q = jnp.tile(init_q, (C, 1))
+    if init_q.shape[0] != C:
+        raise ValueError(f"init_q has {init_q.shape[0]} rows, num_chains={C}")
+    if C < 2:
+        raise ValueError(
+            "ChEES adaptation needs >=2 chains per posterior (cross-chain "
+            "expectations); use sample_nuts for single-chain runs"
+        )
+
+    single = vg_fn if vg_fn is not None else jax.value_and_grad(lambda q: logp_fn(q))
+    lp_chains = jax.vmap(single)
+
+    def lp_bc(q):  # [1, C, dim]
+        lps, gs = lp_chains(q[0])
+        return lps[None], gs[None]
+
+    qs, stats = sample_chees_batched(
+        lp_bc, key, init_q[None], config, jit=jit, probe_vg=single
+    )
+    squeeze = {k: v[0] for k, v in stats.items()}
+    # shared scalars come back as broadcasts; undo for the single-
+    # posterior API (matches sample_nuts' scalar step_size)
+    squeeze["step_size"] = squeeze["step_size"][0]
+    squeeze["traj_length"] = squeeze["traj_length"][0]
+    return qs[0], squeeze

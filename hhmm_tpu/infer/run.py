@@ -78,9 +78,14 @@ class _DAState(NamedTuple):
 
 
 def _da_init(eps):
+    # log_eps_bar starts at log(eps), not 0: the first _da_update
+    # overwrites it entirely (x_eta = 1 at count 1), so the init value
+    # only matters when a window closes with zero further updates —
+    # e.g. a short-warmup schedule whose last window ends on the final
+    # warmup step. There eps_bar must be the adapted eps, not exp(0).
     return _DAState(
         log_eps=jnp.log(eps),
-        log_eps_bar=jnp.zeros_like(eps),
+        log_eps_bar=jnp.log(eps),
         h_bar=jnp.zeros_like(eps),
         mu=jnp.log(10.0 * eps),
         count=jnp.zeros_like(eps),
@@ -105,7 +110,8 @@ class _Welford(NamedTuple):
 
 
 def _welford_init(dim, dtype):
-    return _Welford(jnp.zeros((), dtype), jnp.zeros((dim,), dtype), jnp.zeros((dim,), dtype))
+    shape = dim if isinstance(dim, tuple) else (dim,)
+    return _Welford(jnp.zeros((), dtype), jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
 
 
 def _welford_update(s: _Welford, x):

@@ -1,0 +1,212 @@
+"""ChEES-HMC sampler tests (`infer/chees.py`).
+
+The reference has exactly one inference engine (Stan NUTS); ChEES-HMC is
+this framework's batch-native alternative — fixed jittered trajectory
+lengths shared across chains, adapted from cross-chain statistics
+(Hoffman, Radul & Sountsov 2021). Validation mirrors the discipline used
+for the NUTS path (SURVEY.md §4): exact-moment checks on a tractable
+target, cross-sampler posterior agreement on a real model, and SBC rank
+uniformity through the batched engine.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.stats import kstest
+
+from hhmm_tpu.batch import fit_batched
+from hhmm_tpu.infer import ChEESConfig, SamplerConfig, sample_chees, sample_nuts
+from hhmm_tpu.infer.chees import halton_base2
+from hhmm_tpu.models import MultinomialHMM
+from hhmm_tpu.sim import hmm_sim, obsmodel_categorical
+
+
+class TestHalton:
+    def test_van_der_corput_prefix(self):
+        np.testing.assert_allclose(
+            halton_base2(7), [0.5, 0.25, 0.75, 0.125, 0.625, 0.375, 0.875]
+        )
+
+    def test_range_and_spread(self):
+        u = halton_base2(256)
+        assert (u > 0).all() and (u < 1).all()
+        # low-discrepancy: every dyadic interval of width 1/8 gets 32 points
+        counts, _ = np.histogram(u, bins=8, range=(0, 1))
+        assert (counts == 32).all()
+
+
+class TestGaussianTarget:
+    def test_moments_correlated_gaussian(self):
+        """Exact target: correlated 4-D Gaussian. Posterior moments from
+        pooled chains must match to MC error."""
+        rng = np.random.default_rng(0)
+        L = np.tril(rng.normal(size=(4, 4)) * 0.5) + np.eye(4)
+        cov = L @ L.T
+        prec = jnp.asarray(np.linalg.inv(cov), jnp.float32)
+        mu = jnp.asarray([1.0, -2.0, 0.5, 3.0], jnp.float32)
+
+        def logp(q):
+            d = q - mu
+            return -0.5 * d @ prec @ d
+
+        cfg = ChEESConfig(num_warmup=300, num_samples=500, num_chains=8)
+        init = jax.random.normal(jax.random.PRNGKey(1), (8, 4)) * 2.0
+        qs, stats = sample_chees(logp, jax.random.PRNGKey(0), init, cfg)
+        s = np.asarray(qs).reshape(-1, 4)
+        np.testing.assert_allclose(s.mean(0), np.asarray(mu), atol=0.15)
+        np.testing.assert_allclose(np.cov(s.T), cov, atol=0.35)
+        assert float(np.asarray(stats["diverging"]).mean()) == 0.0
+        # adaptation actually ran: trajectory moved off its 1.0 init and
+        # stays under the leapfrog cap
+        traj = float(stats["traj_length"])
+        eps = float(stats["step_size"])
+        assert traj != pytest.approx(cfg.init_traj_length)
+        assert traj <= eps * cfg.max_leapfrogs + 1e-6
+
+    def test_requires_two_chains(self):
+        with pytest.raises(ValueError, match=">=2 chains"):
+            sample_chees(
+                lambda q: -0.5 * jnp.sum(q * q),
+                jax.random.PRNGKey(0),
+                jnp.zeros((1, 2)),
+                ChEESConfig(num_chains=1),
+            )
+
+
+class TestCrossSamplerAgreement:
+    def test_matches_nuts_on_multinomial_hmm(self, rng):
+        """ChEES and NUTS target the identical posterior; their
+        posterior means over pooled chains must agree to MC error.
+        Label-symmetry is broken by sorting states on phi[:, 0] per
+        draw (as in the SBC suite)."""
+        K, L, T = 2, 3, 300
+        model = MultinomialHMM(K=K, L=L)
+        A = np.array([[0.85, 0.15], [0.25, 0.75]])
+        p1 = np.array([0.6, 0.4])
+        phi = np.array([[0.7, 0.2, 0.1], [0.1, 0.3, 0.6]])
+        z, x = hmm_sim(
+            jax.random.PRNGKey(5), T, A, p1, obsmodel_categorical(phi), validate=False
+        )
+        data = {
+            "x": np.asarray(x, np.int32)[None],
+            "mask": np.ones((1, T), np.float32),
+        }
+
+        def pooled_canonical_means(qs):
+            draws = model.constrained_draws(qs.reshape(-1, qs.shape[-1]))
+            phid = np.asarray(draws["phi_k"]).reshape(-1, K, L)
+            Ad = np.asarray(draws["A_ij"]).reshape(-1, K, K)
+            order = np.argsort(phid[:, :, 0], axis=1)
+            idx = np.arange(phid.shape[0])[:, None]
+            phid = phid[idx, order]
+            Ad = Ad[idx[:, :, None], order[:, :, None], order[:, None, :]]
+            return np.concatenate([phid.mean(0).ravel(), Ad.mean(0).ravel()])
+
+        chees_cfg = ChEESConfig(num_warmup=250, num_samples=400, num_chains=4)
+        nuts_cfg = SamplerConfig(
+            num_warmup=250, num_samples=400, num_chains=4, max_treedepth=6
+        )
+        qs_c, st_c = fit_batched(model, data, jax.random.PRNGKey(0), chees_cfg, chunk_size=1)
+        qs_n, st_n = fit_batched(model, data, jax.random.PRNGKey(0), nuts_cfg, chunk_size=1)
+        assert float(np.asarray(st_c["diverging"]).mean()) < 0.05
+        m_c = pooled_canonical_means(qs_c[0])
+        m_n = pooled_canonical_means(qs_n[0])
+        np.testing.assert_allclose(m_c, m_n, atol=0.06)
+
+
+class TestRaggedChunk:
+    def test_ragged_final_chunk_runs_and_pools_weighted(self, rng):
+        """B not divisible by chunk_size: the final chunk is padded by
+        repeating the last series; those duplicates carry zero weight in
+        the pooled shared-adaptation statistics (batch/fit.py chunk_w).
+        The run must produce finite draws for every real series."""
+        K, L, T = 2, 3, 120
+        model = MultinomialHMM(K=K, L=L)
+        B = 3
+        xs = []
+        for i in range(B):
+            A = rng.dirichlet(np.ones(K), size=K)
+            phi = rng.dirichlet(np.ones(L), size=K)
+            _, x = hmm_sim(
+                jax.random.PRNGKey(i),
+                T,
+                A,
+                rng.dirichlet(np.ones(K)),
+                obsmodel_categorical(phi),
+                validate=False,
+            )
+            xs.append(np.asarray(x, np.int32))
+        data = {"x": np.stack(xs), "mask": np.ones((B, T), np.float32)}
+        cfg = ChEESConfig(num_warmup=50, num_samples=50, num_chains=2)
+        qs, stats = fit_batched(model, data, jax.random.PRNGKey(0), cfg, chunk_size=2)
+        assert qs.shape[:3] == (B, 2, 50)
+        assert np.isfinite(np.asarray(qs)).all()
+        assert np.isfinite(np.asarray(stats["logp"])).all()
+
+
+class TestSBCChEES:
+    @pytest.mark.parametrize("max_leapfrogs", [256, 16])
+    def test_rank_uniformity_multinomial(self, rng, max_leapfrogs):
+        """SBC through the batched engine with the ChEES sampler: ranks
+        of prior draws among posterior draws must be uniform (the same
+        gate as tests/test_sbc.py, chains=4). ``max_leapfrogs=16`` is
+        the benchmark default (bench.py) — this is its calibration
+        evidence; 256 is the unconstrained sampler."""
+        K, L, T = 2, 3, 250
+        N_REPS, THIN = 8, 4
+        model = MultinomialHMM(K=K, L=L)
+        datasets, trues = [], []
+        for _ in range(N_REPS):
+            p1 = rng.dirichlet(np.ones(K))
+            A = rng.dirichlet(np.ones(K), size=K)
+            phi = rng.dirichlet(np.ones(L), size=K)
+            z, x = hmm_sim(
+                jax.random.PRNGKey(int(rng.integers(1 << 30))),
+                T,
+                A,
+                p1,
+                obsmodel_categorical(phi),
+                validate=False,
+            )
+            datasets.append({"x": np.asarray(x, np.int32), "mask": np.ones(T, np.float32)})
+            trues.append((p1, A, phi))
+        data = {k: jnp.asarray(np.stack([d[k] for d in datasets])) for k in datasets[0]}
+        cfg = ChEESConfig(
+            num_warmup=150, num_samples=200, num_chains=4, max_leapfrogs=max_leapfrogs
+        )
+        qs, stats = fit_batched(model, data, jax.random.PRNGKey(2), cfg, chunk_size=N_REPS)
+        assert float(np.asarray(stats["diverging"]).mean()) < 0.1
+
+        units = []
+        for i in range(N_REPS):
+            draws = model.constrained_draws(qs[i].reshape(-1, qs.shape[-1]))
+            p1d = np.asarray(draws["p_1k"]).reshape(-1, K)
+            Ad = np.asarray(draws["A_ij"]).reshape(-1, K, K)
+            phid = np.asarray(draws["phi_k"]).reshape(-1, K, L)
+            order = np.argsort(phid[:, :, 0], axis=1)
+            idx = np.arange(p1d.shape[0])[:, None]
+            p1d = np.take_along_axis(p1d, order, axis=1)
+            phid = phid[idx, order]
+            Ad = Ad[idx[:, :, None], order[:, :, None], order[:, None, :]]
+            p1, A, phi = trues[i]
+            torder = np.argsort(phi[:, 0])
+            truth = np.array(
+                [
+                    p1[torder][0],
+                    A[torder][:, torder][0, 0],
+                    A[torder][:, torder][1, 1],
+                    phi[torder][0, 0],
+                    phi[torder][1, 0],
+                ]
+            )
+            flat = np.column_stack(
+                [p1d[:, 0], Ad[:, 0, 0], Ad[:, 1, 1], phid[:, 0, 0], phid[:, 1, 0]]
+            )
+            thinned = flat[::THIN]
+            r = (thinned < truth[None, :]).sum(axis=0)
+            units.append((r + 0.5) / (thinned.shape[0] + 1))
+        u = np.concatenate(units)
+        assert 0.30 < u.mean() < 0.70, f"rank mean {u.mean():.3f}"
+        p = kstest(u, "uniform").pvalue
+        assert p > 1e-3, f"KS uniformity p={p:.2e}"
