@@ -151,16 +151,10 @@ def main() -> None:
     chunk = min(args.chunk, args.series)
     if args.series % chunk != 0:
         raise SystemExit(f"--series {args.series} must be divisible by --chunk {chunk}")
-    init = jnp.stack(
-        [
-            jnp.stack(
-                [
-                    model.init_unconstrained(k, {"x": x[i], "sign": sign[i]})
-                    for k in jax.random.split(jax.random.PRNGKey(100 + i), chains)
-                ]
-            )
-            for i in range(args.series)
-        ]
+    from hhmm_tpu.batch import default_init
+
+    init = default_init(
+        model, {"x": x, "sign": sign}, args.series, chains, jax.random.PRNGKey(100)
     )  # [B, chains, dim]
     keys = jax.random.split(jax.random.PRNGKey(0), args.series)
 

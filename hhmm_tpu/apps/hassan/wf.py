@@ -20,7 +20,7 @@ become one padded batched NUTS program:
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from typing import Dict, Optional
 
 import jax
@@ -30,7 +30,7 @@ import numpy as np
 from hhmm_tpu.apps.hassan.data import Dataset, make_dataset
 from hhmm_tpu.apps.hassan.forecast import forecast_errors, neighbouring_forecast
 from hhmm_tpu.batch import fit_batched
-from hhmm_tpu.infer import SamplerConfig, sample_nuts
+from hhmm_tpu.infer import ChEESConfig, SamplerConfig, sample_chees, sample_nuts
 from hhmm_tpu.models import IOHMMHMixLite
 
 __all__ = ["WFForecastResult", "wf_forecast"]
@@ -66,7 +66,12 @@ def wf_forecast(
     Step s trains on the prefix ``ohlc[: train_len + s]`` (last observed
     close = day ``train_len + s − 1``) and forecasts day ``train_len + s``
     (h=1), so ``actual[s] = close[train_len + s]`` is strictly out of
-    sample for every step."""
+    sample for every step.
+
+    ``config`` may be a :class:`SamplerConfig` (NUTS) or a
+    :class:`ChEESConfig` (shared-adaptation batch sampler,
+    ``num_chains >= 2``) — the batched fit and the warm-start pilot
+    both follow it."""
     if key is None:
         key = jax.random.PRNGKey(0)
     ohlc = np.asarray(ohlc, dtype=np.float64)
@@ -90,19 +95,17 @@ def wf_forecast(
     init = None
     if warm_start:
         pilot_data = {"x": jnp.asarray(datasets[0].x), "u": jnp.asarray(datasets[0].u)}
-        pilot_cfg = SamplerConfig(
-            num_warmup=config.num_warmup,
-            num_samples=max(50, config.num_samples // 4),
-            num_chains=config.num_chains,
-            max_treedepth=config.max_treedepth,
-        )
+        # same config, smaller draw budget: replace() keeps every other
+        # adaptation knob the caller set
+        pilot_cfg = replace(config, num_samples=max(50, config.num_samples // 4))
+        pilot_sampler = sample_chees if isinstance(config, ChEESConfig) else sample_nuts
         pilot_init = jnp.stack(
             [
                 model.init_unconstrained(k, pilot_data)
                 for k in jax.random.split(jax.random.fold_in(key, 99), config.num_chains)
             ]
         )
-        pilot_qs, _ = sample_nuts(
+        pilot_qs, _ = pilot_sampler(
             model.make_logp(pilot_data), jax.random.fold_in(key, 98), pilot_init, pilot_cfg
         )
         seed_theta = jnp.asarray(np.asarray(pilot_qs).mean(axis=1))  # [chains, dim]

@@ -97,7 +97,12 @@ def wf_trade(
     cache_dir: Optional[str] = None,
 ) -> List[WFResult]:
     """Run all tasks as one batched fit + per-task host post-processing
-    (`wf-trade.R:30-179`, minus the socket cluster)."""
+    (`wf-trade.R:30-179`, minus the socket cluster).
+
+    ``config`` may be a :class:`SamplerConfig` (NUTS) or a
+    :class:`hhmm_tpu.infer.ChEESConfig` (shared-adaptation batch
+    sampler, ``num_chains >= 2``) — `fit_batched` dispatches on the
+    type."""
     if key is None:
         key = jax.random.PRNGKey(0)
 

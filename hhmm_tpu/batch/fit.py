@@ -38,7 +38,7 @@ from hhmm_tpu.infer.chees import (
 )
 from hhmm_tpu.infer.run import SamplerConfig, sample_nuts
 
-__all__ = ["fit_batched"]
+__all__ = ["default_init", "fit_batched"]
 
 
 def _model_fingerprint(model) -> Dict[str, Any]:
@@ -55,7 +55,13 @@ def _model_fingerprint(model) -> Dict[str, Any]:
     return {"class": type(model).__name__, **attrs}
 
 
-def _default_init(model, data_b, n_series, n_chains, key):
+def default_init(model, data_b, n_series, n_chains, key):
+    """Stack per-series × per-chain ``model.init_unconstrained`` draws
+    into [n_series, n_chains, dim]. ``data_b`` is a dict of arrays with
+    a leading series axis; any ``mask`` entry is used to drop padding
+    before data-driven inits (k-means etc.) see it. The single init
+    construction shared by `fit_batched`, `bench.py`, and
+    `__graft_entry__`."""
     init = []
     for i in range(n_series):
         per_series = {k: np.asarray(v[i]) for k, v in data_b.items() if v is not None}
@@ -106,7 +112,7 @@ def fit_batched(
     B = sizes.pop()
     C = config.num_chains
     if init is None:
-        init = _default_init(model, data, B, C, key)
+        init = default_init(model, data, B, C, key)
     init = jnp.asarray(init)
     if init.shape[:2] != (B, C):
         raise ValueError(f"init must be [B={B}, chains={C}, dim], got {init.shape}")

@@ -145,6 +145,60 @@ class TestRaggedChunk:
         assert np.isfinite(np.asarray(stats["logp"])).all()
 
 
+class TestAppHarnesses:
+    """The walk-forward application harnesses accept a ChEESConfig and
+    route both the batched fit and (Hassan) the warm-start pilot through
+    the shared-adaptation sampler."""
+
+    def test_hassan_wf_forecast_chees(self, tmp_path):
+        from hhmm_tpu.apps.hassan import simulate_ohlc, wf_forecast
+
+        rng = np.random.default_rng(5)
+        ohlc = simulate_ohlc(rng, T=120, vol=0.008, regimes=1, drift_spread=-0.02)
+        res = wf_forecast(
+            ohlc,
+            train_len=110,
+            K=2,
+            L=2,
+            config=ChEESConfig(num_warmup=100, num_samples=100, num_chains=2),
+            cache_dir=str(tmp_path),
+            chunk_size=16,
+        )
+        assert res.forecasts.shape[0] == 10
+        assert np.isfinite(res.point).all()
+        assert res.diverged.mean() < 0.2
+        assert res.errors["mape"] < 10.0
+        assert res.errors["r2"] > 0.3  # tracks the trending level
+
+    def test_tayal_wf_trade_chees(self, tmp_path):
+        from hhmm_tpu.apps.tayal import build_tasks, simulate_ticks, wf_trade
+
+        rng = np.random.default_rng(11)
+        days = {
+            sym: [
+                dict(
+                    zip(
+                        ("price", "size", "t_seconds"),
+                        simulate_ticks(rng, n_legs=60)[:3],
+                    )
+                )
+                for _ in range(4)
+            ]
+            for sym in ("AAA", "BBB")
+        }
+        tasks = build_tasks(days, train_days=2, trade_days=1)
+        results = wf_trade(
+            tasks,
+            config=ChEESConfig(num_warmup=80, num_samples=80, num_chains=2),
+            chunk_size=4,
+            cache_dir=str(tmp_path),
+        )
+        assert len(results) == 4
+        for r in results:
+            assert r.diverged < 0.2
+            assert np.isfinite(r.bnh).all()
+
+
 class TestSBCChEES:
     @pytest.mark.parametrize("max_leapfrogs", [256, 16])
     def test_rank_uniformity_multinomial(self, rng, max_leapfrogs):
