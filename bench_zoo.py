@@ -35,14 +35,29 @@ import jax.numpy as jnp
 
 
 def _time_fit(model, data, config, key):
-    from hhmm_tpu.infer import sample_nuts
+    from hhmm_tpu.infer import ChEESConfig, sample_chees, sample_nuts
 
     data = {k: jnp.asarray(v) for k, v in data.items()}
-    theta0 = model.init_unconstrained(jax.random.PRNGKey(7), data)
     vg = model.make_vg(data)
+    if isinstance(config, ChEESConfig):
+        # single posterior, C chains: plain per-posterior ChEES — the
+        # cross-chain criterion replaces NUTS's per-transition trees
+        from hhmm_tpu.batch import default_init
+
+        theta0 = default_init(
+            model,
+            {k: np.asarray(v)[None] for k, v in data.items()},
+            1,
+            config.num_chains,
+            jax.random.PRNGKey(7),
+        )[0]
+        sampler = sample_chees
+    else:
+        theta0 = model.init_unconstrained(jax.random.PRNGKey(7), data)
+        sampler = sample_nuts
 
     def run(key):
-        return sample_nuts(None, key, theta0, config, jit=False, vg_fn=vg)
+        return sampler(None, key, theta0, config, jit=False, vg_fn=vg)
 
     runj = jax.jit(run)
     jax.block_until_ready(runj(jax.random.PRNGKey(999)))  # compile
@@ -139,17 +154,36 @@ def main() -> None:
     ap.add_argument("--warmup", type=int, default=250)
     ap.add_argument("--samples", type=int, default=250)
     ap.add_argument("--max-treedepth", type=int, default=6)
+    ap.add_argument(
+        "--sampler",
+        choices=["nuts", "chees"],
+        default="nuts",
+        help="nuts (default; Stan semantics) or chees — per-posterior "
+        "cross-chain adaptation (infer/chees.py), --chains >= 2",
+    )
+    ap.add_argument("--chains", type=int, default=None)
+    ap.add_argument("--max-leapfrogs", type=int, default=32)
     ap.add_argument("--cpu", action="store_true", help="force the CPU backend")
     args = ap.parse_args()
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
 
-    cfg = SamplerConfig(
-        num_warmup=args.warmup,
-        num_samples=args.samples,
-        num_chains=1,
-        max_treedepth=args.max_treedepth,
-    )
+    if args.sampler == "chees":
+        from hhmm_tpu.infer import ChEESConfig
+
+        cfg = ChEESConfig(
+            num_warmup=args.warmup,
+            num_samples=args.samples,
+            num_chains=args.chains or 4,
+            max_leapfrogs=args.max_leapfrogs,
+        )
+    else:
+        cfg = SamplerConfig(
+            num_warmup=args.warmup,
+            num_samples=args.samples,
+            num_chains=args.chains or 1,
+            max_treedepth=args.max_treedepth,
+        )
     for name in args.configs:
         metric, dt, div, baseline_s = CONFIGS[name](cfg)
         print(

@@ -12,5 +12,6 @@ __all__ = [
     "pad_datasets",
     "digest_key",
     "ResultCache",
+    "default_init",
     "fit_batched",
 ]

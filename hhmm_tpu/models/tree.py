@@ -83,6 +83,16 @@ class TreeHMM(BaseHMMModel):
     support and chain-init values. ``order_mu`` ∈ {"global", "group",
     "none"} (Gaussian leaves only; default "group" when ``semisup``
     else "global").
+
+    Gaussian leaves carry weakly-informative priors μ ~ N(0,
+    ``prior_mu_scale``), σ ~ half-N(0, ``prior_sigma_scale``) — the σ
+    convention of the reference's IOHMM samplers (s ~ N(0,3) truncated,
+    `iohmm-reg/stan/iohmm-reg.stan:113-121`). Unlike the reference's
+    small flat-prior HMMs, a deep tree routinely has leaves with no
+    assigned observations (e.g. the 63-leaf Jangmin tree on T=100);
+    under a flat prior their μ/σ posterior is improper and the chain
+    drifts into σ→0 density spikes (diverging transitions). Set the
+    scales to ``None`` to recover flat priors.
     """
 
     def __init__(
@@ -91,9 +101,13 @@ class TreeHMM(BaseHMMModel):
         semisup: bool = False,
         gate_mode: str = "stan",
         order_mu: Optional[str] = None,
+        prior_mu_scale: Optional[float] = 10.0,
+        prior_sigma_scale: Optional[float] = 3.0,
     ):
         if gate_mode not in ("stan", "hard"):
             raise ValueError("gate_mode must be 'stan' or 'hard'")
+        self.prior_mu_scale = prior_mu_scale
+        self.prior_sigma_scale = prior_sigma_scale
         self.root = root
         self.flat0 = compile_hhmm(root)  # numeric spec compile: init + groups
         self.K = self.flat0.K
@@ -228,6 +242,18 @@ class TreeHMM(BaseHMMModel):
             return jnp.stack(rows)
 
         return compile_params(self.root, pi_of, A_of)
+
+    def log_prior(self, params) -> jnp.ndarray:
+        lp = jnp.zeros(())
+        if self.family != "gaussian":
+            return lp  # simplex params: flat proper (compact support)
+        if self.prior_mu_scale is not None:
+            lp = lp + normal_logpdf(self._mu(params), 0.0, self.prior_mu_scale).sum()
+        if self.prior_sigma_scale is not None:
+            # half-normal: normal logpdf on the positive value (the
+            # log 2 normalization is constant — dropped, as Stan does)
+            lp = lp + normal_logpdf(params["sigma"], 0.0, self.prior_sigma_scale).sum()
+        return lp
 
     def _log_obs(self, params, x) -> jnp.ndarray:
         if self.family == "gaussian":

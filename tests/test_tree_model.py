@@ -155,3 +155,45 @@ class TestRecovery:
         top_hat = np.asarray([m.groups[k] for k in gamma.argmax(axis=1)])
         top_true = leaf_groups(tree)[zleaf]
         assert (top_hat == top_true).mean() > 0.95
+
+
+class TestGaussianLeafPriors:
+    """Weakly-informative priors on Gaussian leaves (μ ~ N(0, s_mu),
+    σ ~ half-N(0, s_sigma)). A deep tree routinely has leaves with no
+    assigned observations; under a flat prior their posterior is
+    improper and long NUTS runs drift into σ→0 density spikes (observed
+    as a 71% divergence rate on the 63-leaf Jangmin fit at the
+    reference MCMC budget — 0.4% with the priors)."""
+
+    def test_log_prior_value_and_flat_optout(self):
+        from hhmm_tpu.hhmm.examples import hier2x2_tree
+        from scipy.stats import norm
+
+        m = TreeHMM(hier2x2_tree(), order_mu="none")
+        params = m.spec_params()
+        mu = np.asarray(m._mu(params))
+        sigma = np.asarray(params["sigma"])
+        expected = norm.logpdf(mu, 0, 10.0).sum() + norm.logpdf(sigma, 0, 3.0).sum()
+        np.testing.assert_allclose(float(m.log_prior(params)), expected, rtol=1e-5)
+
+        flat = TreeHMM(hier2x2_tree(), order_mu="none",
+                       prior_mu_scale=None, prior_sigma_scale=None)
+        assert float(flat.log_prior(params)) == 0.0
+
+    def test_prior_regularizes_empty_leaves(self):
+        """Fit a tree where half the leaves never emit: the posterior σ
+        for empty leaves must stay on the prior scale, not collapse."""
+        from hhmm_tpu.hhmm.examples import hier2x2_tree
+
+        tree = hier2x2_tree()
+        rng = np.random.default_rng(0)
+        # observations only from the left component pair (≈ ±5 region)
+        x = jnp.asarray(rng.normal(5.0, 1.0, size=80).astype(np.float32))
+        m = TreeHMM(tree, order_mu="none")
+        data = {"x": x}
+        cfg = SamplerConfig(num_warmup=120, num_samples=120, num_chains=1, max_treedepth=6)
+        theta0 = m.init_unconstrained(jax.random.PRNGKey(1), data)
+        qs, stats = sample_nuts(None, jax.random.PRNGKey(2), theta0, cfg, vg_fn=m.make_vg(data))
+        assert float(np.asarray(stats["diverging"]).mean()) < 0.1
+        sig = np.asarray(m.constrained_draws(qs)["sigma"]).reshape(-1, m.K)
+        assert sig.min() > 1e-3  # no σ→0 collapse anywhere
