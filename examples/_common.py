@@ -19,6 +19,14 @@ def standard_parser(description: str) -> argparse.ArgumentParser:
     ap.add_argument("--samples", type=int, default=300)
     ap.add_argument("--chains", type=int, default=2)
     ap.add_argument("--max-treedepth", type=int, default=6)
+    ap.add_argument(
+        "--sampler",
+        choices=["nuts", "chees"],
+        default="nuts",
+        help="nuts (default; Stan semantics) or chees — cross-chain "
+        "adaptive HMC (hhmm_tpu/infer/chees.py), needs chains >= 2",
+    )
+    ap.add_argument("--max-leapfrogs", type=int, default=32, help="ChEES leapfrog cap")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--cpu", action="store_true", help="force the CPU backend")
     ap.add_argument(
@@ -33,21 +41,36 @@ def standard_parser(description: str) -> argparse.ArgumentParser:
 
 
 def configure(args):
-    """Apply --cpu/--quick and return a SamplerConfig."""
+    """Apply --cpu/--quick and return a SamplerConfig or ChEESConfig
+    (per --sampler; fit_batched and run_sampler dispatch on the type)."""
     if args.cpu:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
     if args.quick:
         args.warmup, args.samples, args.chains = 50, 50, 1
-    from hhmm_tpu.infer import SamplerConfig
+    from hhmm_tpu.infer import ChEESConfig, SamplerConfig
 
+    if getattr(args, "sampler", "nuts") == "chees":
+        return ChEESConfig(
+            num_warmup=args.warmup,
+            num_samples=args.samples,
+            num_chains=max(2, args.chains),  # cross-chain adaptation
+            max_leapfrogs=args.max_leapfrogs,
+        )
     return SamplerConfig(
         num_warmup=args.warmup,
         num_samples=args.samples,
         num_chains=args.chains,
         max_treedepth=args.max_treedepth,
     )
+
+
+def run_sampler(logp_fn, key, init_q, config, vg_fn=None):
+    """Alias for :func:`hhmm_tpu.infer.sample` (config-type dispatch)."""
+    from hhmm_tpu.infer import sample
+
+    return sample(logp_fn, key, init_q, config, vg_fn=vg_fn)
 
 
 def print_summary(samples: dict, top: int = 12) -> None:

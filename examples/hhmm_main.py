@@ -14,7 +14,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
-from _common import configure, print_summary, standard_parser
+from _common import configure, print_summary, run_sampler, standard_parser
 
 
 def main() -> None:
@@ -32,7 +32,7 @@ def main() -> None:
     from hhmm_tpu.hhmm.examples import fine1998_tree, hier2x2_tree
     from hhmm_tpu.hhmm.simulate import hhmm_sim
     from hhmm_tpu.hhmm.structure import leaf_groups
-    from hhmm_tpu.infer import sample_nuts
+
     from hhmm_tpu.models import TreeHMM
 
     tree_fn = hier2x2_tree if args.tree == "hier2x2" else fine1998_tree
@@ -51,8 +51,10 @@ def main() -> None:
     data = {"x": jnp.asarray(x)}
     if semisup:
         data["g"] = jnp.asarray(g)
-    theta0 = model.init_unconstrained(jax.random.PRNGKey(args.seed + 1), data)
-    qs, stats = sample_nuts(
+    from hhmm_tpu.infer import init_chains
+
+    theta0 = init_chains(model, jax.random.PRNGKey(args.seed + 1), data, cfg.num_chains)
+    qs, stats = run_sampler(
         None, jax.random.PRNGKey(args.seed + 2), theta0, cfg, vg_fn=model.make_vg(data)
     )
     print(f"divergence rate: {float(np.asarray(stats['diverging']).mean()):.4f}")

@@ -14,7 +14,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
-from _common import configure, print_summary, save_figure, standard_parser
+from _common import configure, print_summary, run_sampler, save_figure, standard_parser
 
 
 def main() -> None:
@@ -27,7 +27,7 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    from hhmm_tpu.infer import confusion_matrix, greedy_relabel, sample_nuts
+    from hhmm_tpu.infer import confusion_matrix, greedy_relabel
     from hhmm_tpu.models import GaussianHMM, MultinomialHMM, SemisupMultinomialHMM
     from hhmm_tpu.sim import hmm_sim, obsmodel_categorical, obsmodel_gaussian
 
@@ -66,8 +66,10 @@ def main() -> None:
         model = SemisupMultinomialHMM(K=K, L=L, groups=groups, gate_mode="hard")
         data = {"x": jnp.asarray(np.asarray(x, np.int32)), "g": jnp.asarray(g)}
 
-    theta0 = model.init_unconstrained(jax.random.PRNGKey(args.seed + 1), data)
-    qs, stats = sample_nuts(
+    from hhmm_tpu.infer import init_chains
+
+    theta0 = init_chains(model, jax.random.PRNGKey(args.seed + 1), data, cfg.num_chains)
+    qs, stats = run_sampler(
         None, jax.random.PRNGKey(args.seed + 2), theta0, cfg, vg_fn=model.make_vg(data)
     )
     print(f"divergence rate: {float(np.asarray(stats['diverging']).mean()):.4f}")

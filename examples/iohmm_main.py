@@ -13,7 +13,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
-from _common import configure, print_summary, save_figure, standard_parser
+from _common import configure, print_summary, run_sampler, save_figure, standard_parser
 
 
 def main() -> None:
@@ -26,7 +26,7 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    from hhmm_tpu.infer import confusion_matrix, greedy_relabel, sample_nuts
+    from hhmm_tpu.infer import confusion_matrix, greedy_relabel
     from hhmm_tpu.models import IOHMMHMix, IOHMMReg
     from hhmm_tpu.sim import iohmm_sim, obsmodel_mix, obsmodel_reg
 
@@ -54,8 +54,10 @@ def main() -> None:
         model = IOHMMHMix(K=K, M=M, L=L, hyperparams=DEFAULT_HYPERPARAMS)
 
     data = {"u": jnp.asarray(sim["u"]), "x": jnp.asarray(sim["x"])}
-    theta0 = model.init_unconstrained(jax.random.PRNGKey(args.seed + 1), data)
-    qs, stats = sample_nuts(
+    from hhmm_tpu.infer import init_chains
+
+    theta0 = init_chains(model, jax.random.PRNGKey(args.seed + 1), data, cfg.num_chains)
+    qs, stats = run_sampler(
         None, jax.random.PRNGKey(args.seed + 2), theta0, cfg, vg_fn=model.make_vg(data)
     )
     print(f"divergence rate: {float(np.asarray(stats['diverging']).mean()):.4f}")

@@ -30,7 +30,7 @@ import numpy as np
 from hhmm_tpu.apps.hassan.data import Dataset, make_dataset
 from hhmm_tpu.apps.hassan.forecast import forecast_errors, neighbouring_forecast
 from hhmm_tpu.batch import fit_batched
-from hhmm_tpu.infer import ChEESConfig, SamplerConfig, sample_chees, sample_nuts
+from hhmm_tpu.infer import SamplerConfig, init_chains, sample
 from hhmm_tpu.models import IOHMMHMixLite
 
 __all__ = ["WFForecastResult", "wf_forecast"]
@@ -96,16 +96,12 @@ def wf_forecast(
     if warm_start:
         pilot_data = {"x": jnp.asarray(datasets[0].x), "u": jnp.asarray(datasets[0].u)}
         # same config, smaller draw budget: replace() keeps every other
-        # adaptation knob the caller set
+        # adaptation knob the caller set; sample() dispatches on type
         pilot_cfg = replace(config, num_samples=max(50, config.num_samples // 4))
-        pilot_sampler = sample_chees if isinstance(config, ChEESConfig) else sample_nuts
-        pilot_init = jnp.stack(
-            [
-                model.init_unconstrained(k, pilot_data)
-                for k in jax.random.split(jax.random.fold_in(key, 99), config.num_chains)
-            ]
+        pilot_init = init_chains(
+            model, jax.random.fold_in(key, 99), pilot_data, config.num_chains
         )
-        pilot_qs, _ = pilot_sampler(
+        pilot_qs, _ = sample(
             model.make_logp(pilot_data), jax.random.fold_in(key, 98), pilot_init, pilot_cfg
         )
         seed_theta = jnp.asarray(np.asarray(pilot_qs).mean(axis=1))  # [chains, dim]

@@ -24,7 +24,7 @@ import numpy as np
 from hhmm_tpu.hhmm.examples import jangmin2004_tree
 from hhmm_tpu.hhmm.simulate import hhmm_sim
 from hhmm_tpu.hhmm.structure import leaf_groups
-from hhmm_tpu.infer import SamplerConfig, sample_nuts
+from hhmm_tpu.infer import SamplerConfig, init_chains, sample
 from hhmm_tpu.models import TreeHMM
 
 __all__ = [
@@ -119,8 +119,8 @@ def fit_market(
     model = TreeHMM(tree, semisup=True, gate_mode=gate_mode, order_mu="none")
     data = {"x": jnp.asarray(np.asarray(x, np.float64)), "g": jnp.asarray(np.asarray(g))}
     k_init, k_nuts = jax.random.split(key)
-    theta0 = model.init_unconstrained(k_init, data)
-    qs, stats = sample_nuts(None, k_nuts, theta0, config, vg_fn=model.make_vg(data))
+    theta0 = init_chains(model, k_init, data, config.num_chains)
+    qs, stats = sample(None, k_nuts, theta0, config, vg_fn=model.make_vg(data))
 
     # unsupervised decode: same parameter space (specs are independent
     # of the semisup flag), no label gating

@@ -27,7 +27,7 @@ from hhmm_tpu.apps.tayal.features import (
     to_model_inputs,
 )
 from hhmm_tpu.apps.tayal.trading import Trades, buyandhold, topstate_trading
-from hhmm_tpu.infer import SamplerConfig, sample_nuts
+from hhmm_tpu.infer import SamplerConfig, init_chains, sample
 from hhmm_tpu.models import TayalHHMMLite
 
 __all__ = [
@@ -148,13 +148,8 @@ def run_window(
         "x_oos": jnp.asarray(x[n_ins:]),
         "sign_oos": jnp.asarray(sign[n_ins:]),
     }
-    init = jnp.stack(
-        [
-            model.init_unconstrained(k, data)
-            for k in jax.random.split(jax.random.fold_in(key, 1), config.num_chains)
-        ]
-    )
-    qs, stats = sample_nuts(model.make_logp(data), key, init, config)
+    init = init_chains(model, jax.random.fold_in(key, 1), data, config.num_chains)
+    qs, stats = sample(model.make_logp(data), key, init, config)
 
     # thin draws for generated quantities (reference computes per draw)
     leg_state = decode_states(model, qs, data)

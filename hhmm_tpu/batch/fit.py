@@ -30,13 +30,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from hhmm_tpu.batch.cache import ResultCache, digest_key
-from hhmm_tpu.infer.chees import (
-    ChEESConfig,
-    make_lp_bc,
-    sample_chees,
-    sample_chees_batched,
-)
-from hhmm_tpu.infer.run import SamplerConfig, sample_nuts
+from hhmm_tpu.infer.api import sample
+from hhmm_tpu.infer.chees import ChEESConfig, make_lp_bc, sample_chees_batched
+from hhmm_tpu.infer.run import SamplerConfig
 
 __all__ = ["default_init", "fit_batched"]
 
@@ -152,12 +148,10 @@ def fit_batched(
                 probe_vg=model.make_vg({k: v[0] for k, v in chunk_data.items()}),
             )
 
-        sampler = sample_chees if chees else sample_nuts
-
         def one(args):
             per_series, qi, ki = args
             vg = model.make_vg(per_series)
-            return sampler(None, ki, qi, config, jit=False, vg_fn=vg)
+            return sample(None, ki, qi, config, jit=False, vg_fn=vg)
 
         return jax.vmap(lambda *xs: one((dict(zip(data_keys, xs[:-2])), xs[-2], xs[-1])))(
             *[chunk_data[k] for k in data_keys], chunk_init, chunk_keys

@@ -5,10 +5,13 @@ from hhmm_tpu.infer.chees import (
     make_lp_bc,
     ChEESConfig,
 )
+from hhmm_tpu.infer.api import init_chains, sample
 from hhmm_tpu.infer.diagnostics import split_rhat, ess, summary
 from hhmm_tpu.infer.relabel import greedy_relabel, confusion_matrix, apply_relabel
 
 __all__ = [
+    "sample",
+    "init_chains",
     "sample_nuts",
     "SamplerConfig",
     "sample_chees",

@@ -1,0 +1,46 @@
+"""Sampler-agnostic entry points.
+
+Two sampler families exist (`infer/run.py` NUTS, `infer/chees.py`
+ChEES-HMC) selected by the *config type* — the same convention
+`batch/fit.py::fit_batched` uses. Every consumer that accepts "a
+sampler config" should call :func:`sample` rather than hard-coding
+``sample_nuts``, so a :class:`ChEESConfig` works anywhere a
+:class:`SamplerConfig` does.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from hhmm_tpu.infer.chees import ChEESConfig, sample_chees
+from hhmm_tpu.infer.run import sample_nuts
+
+__all__ = ["init_chains", "sample"]
+
+
+def sample(
+    logp_fn: Optional[Callable],
+    key: jax.Array,
+    init_q: jnp.ndarray,
+    config,
+    jit: bool = True,
+    vg_fn: Optional[Callable] = None,
+):
+    """Run the sampler selected by ``type(config)`` (SamplerConfig →
+    NUTS, ChEESConfig → ChEES). Same signature/returns as
+    :func:`sample_nuts`: ``(samples [chains, draws, dim], stats)``."""
+    sampler = sample_chees if isinstance(config, ChEESConfig) else sample_nuts
+    return sampler(logp_fn, key, init_q, config, jit=jit, vg_fn=vg_fn)
+
+
+def init_chains(model, key: jax.Array, data, n_chains: int) -> jnp.ndarray:
+    """Stack ``n_chains`` dispersed ``model.init_unconstrained`` draws
+    into [n_chains, dim] — the per-chain init every driver needs
+    (ChEES additionally relies on dispersed starts for its cross-chain
+    criterion)."""
+    return jnp.stack(
+        [model.init_unconstrained(k, data) for k in jax.random.split(key, n_chains)]
+    )
