@@ -32,6 +32,7 @@ import numpy as np
 from hhmm_tpu.batch.cache import ResultCache, digest_key
 from hhmm_tpu.infer.api import sample
 from hhmm_tpu.infer.chees import ChEESConfig, make_lp_bc, sample_chees_batched
+from hhmm_tpu.infer.gibbs import GibbsConfig, sample_gibbs
 from hhmm_tpu.infer.run import SamplerConfig
 
 __all__ = ["default_init", "fit_batched"]
@@ -99,7 +100,9 @@ def fit_batched(
     The sampler is selected by the type of ``config``: a
     :class:`SamplerConfig` runs NUTS, a :class:`ChEESConfig` runs
     cross-chain-adaptive ChEES-HMC (`infer/chees.py` — the chain axis is
-    per-series, so its adaptation reductions stay within each series).
+    per-series, so its adaptation reductions stay within each series),
+    and a :class:`GibbsConfig` runs blocked conjugate Gibbs
+    (`infer/gibbs.py` — the model must implement ``gibbs_update``).
     """
     data = {k: jnp.asarray(v) for k, v in data.items() if v is not None}
     sizes = {v.shape[0] for v in data.values()}
@@ -148,10 +151,18 @@ def fit_batched(
                 probe_vg=model.make_vg({k: v[0] for k, v in chunk_data.items()}),
             )
 
-        def one(args):
-            per_series, qi, ki = args
-            vg = model.make_vg(per_series)
-            return sample(None, ki, qi, config, jit=False, vg_fn=vg)
+        if isinstance(config, GibbsConfig):
+
+            def one(args):
+                per_series, qi, ki = args
+                return sample_gibbs(model, per_series, ki, config, init_q=qi, jit=False)
+
+        else:
+
+            def one(args):
+                per_series, qi, ki = args
+                vg = model.make_vg(per_series)
+                return sample(None, ki, qi, config, jit=False, vg_fn=vg)
 
         return jax.vmap(lambda *xs: one((dict(zip(data_keys, xs[:-2])), xs[-2], xs[-1])))(
             *[chunk_data[k] for k in data_keys], chunk_init, chunk_keys
@@ -194,8 +205,12 @@ def fit_batched(
             vars(config),
             np.asarray(chunk_keys),
             # v2: the _da_init log_eps_bar fix (infer/run.py) changed
-            # short-warmup draws for both samplers
-            "sampler=chees-vg-v2" if chees else "sampler=vg-v2",  # sampling-path identity: bump when the
+            # short-warmup draws for both HMC samplers
+            (
+                "sampler=gibbs-v1"
+                if isinstance(config, GibbsConfig)
+                else "sampler=chees-vg-v2" if chees else "sampler=vg-v2"
+            ),  # sampling-path identity: bump when the
             # draw-producing path changes so stale cache entries from a
             # numerically different (if statistically equivalent) path
             # are never mixed into a resumed sweep

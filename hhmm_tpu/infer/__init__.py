@@ -6,6 +6,7 @@ from hhmm_tpu.infer.chees import (
     ChEESConfig,
 )
 from hhmm_tpu.infer.api import init_chains, sample
+from hhmm_tpu.infer.gibbs import GibbsConfig, sample_gibbs
 from hhmm_tpu.infer.diagnostics import split_rhat, ess, summary
 from hhmm_tpu.infer.relabel import greedy_relabel, confusion_matrix, apply_relabel
 
@@ -18,6 +19,8 @@ __all__ = [
     "sample_chees_batched",
     "make_lp_bc",
     "ChEESConfig",
+    "sample_gibbs",
+    "GibbsConfig",
     "split_rhat",
     "ess",
     "summary",

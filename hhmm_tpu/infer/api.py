@@ -31,7 +31,20 @@ def sample(
 ):
     """Run the sampler selected by ``type(config)`` (SamplerConfig →
     NUTS, ChEESConfig → ChEES). Same signature/returns as
-    :func:`sample_nuts`: ``(samples [chains, draws, dim], stats)``."""
+    :func:`sample_nuts`: ``(samples [chains, draws, dim], stats)``.
+
+    A :class:`~hhmm_tpu.infer.gibbs.GibbsConfig` is rejected here: the
+    Gibbs sampler needs the model and data (its parameter block draws
+    from count posteriors), not a density — use
+    :func:`~hhmm_tpu.infer.gibbs.sample_gibbs` or ``fit_batched``,
+    which both accept it."""
+    from hhmm_tpu.infer.gibbs import GibbsConfig
+
+    if isinstance(config, GibbsConfig):
+        raise TypeError(
+            "sample() is density-based; GibbsConfig needs the model and "
+            "data — call sample_gibbs(model, data, ...) or fit_batched"
+        )
     sampler = sample_chees if isinstance(config, ChEESConfig) else sample_nuts
     return sampler(logp_fn, key, init_q, config, jit=jit, vg_fn=vg_fn)
 

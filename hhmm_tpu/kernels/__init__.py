@@ -5,7 +5,7 @@ from hhmm_tpu.kernels.filtering import (
     forward_backward,
 )
 from hhmm_tpu.kernels.viterbi import viterbi
-from hhmm_tpu.kernels.ffbs import ffbs_sample
+from hhmm_tpu.kernels.ffbs import backward_sample, ffbs_sample
 from hhmm_tpu.kernels.grad import forward_loglik
 from hhmm_tpu.kernels.assoc import forward_filter_assoc, forward_filter_seqshard
 
@@ -17,6 +17,7 @@ __all__ = [
     "smooth",
     "forward_backward",
     "viterbi",
+    "backward_sample",
     "ffbs_sample",
     "forward_loglik",
 ]

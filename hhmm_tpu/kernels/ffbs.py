@@ -8,6 +8,11 @@ Gibbs samplers over (θ, z).
 
 Backward sampling: ``z_T ~ Cat(softmax(log_alpha[T]))``;
 ``z_t ~ Cat(softmax(log_alpha[t] + log_A_t[:, z_{t+1}]))``.
+
+:func:`backward_sample` is exposed separately so a caller that already
+ran the forward filter (e.g. the blocked Gibbs step, which also needs
+the marginal log-likelihood) pays only the backward scan;
+:func:`ffbs_sample` is the fused convenience form.
 """
 
 from __future__ import annotations
@@ -20,24 +25,20 @@ from jax import lax
 
 from hhmm_tpu.kernels.filtering import forward_filter, _split_A
 
-__all__ = ["ffbs_sample"]
+__all__ = ["backward_sample", "ffbs_sample"]
 
 
-def ffbs_sample(
+def backward_sample(
     key: jax.Array,
-    log_pi: jnp.ndarray,
+    log_alpha: jnp.ndarray,
     log_A: jnp.ndarray,
-    log_obs: jnp.ndarray,
     mask: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
-    """Sample one state path ``z [T] int32`` from the smoothing posterior.
-
-    With a tail-padding ``mask``, padded steps repeat the last valid state.
-    """
-    T, K = log_obs.shape
+    """Sample ``z [T] int32`` given a forward filter ``log_alpha [T, K]``
+    (one backward scan). With a tail-padding ``mask``, padded steps
+    repeat the last valid state."""
+    T, K = log_alpha.shape
     A_t = _split_A(log_A, T)
-
-    log_alpha, _ = forward_filter(log_pi, log_A, log_obs, mask)
 
     key_last, key_rest = jax.random.split(key)
     z_last = jax.random.categorical(key_last, log_alpha[T - 1])
@@ -58,7 +59,7 @@ def ffbs_sample(
             z = jnp.where(m_next > 0, z, jax.random.categorical(k, alpha_t))
         return z, z
 
-    m = jnp.ones((T,), log_obs.dtype) if mask is None else mask
+    m = jnp.ones((T,), log_alpha.dtype) if mask is None else mask
     if A_t is None:
         xs = (keys, log_alpha[:-1], m[1:])
     else:
@@ -70,3 +71,16 @@ def ffbs_sample(
         T_last = jnp.sum(m).astype(jnp.int32) - 1
         z = jnp.where(jnp.arange(T) <= T_last, z, z[T_last])
     return z
+
+
+def ffbs_sample(
+    key: jax.Array,
+    log_pi: jnp.ndarray,
+    log_A: jnp.ndarray,
+    log_obs: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Sample one state path ``z [T] int32`` from the smoothing posterior
+    (forward filter + backward sample)."""
+    log_alpha, _ = forward_filter(log_pi, log_A, log_obs, mask)
+    return backward_sample(key, log_alpha, log_A, mask)

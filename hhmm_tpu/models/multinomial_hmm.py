@@ -62,6 +62,26 @@ class MultinomialHMM(BaseHMMModel):
             data.get("mask"),
         )
 
+    def gibbs_update(self, key, z, data):
+        """Conjugate parameter block for blocked Gibbs
+        (`infer/gibbs.py`): with the model's flat Dirichlet(1) priors,
+        p_1k | z ~ Dir(1 + 1[z_1]), A rows ~ Dir(1 + transition
+        counts), phi rows ~ Dir(1 + emission counts)."""
+        from hhmm_tpu.infer.gibbs import emission_counts, transition_counts
+
+        x = data["x"].astype(jnp.int32)
+        mask = data.get("mask")
+        k1, k2, k3 = jax.random.split(key, 3)
+        n_trans = transition_counts(z, self.K, mask)
+        c_emis = emission_counts(z, x, self.K, self.L, mask)
+        return {
+            "p_1k": jax.random.dirichlet(
+                k1, 1.0 + jax.nn.one_hot(z[0], self.K, dtype=jnp.float32)
+            ),
+            "A_ij": jax.random.dirichlet(k2, 1.0 + n_trans),
+            "phi_k": jax.random.dirichlet(k3, 1.0 + c_emis),
+        }
+
 
 class SemisupMultinomialHMM(MultinomialHMM):
     """Adds observed group evidence g[t] gating the transition term.

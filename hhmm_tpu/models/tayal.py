@@ -140,6 +140,33 @@ class TayalHHMM(BaseHMMModel):
         ).astype(jnp.float32)  # [K]
         return sign, state_sign
 
+    def gibbs_update(self, key, z, data):
+        """Conjugate parameter block for blocked Gibbs
+        (`infer/gibbs.py`, ``gate_mode="hard"`` only): with the model's
+        flat priors, p_11 | z_1 ~ Beta(1 + 1[z_1=0], 1 + 1[z_1=2]);
+        the two free transition rows ~ Dir(1 + counts) restricted to
+        their support (0 → {1,2}, 2 → {0,3}); phi rows ~ Dir(1 +
+        emission counts). Rows 1→0 and 3→2 are deterministic."""
+        from hhmm_tpu.infer.gibbs import emission_counts, transition_counts
+
+        x = data["x"].astype(jnp.int32)
+        mask = data.get("mask")
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        n = transition_counts(z, self.K, mask)
+        c_emis = emission_counts(z, x, self.K, self.L, mask)
+        a0 = jax.random.dirichlet(k2, 1.0 + jnp.stack([n[0, 1], n[0, 2]]))
+        a2 = jax.random.dirichlet(k3, 1.0 + jnp.stack([n[2, 0], n[2, 3]]))
+        p11 = jax.random.beta(
+            k1,
+            1.0 + (z[0] == 0).astype(jnp.float32),
+            1.0 + (z[0] == 2).astype(jnp.float32),
+        )
+        return {
+            "p_11": p11,
+            "A_row": jnp.stack([a0, a2]),
+            "phi_k": jax.random.dirichlet(k4, 1.0 + c_emis),
+        }
+
     def init_unconstrained(self, key, data):
         """Informed chain init: phi rows start at the empirical symbol
         frequencies of same-sign legs (up states ← up-leg frequencies,

@@ -1,0 +1,164 @@
+"""Blocked conjugate Gibbs sampler tests (`infer/gibbs.py`).
+
+Validation mirrors the other samplers (SURVEY.md §4 discipline):
+cross-sampler posterior agreement against NUTS on the identical
+posterior, SBC rank uniformity through the batched engine, and the
+guard rails (non-conjugate gate mode, models without a conjugate
+block).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.stats import kstest
+
+from hhmm_tpu.batch import fit_batched
+from hhmm_tpu.infer import (
+    GibbsConfig,
+    SamplerConfig,
+    init_chains,
+    sample_gibbs,
+    sample_nuts,
+)
+from hhmm_tpu.models import GaussianHMM, MultinomialHMM, TayalHHMM
+from hhmm_tpu.models.tayal import _UP_STATES, UP
+from hhmm_tpu.sim import hmm_sim, obsmodel_categorical
+
+
+class TestGuards:
+    def test_requires_gibbs_update(self):
+        with pytest.raises(ValueError, match="gibbs_update"):
+            sample_gibbs(GaussianHMM(K=2), {"x": np.zeros(10, np.float32)}, jax.random.PRNGKey(0))
+
+    def test_rejects_stan_gate(self):
+        with pytest.raises(ValueError, match="hard"):
+            sample_gibbs(
+                TayalHHMM(gate_mode="stan"),
+                {"x": np.zeros(10, np.int32), "sign": np.zeros(10, np.int32)},
+                jax.random.PRNGKey(0),
+            )
+
+
+class TestCrossSamplerAgreement:
+    def test_matches_nuts_on_multinomial_hmm(self):
+        """Gibbs and NUTS target the identical flat-prior posterior;
+        pooled canonicalized posterior means must agree to MC error."""
+        K, L, T = 2, 3, 300
+        model = MultinomialHMM(K=K, L=L)
+        A = np.array([[0.85, 0.15], [0.25, 0.75]])
+        p1 = np.array([0.6, 0.4])
+        phi = np.array([[0.7, 0.2, 0.1], [0.1, 0.3, 0.6]])
+        z, x = hmm_sim(
+            jax.random.PRNGKey(5), T, A, p1, obsmodel_categorical(phi), validate=False
+        )
+        data = {"x": np.asarray(x, np.int32)}
+
+        def canon(qs):
+            d = model.constrained_draws(qs.reshape(-1, qs.shape[-1]))
+            phid = np.asarray(d["phi_k"]).reshape(-1, K, L)
+            Ad = np.asarray(d["A_ij"]).reshape(-1, K, K)
+            o = np.argsort(phid[:, :, 0], axis=1)
+            i = np.arange(len(phid))[:, None]
+            phid = phid[i, o]
+            Ad = Ad[i[:, :, None], o[:, :, None], o[:, None, :]]
+            return np.concatenate([phid.mean(0).ravel(), Ad.mean(0).ravel()])
+
+        qg, sg = sample_gibbs(
+            model, data, jax.random.PRNGKey(0),
+            GibbsConfig(num_warmup=200, num_samples=800, num_chains=2),
+        )
+        qn, _ = sample_nuts(
+            model.make_logp({"x": jnp.asarray(data["x"])}),
+            jax.random.PRNGKey(0),
+            init_chains(model, jax.random.PRNGKey(1), data, 2),
+            SamplerConfig(num_warmup=250, num_samples=400, num_chains=2, max_treedepth=6),
+        )
+        assert np.isfinite(np.asarray(sg["logp"])).all()
+        np.testing.assert_allclose(canon(qg), canon(qn), atol=0.05)
+
+
+class TestSBCGibbs:
+    def test_rank_uniformity_tayal(self, rng):
+        """SBC through fit_batched with the Gibbs sampler on the Tayal
+        hard-gate model (the bench.py --sampler gibbs path): ranks of
+        prior draws among posterior draws must be uniform."""
+        N_REPS, THIN = 12, 4
+        model = TayalHHMM(gate_mode="hard")
+        datasets, trues = [], []
+        for _ in range(N_REPS):
+            p11 = rng.uniform()
+            A_row = rng.dirichlet(np.ones(2), size=2)
+            phi = rng.dirichlet(np.ones(9), size=4)
+            params = {
+                "p_11": jnp.asarray(p11),
+                "A_row": jnp.asarray(A_row),
+                "phi_k": jnp.asarray(phi),
+            }
+            pi, A = model.assemble(params)
+            z, x = hmm_sim(
+                jax.random.PRNGKey(int(rng.integers(1 << 30))),
+                300,
+                np.asarray(A),
+                np.asarray(pi),
+                obsmodel_categorical(phi),
+                validate=False,
+            )
+            sign = np.where(_UP_STATES[np.asarray(z)], UP, 1 - UP)
+            datasets.append(
+                {
+                    "x": np.asarray(x, np.int32),
+                    "sign": sign.astype(np.int32),
+                    "mask": np.ones(300, np.float32),
+                }
+            )
+            trues.append(
+                np.concatenate([[p11], [A_row[0, 0], A_row[1, 0]], phi[:, 0], [phi[2, 4]]])
+            )
+        data = {k: jnp.asarray(np.stack([d[k] for d in datasets])) for k in datasets[0]}
+        cfg = GibbsConfig(num_warmup=100, num_samples=400, num_chains=1)
+        qs, stats = fit_batched(model, data, jax.random.PRNGKey(0), cfg, chunk_size=N_REPS)
+
+        units = []
+        for i in range(N_REPS):
+            draws = model.constrained_draws(qs[i].reshape(-1, qs.shape[-1]))
+            flat = np.column_stack(
+                [
+                    np.asarray(draws["p_11"]).reshape(-1),
+                    np.asarray(draws["A_row"]).reshape(-1, 4)[:, 0],
+                    np.asarray(draws["A_row"]).reshape(-1, 4)[:, 2],
+                    *[np.asarray(draws["phi_k"]).reshape(-1, 4, 9)[:, k, 0] for k in range(4)],
+                    np.asarray(draws["phi_k"]).reshape(-1, 4, 9)[:, 2, 4],
+                ]
+            )
+            thinned = flat[::THIN]
+            r = (thinned < trues[i][None, :]).sum(axis=0)
+            units.append((r + 0.5) / (thinned.shape[0] + 1))
+        u = np.concatenate(units)
+        assert 0.30 < u.mean() < 0.70, f"rank mean {u.mean():.3f}"
+        p = kstest(u, "uniform").pvalue
+        assert p > 1e-3, f"KS uniformity p={p:.2e}"
+
+
+class TestMaskedEquivalence:
+    def test_padded_matches_truncated_counts(self):
+        """The conjugate count helpers must ignore padded steps: a
+        padded series gives identical count matrices to the truncated
+        one (the invariant the masked loglik already satisfies)."""
+        from hhmm_tpu.infer.gibbs import emission_counts, transition_counts
+
+        rng = np.random.default_rng(0)
+        T, K, L = 50, 3, 4
+        z = jnp.asarray(rng.integers(0, K, T), jnp.int32)
+        x = jnp.asarray(rng.integers(0, L, T), jnp.int32)
+        z_pad = jnp.concatenate([z, jnp.full(10, z[-1], jnp.int32)])
+        x_pad = jnp.concatenate([x, jnp.zeros(10, jnp.int32)])
+        mask = jnp.concatenate([jnp.ones(T), jnp.zeros(10)]).astype(jnp.float32)
+        np.testing.assert_array_equal(
+            np.asarray(transition_counts(z_pad, K, mask)),
+            np.asarray(transition_counts(z, K, None)),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(emission_counts(z_pad, x_pad, K, L, mask)),
+            np.asarray(emission_counts(z, x, K, L, None)),
+        )
