@@ -14,27 +14,32 @@ Baseline: the reference fits each series with RStan NUTS at 500 iter /
 1/120 series/sec. ``vs_baseline`` is the speedup factor; the north-star
 target is ≥50×.
 
-Default sampler: shared-adaptation ChEES-HMC (`infer/chees.py`) — every
-chain in the batch takes the identical leapfrog count per transition, so
-the vmapped program has zero lockstep waste. Measured on this workload
-(128 series, T=1024, v5e chip; ESS of lp__ per series, zero divergences
-everywhere):
+Default sampler: blocked conjugate Gibbs (`infer/gibbs.py`) — the
+model's flat priors are Dirichlet/Beta-conjugate, so each draw is ONE
+fused Pallas FFBS kernel launch (`kernels/pallas_ffbs.py`: forward
+filter + backward state sampling entirely in VMEM) plus closed-form
+count draws. No gradients, no trajectories. The sign-gated model runs
+in hard-gate form, which is semantically identical on zig-zag legs
+(signs strictly alternate by construction; SBC-validated either way).
 
-    NUTS  depth<=5, 250w+250s, 1 chain:   36 series/s, ESS 19,  700 ESS/s
-    ChEES cap 32,  150w+150s, 2 chains:  105 series/s, ESS 33, 3430 ESS/s
-    ChEES cap 16,  150w+150s, 2 chains:  196 series/s, ESS 20, 3960 ESS/s
+Measured ladder on this workload (T=1024, v5e chip; ESS of lp__ per
+series, zero divergences everywhere; 256-series single dispatch unless
+noted):
 
-(ladder measured at chunk=128; the full 256-series single-dispatch run
-hits 232 series/s, ~27800x baseline.) The default (cap 16) matches the
-reference sampler's per-series ESS at ~5-6x the series throughput;
-`--sampler nuts` reproduces Stan semantics exactly. `--sampler gibbs`
-runs gradient-free blocked conjugate Gibbs (FFBS + Dirichlet/Beta
-draws, infer/gibbs.py) on the hard-gate model: 218 series/s at ESS 46
-— ~10100 ESS/s, 2.4x ChEES and 14x NUTS sampling efficiency; all three
-samplers are latency-bound at ~1.2 s per 256-series dispatch by the
-sequential T=1024 scans. Calibration evidence for every sampler:
-tests/test_sbc.py, tests/test_chees.py, tests/test_gibbs.py (SBC rank
-uniformity + cross-sampler agreement).
+    NUTS  depth<=5, 250w+250s, 1 chain:    36 series/s, ESS 19,   700 ESS/s
+    ChEES cap 32, 150w+150s, 2 chains*:   105 series/s, ESS 33,  3430 ESS/s
+    ChEES cap 16, 150w+150s, 2 chains:    226 series/s, ESS 19,  4200 ESS/s
+    Gibbs (scan FFBS), 50w+250s:          218 series/s, ESS 46, 10100 ESS/s
+    Gibbs (fused Pallas FFBS), 50w+250s: 1500 series/s, ESS 45, 68000 ESS/s
+    (* = 128-series chunks)
+
+The HMC samplers are latency-bound by sequential XLA scans (~1.2 s per
+dispatch); the fused FFBS removes that floor. `--sampler chees` is the
+general-model batch sampler (shared cross-chain adaptation, zero
+lockstep waste); `--sampler nuts` reproduces Stan semantics exactly.
+Calibration evidence for every sampler: tests/test_sbc.py,
+tests/test_chees.py, tests/test_gibbs.py, tests/test_pallas_ffbs.py
+(SBC rank uniformity + cross-sampler agreement + kernel parity).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -62,13 +67,14 @@ def main() -> None:
         "--warmup",
         type=int,
         default=None,
-        help="default: 150 (chees) / 250 (nuts, matching the reference budget)",
+        help="default: 50 (gibbs burn-in) / 150 (chees) / 250 (nuts, "
+        "matching the reference budget)",
     )
     ap.add_argument(
         "--samples",
         type=int,
         default=None,
-        help="default: 150 (chees; x2 chains pools 300 draws) / 250 (nuts)",
+        help="default: 250 (gibbs, nuts) / 150 (chees; x2 chains pools 300 draws)",
     )
     # Treedepth bound: in a vmapped batch every series steps in lockstep,
     # so the whole batch pays the deepest trajectory. Measured on this
@@ -92,18 +98,19 @@ def main() -> None:
     ap.add_argument(
         "--sampler",
         choices=["nuts", "chees", "gibbs"],
-        default="chees",
-        help="chees = shared-adaptation jittered HMC (infer/chees.py), the "
-        "lockstep-batch-native scheme (default; see module docstring for "
-        "the measured tradeoff); nuts = per-transition tree doubling "
-        "(Stan semantics); gibbs = blocked conjugate FFBS Gibbs "
-        "(infer/gibbs.py; gradient-free, runs the hard-gate model)",
+        default="gibbs",
+        help="gibbs = blocked conjugate Gibbs, one fused Pallas FFBS "
+        "launch per draw (default; see module docstring for the measured "
+        "ladder); chees = shared-adaptation jittered HMC (infer/chees.py), "
+        "the general-model batch sampler; nuts = per-transition tree "
+        "doubling (Stan semantics)",
     )
     ap.add_argument(
         "--chains",
         type=int,
         default=None,
-        help="chains per series; default 2 (chees; adaptation needs >= 2) / 1 (nuts)",
+        help="chains per series; default 1 (gibbs, nuts) / 2 (chees; "
+        "adaptation needs >= 2)",
     )
     ap.add_argument(
         "--max-leapfrogs",

@@ -34,8 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from hhmm_tpu.kernels.ffbs import backward_sample
-from hhmm_tpu.kernels.filtering import forward_filter
+from hhmm_tpu.kernels.ffbs import ffbs_fused
 
 __all__ = ["GibbsConfig", "sample_gibbs", "transition_counts", "emission_counts"]
 
@@ -120,13 +119,13 @@ def sample_gibbs(
         params0, _ = model.unpack(theta0)
 
         def step(params, k):
-            # exactly 2 scans per draw: ONE forward filter serves both
-            # the lp__ trace of the recorded params and the backward
-            # state sampling; the conjugate block is scan-free matmuls.
+            # the whole transition is ONE fused FFBS (forward filter +
+            # backward state draw + lp trace — a single Pallas kernel
+            # launch on TPU, kernels/pallas_ffbs.py) plus scan-free
+            # conjugate count matmuls.
             k_z, k_par = jax.random.split(k)
             log_pi, log_A, log_obs, mask = model.build(params, data)
-            log_alpha, ll = forward_filter(log_pi, log_A, log_obs, mask)
-            z = backward_sample(k_z, log_alpha, log_A, mask)
+            z, ll = ffbs_fused(k_z, log_pi, log_A, log_obs, mask)
             new = model.gibbs_update(k_par, z, data)
             # record the params that produced ll (the pre-update state
             # of this transition — the first recorded pair is the init,

@@ -5,7 +5,12 @@ from hhmm_tpu.kernels.filtering import (
     forward_backward,
 )
 from hhmm_tpu.kernels.viterbi import viterbi
-from hhmm_tpu.kernels.ffbs import backward_sample, ffbs_sample
+from hhmm_tpu.kernels.ffbs import (
+    backward_sample,
+    ffbs_fused,
+    ffbs_invcdf_reference,
+    ffbs_sample,
+)
 from hhmm_tpu.kernels.grad import forward_loglik
 from hhmm_tpu.kernels.assoc import forward_filter_assoc, forward_filter_seqshard
 
@@ -18,6 +23,8 @@ __all__ = [
     "forward_backward",
     "viterbi",
     "backward_sample",
+    "ffbs_fused",
+    "ffbs_invcdf_reference",
     "ffbs_sample",
     "forward_loglik",
 ]

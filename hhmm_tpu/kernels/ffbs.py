@@ -17,15 +17,16 @@ the marginal log-likelihood) pays only the backward scan;
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.custom_batching import custom_vmap
 
 from hhmm_tpu.kernels.filtering import forward_filter, _split_A
 
-__all__ = ["backward_sample", "ffbs_sample"]
+__all__ = ["backward_sample", "ffbs_fused", "ffbs_invcdf_reference", "ffbs_sample"]
 
 
 def backward_sample(
@@ -84,3 +85,115 @@ def ffbs_sample(
     (forward filter + backward sample)."""
     log_alpha, _ = forward_filter(log_pi, log_A, log_obs, mask)
     return backward_sample(key, log_alpha, log_A, mask)
+
+
+# ---- fused path (inverse-CDF draws; Pallas TPU kernel when eligible) ----
+
+
+def _invcdf(logits: jnp.ndarray, u: jnp.ndarray) -> jnp.ndarray:
+    """z = #{k : cum_k <= u} over normalized exp(logits) [K]. Identical
+    math to the Pallas kernel's `_sample_invcdf`."""
+    p = jax.nn.softmax(logits)
+    cum = jnp.cumsum(p[:-1])
+    return jnp.sum(u >= cum).astype(jnp.int32)
+
+
+def ffbs_invcdf_reference(
+    log_pi: jnp.ndarray,
+    log_A: jnp.ndarray,
+    log_obs: jnp.ndarray,
+    mask: jnp.ndarray,
+    u: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Single-series FFBS with inverse-CDF draws from pre-drawn uniforms
+    ``u [T]`` — the exact semantics of the Pallas kernel
+    (`kernels/pallas_ffbs.py`), as composable JAX. Homogeneous ``log_A``
+    only. Returns ``(z [T] int32, loglik)``."""
+    T, K = log_obs.shape
+    log_alpha, ll = forward_filter(log_pi, log_A, log_obs, mask)
+    z_last = _invcdf(log_alpha[T - 1], u[T - 1])
+
+    def step(z_next, xs):
+        alpha_t, m_next, u_t = xs
+        logits = jnp.where(m_next > 0, alpha_t + log_A[:, z_next], alpha_t)
+        z = _invcdf(logits, u_t)
+        return z, z
+
+    _, z_rest = lax.scan(
+        step, z_last, (log_alpha[:-1], mask[1:], u[:-1]), reverse=True
+    )
+    z = jnp.concatenate([z_rest, z_last[None]]).astype(jnp.int32)
+    T_last = jnp.sum(mask).astype(jnp.int32) - 1
+    z = jnp.where(jnp.arange(T) <= T_last, z, z[T_last])
+    return z, ll
+
+
+@custom_vmap
+def _ffbs_batched(u, log_pi, log_A, log_obs, mask):
+    # same eligibility rules + batch-axis folding as the vg hot loop
+    from hhmm_tpu.kernels.vg import _pallas_eligible
+
+    if _pallas_eligible(log_A, log_obs):
+        from hhmm_tpu.kernels.pallas_ffbs import pallas_ffbs
+
+        return pallas_ffbs(log_pi, log_A, log_obs, mask, u)
+    z, ll = jax.vmap(
+        lambda ui, pi, A, obs, m: ffbs_invcdf_reference(pi, A, obs, m, ui)
+    )(u, log_pi, log_A, log_obs, mask)
+    return z, ll
+
+
+@_ffbs_batched.def_vmap
+def _ffbs_batched_rule(axis_size, in_batched, *args):
+    from hhmm_tpu.kernels.vg import _broadcast_unbatched
+
+    args = _broadcast_unbatched(axis_size, in_batched, args)
+    flat = tuple(a.reshape((-1,) + a.shape[2:]) for a in args)
+    z, ll = _ffbs_batched(*flat)
+    return (
+        z.reshape((axis_size, -1) + z.shape[1:]),
+        ll.reshape((axis_size, -1) + ll.shape[1:]),
+    ), (True, True)
+
+
+@custom_vmap
+def _ffbs_fused_single(u, log_pi, log_A, log_obs, mask):
+    return ffbs_invcdf_reference(log_pi, log_A, log_obs, mask, u)
+
+
+@_ffbs_fused_single.def_vmap
+def _ffbs_fused_single_rule(axis_size, in_batched, *args):
+    from hhmm_tpu.kernels.vg import _broadcast_unbatched
+
+    args = _broadcast_unbatched(axis_size, in_batched, args)
+    return _ffbs_batched(*args), (True, True)
+
+
+def ffbs_fused(
+    key: jax.Array,
+    log_pi: jnp.ndarray,
+    log_A: jnp.ndarray,
+    log_obs: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """FFBS draw + marginal loglik in (at most) one fused kernel:
+    ``(z [T] int32, loglik)`` for one series; under any ``vmap`` nesting
+    the batch collapses and dispatches to the Pallas TPU kernel when
+    eligible (homogeneous f32 ``log_A``, T*K <= 4096), else to the
+    scan-based inverse-CDF reference — identical draws either way.
+
+    Uses inverse-CDF sampling from ``T`` pre-drawn uniforms, so draws
+    differ from :func:`ffbs_sample` (Gumbel-based) in randomness but
+    target the same distribution. This is the Gibbs hot path
+    (`infer/gibbs.py`). Homogeneous ``log_A [K, K]`` only — for
+    time-varying transitions use :func:`ffbs_sample`."""
+    if log_A.ndim != 2:
+        raise ValueError(
+            f"ffbs_fused needs homogeneous log_A [K, K], got shape "
+            f"{log_A.shape}; use ffbs_sample for time-varying transitions"
+        )
+    T = log_obs.shape[0]
+    if mask is None:
+        mask = jnp.ones((T,), log_obs.dtype)
+    u = jax.random.uniform(key, (T,), log_obs.dtype)
+    return _ffbs_fused_single(u, log_pi, log_A, log_obs, mask)

@@ -1,0 +1,164 @@
+"""Fused Pallas TPU kernel: batched FFBS (forward filter + backward
+state sampling) in one kernel launch.
+
+The blocked Gibbs sampler (`infer/gibbs.py`) is latency-bound by its two
+sequential ``lax.scan``s per draw — XLA sequences 2(T-1) microkernel loop
+iterations, exactly the overhead `kernels/pallas_forward.py` removes for
+the HMC gradient path. This kernel does the same for FFBS:
+
+- layout identical to the vg kernel: batch on the 128-lane axis, K
+  states on sublanes, one grid step per 128-series tile, the forward
+  filter held in a VMEM scratch as the backward pass's residual;
+- backward *sampling* instead of backward smoothing: states are drawn
+  by inverse-CDF against pre-drawn uniforms ``u [T]`` (generated with
+  ``jax.random`` OUTSIDE the kernel — no in-kernel PRNG), with the
+  transition column ``A[:, z_{t+1}]`` selected by an unrolled masked
+  sum over the (static, small) K destinations;
+- outputs: ``z [T] (f32 lanes, cast to int32 outside)`` and the
+  marginal ``loglik [B]`` — the two things a Gibbs step needs.
+
+Masked steps follow the scan-kernel convention: padded steps copy the
+forward carry, and a state whose successor step is padding is drawn
+from the filter alone. The padded tail is overwritten with the last
+valid state by the wrapper (same as `kernels/ffbs.py`).
+
+The draw differs from ``jax.random.categorical`` (Gumbel) in its use of
+randomness but targets the identical distribution; parity with the JAX
+reference implementation `kernels/ffbs.py::ffbs_invcdf_reference` given
+the SAME uniforms is exact and pinned in interpreter mode
+(`tests/test_pallas_ffbs.py`).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["pallas_ffbs"]
+
+_LANES = 128
+_CLAMP = -1.0e30
+
+
+def _lse0(x):
+    m = jnp.maximum(jnp.max(x, axis=0), _CLAMP)
+    return m + jnp.log(jnp.sum(jnp.exp(x - m[None]), axis=0))
+
+
+def _sample_invcdf(logits, u):
+    """Inverse-CDF categorical draw over axis 0 of ``logits [K, B]``
+    using uniforms ``u [B]``: z = #{k : cum_k <= u}. Unrolled over the
+    static K axis."""
+    K = logits.shape[0]
+    p = jnp.exp(logits - _lse0(logits)[None])  # [K, B], sums to 1
+    z = jnp.zeros(u.shape, jnp.float32)
+    cum = jnp.zeros(u.shape, jnp.float32)
+    for k in range(K - 1):  # last bucket catches the remainder
+        cum = cum + p[k]
+        z = z + (u >= cum).astype(jnp.float32)
+    return z
+
+
+def _ffbs_kernel(
+    pi_ref,  # [K, B]
+    A_ref,  # [K, K, B]
+    obs_ref,  # [T, K, B]
+    mask_ref,  # [T, B]
+    u_ref,  # [T, B]
+    ll_ref,  # out [1, B]
+    z_ref,  # out [T, B] f32
+    alpha_scr,  # scratch [T, K, B]
+):
+    T, K, B = obs_ref.shape
+    A = A_ref[:]
+
+    # ---- forward filter (identical to pallas_forward.py) ----
+    m0 = mask_ref[0][None]
+    alpha = jnp.where(m0 > 0, pi_ref[:] + obs_ref[0], pi_ref[:])
+    alpha_scr[0] = alpha
+
+    def fwd_body(t, alpha):
+        new = _lse0(alpha[:, None, :] + A) + obs_ref[t]
+        alpha = jnp.where(mask_ref[t][None] > 0, new, alpha)
+        alpha_scr[t] = alpha
+        return alpha
+
+    alpha = lax.fori_loop(1, T, fwd_body, alpha)
+    ll_ref[0] = _lse0(alpha)
+
+    # ---- backward sampling ----
+    z_last = _sample_invcdf(alpha, u_ref[T - 1])
+    z_ref[T - 1] = z_last
+
+    def bwd_body(i, z_next):
+        t = T - 2 - i  # T-2 .. 0
+        # A[:, z_{t+1}] per lane: unrolled masked sum over destinations
+        Acol = jnp.zeros((K, B), jnp.float32)
+        for j in range(K):
+            Acol = Acol + A[:, j, :] * (z_next[None] == float(j)).astype(jnp.float32)
+        alpha_t = alpha_scr[t]
+        # successor step padded -> draw from the filter alone
+        logits = jnp.where(mask_ref[t + 1][None] > 0, alpha_t + Acol, alpha_t)
+        z_t = _sample_invcdf(logits, u_ref[t])
+        z_ref[t] = z_t
+        return z_t
+
+    lax.fori_loop(0, T - 1, bwd_body, z_last)
+
+
+def pallas_ffbs(
+    log_pi: jnp.ndarray,  # [B, K]
+    log_A: jnp.ndarray,  # [B, K, K]
+    log_obs: jnp.ndarray,  # [B, T, K]
+    mask: jnp.ndarray,  # [B, T]
+    u: jnp.ndarray,  # [B, T] uniforms in [0, 1)
+    *,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Batched fused FFBS: returns ``(z [B, T] int32, loglik [B])``.
+    Pads the batch to a multiple of 128 lanes; one grid step per tile."""
+    B, T, K = log_obs.shape
+    Bp = -(-B // _LANES) * _LANES
+
+    def pad(x):
+        return jnp.pad(x, [(0, Bp - B)] + [(0, 0)] * (x.ndim - 1))
+
+    pi_t = pad(log_pi).transpose(1, 0)
+    A_t = pad(log_A).transpose(1, 2, 0)
+    obs_t = pad(log_obs).transpose(1, 2, 0)
+    mask_t = jnp.pad(mask, [(0, Bp - B), (0, 0)], constant_values=1.0).transpose(1, 0)
+    u_t = pad(u).transpose(1, 0)
+
+    grid = (Bp // _LANES,)
+
+    def lanes(*blk):
+        return pl.BlockSpec(
+            blk + (_LANES,),
+            index_map=lambda b: (0,) * len(blk) + (b,),
+            memory_space=pltpu.VMEM,
+        )
+
+    ll, z = pl.pallas_call(
+        _ffbs_kernel,
+        grid=grid,
+        in_specs=[lanes(K), lanes(K, K), lanes(T, K), lanes(T), lanes(T)],
+        out_specs=(lanes(1), lanes(T)),
+        out_shape=(
+            jax.ShapeDtypeStruct((1, Bp), jnp.float32),
+            jax.ShapeDtypeStruct((T, Bp), jnp.float32),
+        ),
+        scratch_shapes=[pltpu.VMEM((T, K, _LANES), jnp.float32)],
+        interpret=interpret,
+    )(pi_t, A_t, obs_t, mask_t, u_t)
+
+    z = z.transpose(1, 0)[:B].astype(jnp.int32)  # [B, T]
+    # padded tail: repeat the last valid state (scan-kernel convention)
+    T_last = jnp.sum(mask, axis=1).astype(jnp.int32) - 1  # [B]
+    last = jnp.take_along_axis(z, T_last[:, None], axis=1)  # [B, 1]
+    z = jnp.where(jnp.arange(T)[None, :] <= T_last[:, None], z, last)
+    return z, ll[0, :B]

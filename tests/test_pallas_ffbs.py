@@ -1,0 +1,86 @@
+"""Fused Pallas FFBS kernel tests (`kernels/pallas_ffbs.py`,
+`kernels/ffbs.py::ffbs_fused`).
+
+Pinning strategy mirrors tests/test_pallas.py: exact draw parity
+between the Pallas kernel (interpreter mode on CPU) and the JAX
+inverse-CDF reference given identical uniforms, plus statistical
+checks that the draws really come from the smoothing posterior.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hhmm_tpu.kernels import forward_backward, forward_filter
+from hhmm_tpu.kernels.ffbs import ffbs_fused, ffbs_invcdf_reference
+from hhmm_tpu.kernels.pallas_ffbs import pallas_ffbs
+
+
+def _random_hmm(rng, T, K, masked_tail=0):
+    log_pi = np.log(rng.dirichlet(np.ones(K)))
+    log_A = np.log(rng.dirichlet(np.ones(K), size=K))
+    log_obs = rng.normal(size=(T, K)) - 1.0
+    mask = np.ones(T, np.float32)
+    if masked_tail:
+        mask[-masked_tail:] = 0.0
+    return (
+        jnp.asarray(log_pi, jnp.float32),
+        jnp.asarray(log_A, jnp.float32),
+        jnp.asarray(log_obs, jnp.float32),
+        jnp.asarray(mask),
+    )
+
+
+class TestKernelParity:
+    @pytest.mark.parametrize("masked_tail", [0, 7])
+    @pytest.mark.parametrize("K", [2, 4])
+    def test_matches_reference_interpret(self, rng, K, masked_tail):
+        """Identical uniforms → identical draws and logliks, kernel
+        (interpreter mode) vs the scan reference, over a batch."""
+        B, T = 5, 33
+        hmms = [_random_hmm(rng, T, K, masked_tail) for _ in range(B)]
+        log_pi = jnp.stack([h[0] for h in hmms])
+        log_A = jnp.stack([h[1] for h in hmms])
+        log_obs = jnp.stack([h[2] for h in hmms])
+        mask = jnp.stack([h[3] for h in hmms])
+        u = jnp.asarray(rng.uniform(size=(B, T)), jnp.float32)
+
+        z_k, ll_k = pallas_ffbs(log_pi, log_A, log_obs, mask, u, interpret=True)
+        z_r, ll_r = jax.vmap(ffbs_invcdf_reference)(log_pi, log_A, log_obs, mask, u)
+        np.testing.assert_array_equal(np.asarray(z_k), np.asarray(z_r))
+        np.testing.assert_allclose(np.asarray(ll_k), np.asarray(ll_r), rtol=1e-5)
+
+    def test_loglik_matches_forward_filter(self, rng):
+        log_pi, log_A, log_obs, mask = _random_hmm(rng, 40, 3, masked_tail=5)
+        u = jnp.asarray(rng.uniform(size=(1, 40)), jnp.float32)
+        _, ll = pallas_ffbs(
+            log_pi[None], log_A[None], log_obs[None], mask[None], u, interpret=True
+        )
+        _, ll_ref = forward_filter(log_pi, log_A, log_obs, mask)
+        np.testing.assert_allclose(float(ll[0]), float(ll_ref), rtol=1e-5)
+
+
+class TestDrawDistribution:
+    def test_marginals_match_smoother(self, rng):
+        """Empirical state marginals over many inverse-CDF draws must
+        match the forward-backward smoothing marginals gamma."""
+        T, K, N = 30, 3, 4000
+        log_pi, log_A, log_obs, mask = _random_hmm(rng, T, K)
+        keys = jax.random.split(jax.random.PRNGKey(0), N)
+        z = jax.vmap(lambda k: ffbs_fused(k, log_pi, log_A, log_obs, mask)[0])(keys)
+        emp = np.stack([(np.asarray(z) == k).mean(axis=0) for k in range(K)], axis=1)
+        _, _, log_gamma, _ = forward_backward(log_pi, log_A, log_obs, mask)
+        gamma = np.asarray(np.exp(log_gamma))
+        np.testing.assert_allclose(emp, gamma, atol=0.03)
+
+    def test_padded_tail_repeats_last_state(self, rng):
+        log_pi, log_A, log_obs, mask = _random_hmm(rng, 25, 3, masked_tail=6)
+        z, _ = ffbs_fused(jax.random.PRNGKey(3), log_pi, log_A, log_obs, mask)
+        z = np.asarray(z)
+        assert (z[-6:] == z[18]).all()
+
+    def test_mask_none_defaults_dense(self, rng):
+        log_pi, log_A, log_obs, _ = _random_hmm(rng, 20, 2)
+        z, ll = ffbs_fused(jax.random.PRNGKey(1), log_pi, log_A, log_obs, None)
+        assert z.shape == (20,) and np.isfinite(float(ll))
