@@ -33,3 +33,28 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture
+def tayal_wf_tasks():
+    """Shared synthetic walk-forward task list: 2 symbols x 4 days of
+    simulated ticks, 2-day train + 1-day trade windows -> 4 tasks.
+    Used by the wf_trade tests across sampler families."""
+    from hhmm_tpu.apps.tayal import build_tasks, simulate_ticks
+
+    rng = np.random.default_rng(11)
+    days = {
+        sym: [
+            dict(
+                zip(
+                    ("price", "size", "t_seconds"),
+                    simulate_ticks(rng, n_legs=60)[:3],
+                )
+            )
+            for _ in range(4)
+        ]
+        for sym in ("AAA", "BBB")
+    }
+    tasks = build_tasks(days, train_days=2, trade_days=1)
+    assert len(tasks) == 4  # 2 windows x 2 symbols
+    return tasks

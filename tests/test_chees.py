@@ -170,25 +170,11 @@ class TestAppHarnesses:
         assert res.errors["mape"] < 10.0
         assert res.errors["r2"] > 0.3  # tracks the trending level
 
-    def test_tayal_wf_trade_chees(self, tmp_path):
-        from hhmm_tpu.apps.tayal import build_tasks, simulate_ticks, wf_trade
+    def test_tayal_wf_trade_chees(self, tmp_path, tayal_wf_tasks):
+        from hhmm_tpu.apps.tayal import wf_trade
 
-        rng = np.random.default_rng(11)
-        days = {
-            sym: [
-                dict(
-                    zip(
-                        ("price", "size", "t_seconds"),
-                        simulate_ticks(rng, n_legs=60)[:3],
-                    )
-                )
-                for _ in range(4)
-            ]
-            for sym in ("AAA", "BBB")
-        }
-        tasks = build_tasks(days, train_days=2, trade_days=1)
         results = wf_trade(
-            tasks,
+            tayal_wf_tasks,
             config=ChEESConfig(num_warmup=80, num_samples=80, num_chains=2),
             chunk_size=4,
             cache_dir=str(tmp_path),

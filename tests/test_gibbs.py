@@ -140,6 +140,27 @@ class TestSBCGibbs:
         assert p > 1e-3, f"KS uniformity p={p:.2e}"
 
 
+class TestWalkForwardGibbs:
+    def test_tayal_wf_trade_with_gibbs(self, tmp_path, tayal_wf_tasks):
+        """The Tayal walk-forward harness runs end-to-end with the Gibbs
+        sampler: TayalHHMMLite inherits the conjugate block, hard gate
+        gives the exact factorization, and fit_batched dispatches on
+        GibbsConfig."""
+        from hhmm_tpu.apps.tayal import wf_trade
+
+        results = wf_trade(
+            tayal_wf_tasks,
+            config=GibbsConfig(num_warmup=50, num_samples=150, num_chains=1),
+            gate_mode="hard",
+            chunk_size=4,
+            cache_dir=str(tmp_path),
+        )
+        assert len(results) == 4
+        for r in results:
+            assert np.isfinite(r.bnh).all()
+            assert set(r.trades.keys()) == {0, 1, 2, 3, 4, 5}
+
+
 class TestMaskedEquivalence:
     def test_padded_matches_truncated_counts(self):
         """The conjugate count helpers must ignore padded steps: a

@@ -199,22 +199,8 @@ class TestPipeline:
         assert np.isfinite(res.trades[1].ret).all()
         assert "Unconditional" in res.summary
 
-    def test_walk_forward(self, tmp_path):
-        rng = np.random.default_rng(11)
-        days = {
-            sym: [
-                dict(
-                    zip(
-                        ("price", "size", "t_seconds"),
-                        simulate_ticks(rng, n_legs=60)[:3],
-                    )
-                )
-                for _ in range(4)
-            ]
-            for sym in ("AAA", "BBB")
-        }
-        tasks = build_tasks(days, train_days=2, trade_days=1)
-        assert len(tasks) == 4  # 2 windows x 2 symbols
+    def test_walk_forward(self, tmp_path, tayal_wf_tasks):
+        tasks = tayal_wf_tasks
         from hhmm_tpu.infer import SamplerConfig
 
         results = wf_trade(
