@@ -35,29 +35,46 @@ import jax.numpy as jnp
 
 
 def _time_fit(model, data, config, key):
-    from hhmm_tpu.infer import ChEESConfig, sample_chees, sample_nuts
+    from hhmm_tpu.infer import ChEESConfig, GibbsConfig, sample_chees, sample_gibbs, sample_nuts
 
+    np_data = {k: np.asarray(v) for k, v in data.items()}
     data = {k: jnp.asarray(v) for k, v in data.items()}
-    vg = model.make_vg(data)
-    if isinstance(config, ChEESConfig):
+    if isinstance(config, GibbsConfig):
+        # default init does host-side work (k-means/bincount) — build it
+        # outside the jit and pass it in
+        init = jnp.stack(
+            [
+                model.init_unconstrained(k, np_data)
+                for k in jax.random.split(jax.random.PRNGKey(7), config.num_chains)
+            ]
+        )
+
+        def run(key):
+            return sample_gibbs(model, data, key, config, init_q=init, jit=False)
+
+    elif isinstance(config, ChEESConfig):
         # single posterior, C chains: plain per-posterior ChEES — the
         # cross-chain criterion replaces NUTS's per-transition trees
         from hhmm_tpu.batch import default_init
 
+        vg = model.make_vg(data)
         theta0 = default_init(
             model,
-            {k: np.asarray(v)[None] for k, v in data.items()},
+            {k: v[None] for k, v in np_data.items()},
             1,
             config.num_chains,
             jax.random.PRNGKey(7),
         )[0]
-        sampler = sample_chees
-    else:
-        theta0 = model.init_unconstrained(jax.random.PRNGKey(7), data)
-        sampler = sample_nuts
 
-    def run(key):
-        return sampler(None, key, theta0, config, jit=False, vg_fn=vg)
+        def run(key):
+            return sample_chees(None, key, theta0, config, jit=False, vg_fn=vg)
+
+    else:
+        vg = model.make_vg(data)
+        theta0 = model.init_unconstrained(jax.random.PRNGKey(7), data)
+
+        def run(key):
+            return sample_nuts(None, key, theta0, config, jit=False, vg_fn=vg)
 
     runj = jax.jit(run)
     jax.block_until_ready(runj(jax.random.PRNGKey(999)))  # compile
@@ -114,11 +131,15 @@ def bench_hmix(cfg):
 
 def bench_tayal(cfg):
     from __graft_entry__ import _tayal_batch
+    from hhmm_tpu.infer import GibbsConfig
     from hhmm_tpu.models import TayalHHMM
 
+    # Gibbs needs the exact-HMM factorization (hard gate; identical on
+    # strictly-alternating zig-zag signs)
+    model = TayalHHMM(gate_mode="hard") if isinstance(cfg, GibbsConfig) else TayalHHMM()
     x, sign = _tayal_batch(1, 1024, seed=3)
     dt, div = _time_fit(
-        TayalHHMM(), {"x": x[0], "sign": sign[0]}, cfg, jax.random.PRNGKey(1)
+        model, {"x": x[0], "sign": sign[0]}, cfg, jax.random.PRNGKey(1)
     )
     return "tayal_single_fit", dt, div, 120.0
 
@@ -156,10 +177,11 @@ def main() -> None:
     ap.add_argument("--max-treedepth", type=int, default=6)
     ap.add_argument(
         "--sampler",
-        choices=["nuts", "chees"],
+        choices=["nuts", "chees", "gibbs"],
         default="nuts",
-        help="nuts (default; Stan semantics) or chees — per-posterior "
-        "cross-chain adaptation (infer/chees.py), --chains >= 2",
+        help="nuts (default; Stan semantics); chees — per-posterior "
+        "cross-chain adaptation (infer/chees.py), --chains >= 2; gibbs — "
+        "blocked conjugate FFBS (discrete-emission configs only: tayal)",
     )
     ap.add_argument("--chains", type=int, default=None)
     ap.add_argument("--max-leapfrogs", type=int, default=32)
@@ -168,7 +190,15 @@ def main() -> None:
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
 
-    if args.sampler == "chees":
+    if args.sampler == "gibbs":
+        from hhmm_tpu.infer import GibbsConfig
+
+        cfg = GibbsConfig(
+            num_warmup=args.warmup,
+            num_samples=args.samples,
+            num_chains=args.chains or 1,
+        )
+    elif args.sampler == "chees":
         from hhmm_tpu.infer import ChEESConfig
 
         cfg = ChEESConfig(
@@ -184,6 +214,13 @@ def main() -> None:
             num_chains=args.chains or 1,
             max_treedepth=args.max_treedepth,
         )
+    if args.sampler == "gibbs":
+        bad = [c for c in args.configs if c != "tayal"]
+        if bad:
+            raise SystemExit(
+                f"--sampler gibbs supports only conjugate discrete-emission "
+                f"configs (tayal); drop {bad} or use --configs tayal"
+            )
     for name in args.configs:
         metric, dt, div, baseline_s = CONFIGS[name](cfg)
         print(
