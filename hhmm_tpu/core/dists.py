@@ -12,11 +12,16 @@ Shapes broadcast; everything is jittable and differentiable.
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 from jax.scipy.special import gammaln, logsumexp
 
-_HALF_LOG_2PI = 0.5 * jnp.log(2.0 * jnp.pi)
+# plain float, NOT a jnp op: module import must not initialize the JAX
+# backend (the driver's dryrun_multichip forces the CPU platform *after*
+# interpreter start but *before* importing hhmm_tpu)
+_HALF_LOG_2PI = 0.5 * math.log(2.0 * math.pi)
 
 
 def normal_logpdf(x, mu=0.0, sigma=1.0):
