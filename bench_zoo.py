@@ -86,7 +86,8 @@ def _time_fit(model, data, config, key):
 
 
 def bench_hmm(cfg):
-    from hhmm_tpu.models import GaussianHMM
+    from hhmm_tpu.infer import GibbsConfig
+    from hhmm_tpu.models import GaussianHMM, NIGPrior
     from hhmm_tpu.sim import hmm_sim, obsmodel_gaussian
 
     K, T = 3, 500
@@ -95,7 +96,14 @@ def bench_hmm(cfg):
         jax.random.PRNGKey(0), T, A, np.ones(K) / K,
         obsmodel_gaussian(np.array([-2.0, 0.5, 3.0]), np.array([0.5, 0.8, 0.6])),
     )
-    dt, div = _time_fit(GaussianHMM(K=K), {"x": x}, cfg, jax.random.PRNGKey(1))
+    # Gibbs path: the NIG emission prior enables the conjugate block
+    # (FFBS + joint NIG draws, models/gaussian_hmm.py)
+    model = (
+        GaussianHMM(K=K, nig_prior=NIGPrior(m0=0.0, kappa0=0.1, a0=2.0, b0=1.0))
+        if isinstance(cfg, GibbsConfig)
+        else GaussianHMM(K=K)
+    )
+    dt, div = _time_fit(model, {"x": x}, cfg, jax.random.PRNGKey(1))
     return "gaussian_hmm_fit", dt, div, 300.0  # ≈5-min CPU budget class
 
 
@@ -215,11 +223,11 @@ def main() -> None:
             max_treedepth=args.max_treedepth,
         )
     if args.sampler == "gibbs":
-        bad = [c for c in args.configs if c != "tayal"]
+        bad = [c for c in args.configs if c not in ("tayal", "hmm")]
         if bad:
             raise SystemExit(
-                f"--sampler gibbs supports only conjugate discrete-emission "
-                f"configs (tayal); drop {bad} or use --configs tayal"
+                f"--sampler gibbs supports only the conjugate configs "
+                f"(tayal, hmm); drop {bad} or use --configs tayal hmm"
             )
     for name in args.configs:
         metric, dt, div, baseline_s = CONFIGS[name](cfg)

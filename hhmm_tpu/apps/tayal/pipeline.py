@@ -149,7 +149,12 @@ def run_window(
         "sign_oos": jnp.asarray(sign[n_ins:]),
     }
     init = init_chains(model, jax.random.fold_in(key, 1), data, config.num_chains)
-    qs, stats = sample(model.make_logp(data), key, init, config)
+    # the fused value+grad op (Pallas on TPU) is the hot loop: real
+    # windows are ~10k legs, where the plain XLA-scan logp path is
+    # dispatch-bound (see kernels/vg.py)
+    qs, stats = sample(
+        model.make_logp(data), key, init, config, vg_fn=model.make_vg(data)
+    )
 
     # thin draws for generated quantities (reference computes per draw)
     leg_state = decode_states(model, qs, data)

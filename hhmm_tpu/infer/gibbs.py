@@ -17,8 +17,11 @@ Each draw costs ~2 scans instead of ~10-30 leapfrogs × (forward +
 backward) — and targets the *identical posterior* as the NUTS/ChEES
 samplers (pinned by cross-sampler agreement and SBC tests).
 
-A model opts in by implementing ``gibbs_update(key, z, data) ->
-params`` (the conjugate block) alongside its standard ``build``; the
+A model opts in by implementing ``gibbs_update(key, z, data, params)
+-> params`` (the conjugate block given the current params — models
+whose conditionals factor completely ignore ``params``; the Gaussian
+family uses it for its exact ordered-cone accept/reject step)
+alongside its standard ``build``; the
 factorization returned by ``build`` must be an exact HMM (for gated
 models: ``gate_mode="hard"`` — the stan-parity soft gate is not a
 product of standard HMM factors, so conjugacy fails there and
@@ -126,7 +129,7 @@ def sample_gibbs(
             k_z, k_par = jax.random.split(k)
             log_pi, log_A, log_obs, mask = model.build(params, data)
             z, ll = ffbs_fused(k_z, log_pi, log_A, log_obs, mask)
-            new = model.gibbs_update(k_par, z, data)
+            new = model.gibbs_update(k_par, z, data, params)
             # record the params that produced ll (the pre-update state
             # of this transition — the first recorded pair is the init,
             # absorbed by warmup)

@@ -1,5 +1,5 @@
 from hhmm_tpu.models.base import BaseHMMModel
-from hhmm_tpu.models.gaussian_hmm import GaussianHMM
+from hhmm_tpu.models.gaussian_hmm import GaussianHMM, NIGPrior
 from hhmm_tpu.models.multinomial_hmm import MultinomialHMM, SemisupMultinomialHMM
 from hhmm_tpu.models.iohmm import IOHMMReg, IOHMMMix, IOHMMHMix, IOHMMHMixLite
 from hhmm_tpu.models.tayal import TayalHHMM, TayalHHMMLite
@@ -9,6 +9,7 @@ __all__ = [
     "TreeHMM",
     "BaseHMMModel",
     "GaussianHMM",
+    "NIGPrior",
     "MultinomialHMM",
     "SemisupMultinomialHMM",
     "IOHMMReg",

@@ -2,9 +2,16 @@
 
 Parameters (matching `hmm/stan/hmm.stan:14-22`): initial simplex ``p_1k``,
 transition simplex rows ``A_ij``, ``ordered[K] mu_k`` (the identifiability
-constraint, `hmm/stan/hmm.stan:20`), ``sigma_k > 1e-4``. No explicit
-priors — the target is the marginalized forward log-likelihood alone
-(`hmm/stan/hmm.stan:46`), i.e. flat priors on the constrained space.
+constraint, `hmm/stan/hmm.stan:20`), ``sigma_k > 1e-4``. By default no
+explicit priors — the target is the marginalized forward log-likelihood
+alone (`hmm/stan/hmm.stan:46`), i.e. flat priors on the constrained
+space.
+
+An optional conjugate Normal–Inverse-Gamma emission prior
+(:class:`NIGPrior`) enables the blocked Gibbs sampler
+(`infer/gibbs.py`): with it, ``log_prior`` adds the same NIG terms to
+the HMC target, so NUTS/ChEES and Gibbs sample the *identical*
+posterior (pinned by cross-sampler agreement tests).
 
 The k-means init mirrors the reference driver's ``init_fun``
 (`hmm/main.R:37-47`): cluster x, order cluster centers, init mu/sigma
@@ -13,7 +20,8 @@ from cluster moments and A/p1 uniform.
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -24,12 +32,50 @@ from hhmm_tpu.core.lmath import safe_log
 from hhmm_tpu.core.bijectors import Bijector, Ordered, Positive, Simplex
 from hhmm_tpu.models.base import BaseHMMModel
 
-__all__ = ["GaussianHMM"]
+__all__ = ["GaussianHMM", "NIGPrior"]
+
+
+@dataclass(frozen=True)
+class NIGPrior:
+    """Conjugate emission prior: ``sigma_k^2 ~ InvGamma(a0, b0)``,
+    ``mu_k | sigma_k ~ N(m0, sigma_k^2 / kappa0)`` iid per state,
+    restricted to the ordered cone (= the distribution of the sorted
+    draws; the likelihood is permutation-symmetric, so the restriction
+    only renormalizes by the constant K!)."""
+
+    m0: float = 0.0
+    kappa0: float = 0.2
+    a0: float = 2.5
+    b0: float = 1.5
+
+    def log_density(self, mu: jnp.ndarray, sigma: jnp.ndarray) -> jnp.ndarray:
+        """Summed log prior over states, as a density in (mu, sigma)
+        [std, not variance — includes the dv/dsigma = 2 sigma Jacobian]."""
+        v = sigma * sigma
+        lp_v = (
+            self.a0 * jnp.log(self.b0)
+            - jax.scipy.special.gammaln(self.a0)
+            - (self.a0 + 1.0) * jnp.log(v)
+            - self.b0 / v
+        ) + jnp.log(2.0 * sigma)
+        lp_mu = dists.normal_logpdf(mu, self.m0, sigma / jnp.sqrt(self.kappa0))
+        return jnp.sum(lp_v + lp_mu)
+
+    def sample(self, key: jax.Array, K: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Exact ordered-prior draw: iid NIG per state, then sort by mu
+        (the sort IS the ordered-cone restriction)."""
+        k_v, k_m = jax.random.split(key)
+        v = self.b0 / jax.random.gamma(k_v, self.a0, (K,))
+        sigma = jnp.sqrt(v)
+        mu = self.m0 + sigma / np.sqrt(self.kappa0) * jax.random.normal(k_m, (K,))
+        order = jnp.argsort(mu)
+        return mu[order], sigma[order]
 
 
 class GaussianHMM(BaseHMMModel):
-    def __init__(self, K: int):
+    def __init__(self, K: int, nig_prior: Optional[NIGPrior] = None):
         self.K = K
+        self.nig_prior = nig_prior
 
     def specs(self) -> List[Tuple[str, Bijector]]:
         K = self.K
@@ -51,6 +97,73 @@ class GaussianHMM(BaseHMMModel):
             log_obs,
             data.get("mask"),
         )
+
+    def log_prior(self, params):
+        if self.nig_prior is None:
+            return jnp.zeros(())
+        return self.nig_prior.log_density(params["mu_k"], params["sigma_k"])
+
+    def gibbs_update(self, key, z, data, params):
+        """Conjugate parameter block for blocked Gibbs (`infer/gibbs.py`).
+
+        Dirichlet(1) draws for ``p_1k``/``A_ij`` rows (the Stan models'
+        implicit flat simplex priors, `hmm/stan/hmm.stan:15-17`). The
+        emission block is a joint draw from the per-state NIG posterior
+
+            sigma_k^2 | z ~ InvGamma(a0 + n_k/2, b_n)
+            mu_k | sigma_k^2, z ~ N(m_n, sigma_k^2 / (kappa0 + n_k))
+
+        followed by an exact ordered-cone step: the target restricted to
+        ``mu_1 < ... < mu_K`` is proportional to the unordered NIG
+        product there, so an independence-MH move that proposes the
+        unordered joint draw accepts with probability 1 when ordered and
+        0 otherwise (keep the current emission params on reject).
+        Sufficient statistics are one-hot matmuls (MXU, no scatters).
+        """
+        if self.nig_prior is None:
+            raise ValueError(
+                "GaussianHMM Gibbs needs a proper conjugate prior: construct "
+                "with GaussianHMM(K, nig_prior=NIGPrior(...))"
+            )
+        pr = self.nig_prior
+        from hhmm_tpu.infer.gibbs import transition_counts
+
+        x = data["x"].astype(jnp.float32)
+        mask = data.get("mask")
+        K = self.K
+        k_p1, k_A, k_v, k_mu = jax.random.split(key, 4)
+
+        zoh = jax.nn.one_hot(z, K, dtype=jnp.float32)  # [T, K]
+        if mask is not None:
+            zoh = zoh * mask[:, None]
+        n_k = zoh.sum(axis=0)  # [K]
+        sum_x = x @ zoh  # [K]
+        sum_x2 = (x * x) @ zoh  # [K]
+
+        xbar = jnp.where(n_k > 0, sum_x / jnp.maximum(n_k, 1.0), pr.m0)
+        scatter = jnp.maximum(sum_x2 - n_k * xbar * xbar, 0.0)
+        kappa_n = pr.kappa0 + n_k
+        m_n = (pr.kappa0 * pr.m0 + sum_x) / kappa_n
+        a_n = pr.a0 + 0.5 * n_k
+        b_n = (
+            pr.b0
+            + 0.5 * scatter
+            + 0.5 * pr.kappa0 * n_k * (xbar - pr.m0) ** 2 / kappa_n
+        )
+        v = b_n / jax.random.gamma(k_v, a_n)
+        sigma = jnp.sqrt(v)
+        mu = m_n + sigma / jnp.sqrt(kappa_n) * jax.random.normal(k_mu, (K,))
+
+        ordered = jnp.all(mu[1:] > mu[:-1])
+        mu = jnp.where(ordered, mu, params["mu_k"])
+        sigma = jnp.where(ordered, sigma, params["sigma_k"])
+
+        return {
+            "p_1k": jax.random.dirichlet(k_p1, 1.0 + zoh[0]),
+            "A_ij": jax.random.dirichlet(k_A, 1.0 + transition_counts(z, K, mask)),
+            "mu_k": mu,
+            "sigma_k": jnp.maximum(sigma, 2e-4),
+        }
 
     def init_unconstrained(self, key, data):
         """k-means-style init on host (reference: `hmm/main.R:37-47`)."""

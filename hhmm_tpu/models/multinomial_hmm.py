@@ -62,7 +62,7 @@ class MultinomialHMM(BaseHMMModel):
             data.get("mask"),
         )
 
-    def gibbs_update(self, key, z, data):
+    def gibbs_update(self, key, z, data, params=None):
         """Conjugate parameter block for blocked Gibbs
         (`infer/gibbs.py`): with the model's flat Dirichlet(1) priors,
         p_1k | z ~ Dir(1 + 1[z_1]), A rows ~ Dir(1 + transition

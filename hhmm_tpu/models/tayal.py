@@ -140,7 +140,7 @@ class TayalHHMM(BaseHMMModel):
         ).astype(jnp.float32)  # [K]
         return sign, state_sign
 
-    def gibbs_update(self, key, z, data):
+    def gibbs_update(self, key, z, data, params=None):
         """Conjugate parameter block for blocked Gibbs
         (`infer/gibbs.py`, ``gate_mode="hard"`` only): with the model's
         flat priors, p_11 | z_1 ~ Beta(1 + 1[z_1=0], 1 + 1[z_1=2]);

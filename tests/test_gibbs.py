@@ -28,8 +28,23 @@ from hhmm_tpu.sim import hmm_sim, obsmodel_categorical
 
 class TestGuards:
     def test_requires_gibbs_update(self):
+        from hhmm_tpu.models import IOHMMReg
+
         with pytest.raises(ValueError, match="gibbs_update"):
-            sample_gibbs(GaussianHMM(K=2), {"x": np.zeros(10, np.float32)}, jax.random.PRNGKey(0))
+            sample_gibbs(
+                IOHMMReg(K=2, M=2),
+                {"x": np.zeros(10, np.float32), "u": np.zeros((10, 2), np.float32)},
+                jax.random.PRNGKey(0),
+            )
+
+    def test_gaussian_requires_proper_prior(self):
+        with pytest.raises(ValueError, match="nig_prior"):
+            sample_gibbs(
+                GaussianHMM(K=2),
+                {"x": np.zeros(10, np.float32)},
+                jax.random.PRNGKey(0),
+                GibbsConfig(num_warmup=1, num_samples=1),
+            )
 
     def test_rejects_stan_gate(self):
         with pytest.raises(ValueError, match="hard"):
@@ -76,6 +91,53 @@ class TestCrossSamplerAgreement:
         )
         assert np.isfinite(np.asarray(sg["logp"])).all()
         np.testing.assert_allclose(canon(qg), canon(qn), atol=0.05)
+
+    def test_matches_nuts_on_gaussian_hmm(self):
+        """NIG-prior Gaussian HMM: Gibbs (FFBS + joint NIG block with
+        ordered-cone accept step) and NUTS with the same ``log_prior``
+        target the identical posterior (`hmm/stan/hmm.stan:14-46`
+        semantics + the conjugate prior both samplers share)."""
+        from hhmm_tpu.models import NIGPrior
+        from hhmm_tpu.sim import obsmodel_gaussian
+
+        K, T = 2, 400
+        prior = NIGPrior(m0=0.0, kappa0=0.2, a0=2.5, b0=1.5)
+        model = GaussianHMM(K=K, nig_prior=prior)
+        A = np.array([[0.9, 0.1], [0.2, 0.8]])
+        p1 = np.array([0.5, 0.5])
+        mu = np.array([-1.5, 1.5])
+        sigma = np.array([0.6, 0.9])
+        z, x = hmm_sim(
+            jax.random.PRNGKey(3), T, A, p1, obsmodel_gaussian(mu, sigma), validate=False
+        )
+        data = {"x": np.asarray(x, np.float32)}
+
+        def moments(qs):
+            d = model.constrained_draws(qs.reshape(-1, qs.shape[-1]))
+            return np.concatenate(
+                [
+                    np.asarray(d["mu_k"]).mean(0),
+                    np.asarray(d["sigma_k"]).mean(0),
+                    np.asarray(d["A_ij"]).reshape(-1, K * K).mean(0),
+                    np.asarray(d["mu_k"]).std(0),
+                ]
+            )
+
+        qg, sg = sample_gibbs(
+            model, data, jax.random.PRNGKey(0),
+            GibbsConfig(num_warmup=200, num_samples=800, num_chains=2),
+        )
+        qn, _ = sample_nuts(
+            model.make_logp({"x": jnp.asarray(data["x"])}),
+            jax.random.PRNGKey(0),
+            init_chains(model, jax.random.PRNGKey(1), data, 2),
+            SamplerConfig(num_warmup=250, num_samples=400, num_chains=2, max_treedepth=6),
+        )
+        assert np.isfinite(np.asarray(sg["logp"])).all()
+        np.testing.assert_allclose(moments(qg), moments(qn), atol=0.07)
+        # recovery sanity on the same fit
+        d = model.constrained_draws(qg.reshape(-1, qg.shape[-1]))
+        np.testing.assert_allclose(np.asarray(d["mu_k"]).mean(0), mu, atol=0.35)
 
 
 class TestSBCGibbs:

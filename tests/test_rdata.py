@@ -1,0 +1,151 @@
+"""RData reader tests (`hhmm_tpu/apps/rdata.py`).
+
+Two layers: a hand-crafted RDX2 byte stream (round-trip against the
+grammar, no R needed) and — when the read-only reference mount is
+present — a parse of one real tick day checked for the invariants the
+Tayal pipeline relies on (`tayal2009/main.R:47-58` semantics: PRICE/
+SIZE columns, sorted POSIXct index, NA rows dropped).
+"""
+
+import gzip
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from hhmm_tpu.apps.rdata import load_rdata, load_tick_rdata
+
+REF_DAY = "/root/reference/tayal2009/data/G.TO/2007.05.01.G.TO.RData"
+
+
+def _int(v):
+    return struct.pack(">i", v)
+
+
+def _charsxp(s: str) -> bytes:
+    b = s.encode()
+    return _int(0x00040009) + _int(len(b)) + b
+
+
+def _symsxp(name: str) -> bytes:
+    return _int(1) + _charsxp(name)
+
+
+def _strsxp(strings) -> bytes:
+    return _int(16) + _int(len(strings)) + b"".join(_charsxp(s) for s in strings)
+
+
+def _realsxp(values, attrs: bytes = b"") -> bytes:
+    flags = 14 | (0x200 if attrs else 0)
+    body = _int(flags) + _int(len(values))
+    body += b"".join(struct.pack(">d", float(v)) for v in values)
+    return body + attrs
+
+
+def _intsxp(values, attrs: bytes = b"") -> bytes:
+    flags = 13 | (0x200 if attrs else 0)
+    body = _int(flags) + _int(len(values))
+    body += b"".join(_int(int(v)) for v in values)
+    return body + attrs
+
+
+def _pairlist(items) -> bytes:
+    """items: list of (tag_name, value_bytes) → tagged LISTSXP chain."""
+    out = b""
+    for name, val in items:
+        out += _int(2 | 0x400) + _symsxp(name) + val
+    return out + _int(254)  # NILVALUE
+
+
+def _rdx2(top: bytes) -> bytes:
+    return b"RDX2\nX\n" + _int(2) + _int(0x030203) + _int(0x020300) + top
+
+
+class TestGrammar:
+    def test_scalar_and_attributes_roundtrip(self, tmp_path):
+        # a [3, 2] matrix with dim + dimnames + index, xts-style
+        mat = _realsxp(
+            [1.0, 2.0, 3.0, 10.0, 20.0, 30.0],
+            attrs=_pairlist(
+                [
+                    ("dim", _intsxp([3, 2])),
+                    (
+                        "dimnames",
+                        _int(19) + _int(2) + _int(254) + _strsxp(["PRICE", "SIZE"]),
+                    ),
+                    ("index", _realsxp([100.0, 101.0, 102.0])),
+                ]
+            ),
+        )
+        raw = _rdx2(_pairlist([("XYZ", mat)]))
+        p = tmp_path / "toy.RData"
+        p.write_bytes(gzip.compress(raw))
+
+        out = load_rdata(str(p))
+        assert list(out) == ["XYZ"]
+        obj = out["XYZ"]
+        assert obj.dim == (3, 2)
+        np.testing.assert_allclose(
+            obj.matrix(), [[1.0, 10.0], [2.0, 20.0], [3.0, 30.0]]
+        )
+        assert obj.colnames() == ["PRICE", "SIZE"]
+
+        ticks = load_tick_rdata(str(p))
+        np.testing.assert_allclose(ticks["price"], [1.0, 2.0, 3.0])
+        np.testing.assert_allclose(ticks["size"], [10.0, 20.0, 30.0])
+        np.testing.assert_allclose(ticks["t_seconds"], [100.0, 101.0, 102.0])
+
+    def test_na_rows_dropped_and_unsorted_index_sorted(self, tmp_path):
+        nan = float("nan")
+        mat = _realsxp(
+            [1.0, nan, 3.0, 10.0, 20.0, 30.0],
+            attrs=_pairlist(
+                [
+                    ("dim", _intsxp([3, 2])),
+                    ("index", _realsxp([102.0, 101.0, 100.0])),
+                ]
+            ),
+        )
+        p = tmp_path / "toy2.RData"
+        p.write_bytes(gzip.compress(_rdx2(_pairlist([("A", mat)]))))
+        ticks = load_tick_rdata(str(p))
+        # NA row dropped, remaining sorted by time
+        np.testing.assert_allclose(ticks["t_seconds"], [100.0, 102.0])
+        np.testing.assert_allclose(ticks["price"], [3.0, 1.0])
+
+    def test_uncompressed_and_bad_magic(self, tmp_path):
+        p = tmp_path / "plain.RData"
+        p.write_bytes(_rdx2(_pairlist([("v", _realsxp([1.0]))])))
+        assert "v" in load_rdata(str(p))
+        bad = tmp_path / "bad.RData"
+        bad.write_bytes(b"not an rdata file")
+        with pytest.raises(ValueError, match="RDX"):
+            load_rdata(str(bad))
+
+
+@pytest.mark.skipif(not os.path.exists(REF_DAY), reason="reference data not mounted")
+class TestReferenceData:
+    def test_real_tick_day(self):
+        ticks = load_tick_rdata(REF_DAY)
+        n = len(ticks["price"])
+        assert n > 1000
+        assert len(ticks["size"]) == n and len(ticks["t_seconds"]) == n
+        assert np.all(np.isfinite(ticks["price"])) and np.all(ticks["price"] > 0)
+        assert np.all(ticks["size"] >= 0)
+        assert np.all(np.diff(ticks["t_seconds"]) >= 0)
+        # 2007-05-01 trading day, America/Toronto (UTC-4): the session
+        # must fall inside that calendar day's UTC range
+        import datetime as dt
+
+        lo = dt.datetime(2007, 5, 1, tzinfo=dt.timezone.utc).timestamp()
+        hi = lo + 2 * 86400.0
+        assert lo <= ticks["t_seconds"][0] <= hi
+        assert lo <= ticks["t_seconds"][-1] <= hi
+
+    def test_full_binding_structure(self):
+        out = load_rdata(REF_DAY)
+        assert list(out) == ["G.TO"]
+        obj = out["G.TO"]
+        assert obj.dim is not None and obj.dim[1] == 6
+        assert obj.colnames()[:2] == ["Price", "Volume"]
