@@ -129,8 +129,10 @@ def spot_checks(phi_mean: np.ndarray) -> Dict[str, float]:
 def _sampler_config(args):
     """ChEES by default: bounded leapfrogs keep each device dispatch
     short (the tunnel kills single XLA programs that run >~10 min —
-    NUTS at depth 7-8 on a ~10k-leg real window exceeds that)."""
-    from hhmm_tpu.infer import ChEESConfig, SamplerConfig
+    NUTS at depth 7-8 on a ~10k-leg real window exceeds that). Gibbs
+    (hard gate — identical on strictly alternating zig-zag signs) is
+    the fast path for the walk-forward backtest."""
+    from hhmm_tpu.infer import ChEESConfig, GibbsConfig, SamplerConfig
 
     if args.sampler == "nuts":
         return SamplerConfig(
@@ -138,6 +140,12 @@ def _sampler_config(args):
             num_samples=args.samples,
             num_chains=args.chains,
             max_treedepth=args.max_treedepth,
+        )
+    if args.sampler == "gibbs":
+        return GibbsConfig(
+            num_warmup=args.warmup,
+            num_samples=args.samples,
+            num_chains=args.chains,
         )
     return ChEESConfig(
         num_warmup=args.warmup,
@@ -229,6 +237,9 @@ def run_wf(args) -> Dict:
         key=jax.random.PRNGKey(args.seed),
         chunk_size=args.chunk,
         cache_dir=args.cache_dir,
+        # conjugate Gibbs needs the exact-HMM factorization; identical
+        # posterior on strictly-alternating zig-zag signs
+        gate_mode="hard" if args.sampler == "gibbs" else "stan",
     )
 
     # per-strategy daily-return table (`main.Rmd:800`: one return per
@@ -288,7 +299,7 @@ def main():
     ap.add_argument("--chains", type=int, default=4)
     ap.add_argument("--max-treedepth", type=int, default=8)
     ap.add_argument("--max-leapfrogs", type=int, default=32)
-    ap.add_argument("--sampler", choices=["chees", "nuts"], default="chees")
+    ap.add_argument("--sampler", choices=["chees", "nuts", "gibbs"], default="chees")
     ap.add_argument("--seed", type=int, default=9000)
     ap.add_argument("--chunk", type=int, default=64)
     ap.add_argument("--symbols", type=str, default="")
@@ -296,6 +307,12 @@ def main():
     ap.add_argument("--cache-dir", type=str, default=None)
     ap.add_argument("--out", type=str, default=None)
     args = ap.parse_args()
+    if args.stage == "single" and args.sampler == "gibbs":
+        raise SystemExit(
+            "--sampler gibbs is walk-forward only (run_window samples "
+            "through the density-based API); use 'wf', or chees/nuts "
+            "for the single stage"
+        )
 
     out = run_single(args) if args.stage == "single" else run_wf(args)
     os.makedirs(RESULTS, exist_ok=True)

@@ -251,17 +251,28 @@ class _Reader:
             return RVector(start + incr * np.arange(int(n)), _attrs_to_dict(attr))
         if cls_name in ("wrap_real", "wrap_integer", "wrap_logical",
                         "wrap_string", "wrap_complex", "wrap_raw"):
-            payload = state.values[0] if isinstance(state, RVector) else state
+            payload = _altrep_payload(state)
             if isinstance(payload, RVector):
                 payload.attributes.update(_attrs_to_dict(attr))
                 return payload
             return RVector(payload, _attrs_to_dict(attr))
         if cls_name == "deferred_string":
             # state = (data to convert, metadata); realize eagerly
-            payload = state.values[0] if isinstance(state, RVector) else state
+            payload = _altrep_payload(state)
             vals = [str(v) for v in np.asarray(payload.values)]
             return RVector(vals, _attrs_to_dict(attr))
         raise ValueError(f"unsupported ALTREP class {cls_name!r}")
+
+
+def _altrep_payload(state):
+    """The wrapped data of an ALTREP wrapper state. R serializes wrapper
+    state as the pairlist CONS(wrapped, metadata) (altclasses.c); older
+    writers used a generic vector (data, metadata)."""
+    if isinstance(state, _Pairlist):
+        return state.car
+    if isinstance(state, RVector) and isinstance(state.values, list):
+        return state.values[0]
+    return state
 
 
 @dataclass

@@ -166,15 +166,36 @@ def wf_trade(
         cache_dir=cache_dir,
     )
 
+    def _bucket(n: int) -> int:
+        """Next power of two >= max(n, 1024): per-task decode shapes
+        collapse to a handful of buckets, so the generated pass compiles
+        a few times instead of once per task (204 distinct lengths =
+        hours of TPU compiles)."""
+        return 1 << max(10, int(n - 1).bit_length())
+
+    def _pad_to(a, n, fill=0):
+        return np.pad(np.asarray(a), (0, n - len(a)), constant_values=fill)
+
     results = []
     for i, (task, (zig, x, sign, n_ins)) in enumerate(zip(tasks, feats)):
+        n_oos = len(x) - n_ins
+        b_ins, b_oos = _bucket(n_ins), _bucket(n_oos)
         per_task = {
-            "x": jnp.asarray(x[:n_ins]),
-            "sign": jnp.asarray(sign[:n_ins]),
-            "x_oos": jnp.asarray(x[n_ins:]),
-            "sign_oos": jnp.asarray(sign[n_ins:]),
+            "x": jnp.asarray(_pad_to(x[:n_ins], b_ins)),
+            "sign": jnp.asarray(_pad_to(sign[:n_ins], b_ins)),
+            "mask": jnp.asarray(
+                (np.arange(b_ins) < n_ins).astype(np.float32)
+            ),
+            "x_oos": jnp.asarray(_pad_to(x[n_ins:], b_oos)),
+            "sign_oos": jnp.asarray(_pad_to(sign[n_ins:], b_oos)),
+            "mask_oos": jnp.asarray(
+                (np.arange(b_oos) < n_oos).astype(np.float32)
+            ),
         }
-        leg_state = decode_states(model, qs[i], per_task)
+        padded_state = decode_states(model, qs[i], per_task)
+        leg_state = np.concatenate(
+            [padded_state[:n_ins], padded_state[b_ins : b_ins + n_oos]]
+        )
         lw = label_and_trade(task.price, zig, leg_state, task.ins_end_tick, lags)
         results.append(
             WFResult(

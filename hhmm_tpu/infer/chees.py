@@ -142,6 +142,7 @@ def sample_chees_batched(
     jit: bool = True,
     series_weight: Optional[jnp.ndarray] = None,
     probe_vg: Optional[Callable] = None,
+    trajectory_fn: Optional[Callable] = None,
 ):
     """ChEES-HMC over a series×chains batch with SHARED step-size and
     trajectory-length adaptation (see module docstring).
@@ -188,6 +189,12 @@ def sample_chees_batched(
         return 0.5 * jnp.sum(inv_mass[:, None, :] * p * p, axis=-1)
 
     def leapfrogs(inv_mass, eps, n_steps, q, p, logp, grad):
+        if trajectory_fn is not None:
+            # the whole trajectory as ONE fused kernel launch (e.g.
+            # `kernels/pallas_traj.py::make_tayal_trajectory`) — same
+            # algebra, none of the per-leapfrog launch+glue latency
+            return trajectory_fn(inv_mass, eps, n_steps, q, p, logp, grad)
+
         def body(state):
             i, q, p, _, grad = state
             p_half = p + 0.5 * eps * grad

@@ -1,0 +1,440 @@
+"""Fully-fused ChEES/HMC trajectory kernel for the Tayal model.
+
+The batch HMC samplers are latency-bound: each leapfrog is one fused
+forward+gradient kernel launch (`kernels/pallas_forward.py`) plus XLA
+glue (bijector chain rule, momentum update) — ~2/3 of the per-leapfrog
+wall-clock is launch+glue, not math. This kernel runs an ENTIRE
+trajectory (n leapfrog steps, dynamic count bounded by the ChEES cap)
+in ONE `pallas_call`, holding positions, momenta, the forward filter,
+and all gradient accumulators in VMEM/registers:
+
+per leapfrog, entirely in-kernel:
+- unpack: sigmoid (p_11), stick-breaking simplex rows (A_row 2-simplexes,
+  phi_k 9-simplexes) with their exact Stan log-Jacobians — bit-matching
+  `core/bijectors.py` (`UnitInterval`, `Simplex`);
+- assemble the sparse Tayal (pi, A) — entry-state-restricted pi factor
+  and MASK_NEG structural zeros (`models/tayal.py::build_vg` semantics);
+- emissions on the fly: log_obs[t, k] = log_phi[k, x_t] via a 9-term
+  one-hot contraction (the [T, K] observation matrix never exists);
+- forward filter (alpha in VMEM scratch) + backward pass with
+  Baum-Welch accumulators: d_pi, d_A [K,K], and d_phi-in-log-space
+  accumulated DIRECTLY per symbol ([K, L] — the [T, K] d_obs of the
+  per-leapfrog kernel is never materialized);
+- hand-derived stick-breaking VJPs back to the 35 unconstrained
+  coordinates (suffix-sum form), plus the log-Jacobian gradients;
+- the leapfrog momentum updates with the shared (scalar) step size and
+  per-lane diagonal inverse mass.
+
+Gating is the stan-parity sign gate (`hhmm-tayal2009.stan:46-70`): the
+transition factor log A[i, j] is multiplied by
+c[t, j] = (sign_t == state_sign_j), exactly as `kernels/pallas_forward`.
+
+Layout: flat batch (series x chains) on the 128-lane axis, one grid
+step per tile; the 35 unconstrained coordinates and K=4 states live on
+sublanes. The step count is a dynamic scalar (SMEM) bounded by the
+static ChEES cap, so the jittered-trajectory semantics of
+`infer/chees.py::leapfrogs` are preserved exactly.
+
+Equality with the unfused path (same bijectors, same gating, same
+leapfrog algebra) is pinned by `tests/test_pallas_traj.py` in
+interpreter mode; the TPU path is exercised by `bench.py --sampler
+chees`.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["tayal_trajectory", "make_tayal_trajectory"]
+
+_LANES = 128
+_K = 4
+_L = 9
+_DIM = 35  # 1 (p_11) + 2 (A_row frees) + 32 (phi frees)
+# state sign groups: states {1,2} emit up (0.0), {0,3} down (1.0)
+_STATE_SIGN = np.array([1.0, 0.0, 0.0, 1.0], np.float32)
+_UP, _DOWN = 0.0, 1.0
+
+
+def _logsig(x):
+    # stable log-sigmoid: -softplus(-x)
+    return jnp.minimum(x, 0.0) - jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+
+def _unpack(q):
+    """q [DIM, B] -> (log_phi [K, L, B], z_phi [K, L-1, B], zA [2, B],
+    p11 [B], ldj [B]).
+
+    Bit-matches `core/bijectors.py`: UnitInterval for p_11, stick-
+    breaking Simplex for the A_row and phi_k rows (offsets
+    -log(K-1-d)). The sparse transition matrix itself is assembled in
+    linear space by the caller (scaled Baum-Welch)."""
+    B = q.shape[1]
+    q0 = q[0]
+    p11 = jax.nn.sigmoid(q0)
+    ldj = _logsig(q0) + _logsig(-q0)
+
+    # A_row: two 2-simplexes, one free coord each (offset -log(1) = 0)
+    zA_logit = q[1:3]  # [2, B]
+    log_zA = _logsig(zA_logit)
+    log_1mzA = _logsig(-zA_logit)
+    zA = jax.nn.sigmoid(zA_logit)
+    ldj = ldj + jnp.sum(log_zA + log_1mzA, axis=0)
+
+    # phi rows: 4 stick-breaking 9-simplexes (8 frees each).
+    # Stick offsets -log(L-1-d) built in-kernel from a 2-D iota
+    # (Pallas kernels may not capture host constant arrays).
+    d_iota = lax.broadcasted_iota(jnp.int32, (_L - 1, B), 0).astype(jnp.float32)  # [8, B]
+    offsets = -jnp.log(float(_L - 1) - d_iota)
+    log_phi_rows = []
+    z_rows = []
+    for k in range(_K):
+        xk = q[3 + 8 * k : 3 + 8 * (k + 1)]  # [8, B]
+        logit = xk + offsets
+        log_z = _logsig(logit)
+        log_1mz = _logsig(-logit)
+        # unrolled cumsum over the 8 sticks (Mosaic has no cumsum)
+        rem_rows = []
+        acc = jnp.zeros((B,), jnp.float32)
+        for d in range(_L - 1):
+            rem_rows.append(acc)  # log remaining stick BEFORE break d
+            acc = acc + log_1mz[d]
+        log_rem_before = jnp.stack(rem_rows)  # [8, B]
+        log_y = jnp.concatenate(
+            [log_z + log_rem_before, acc[None]], axis=0
+        )  # [9, B]; acc = full log-remainder = log y_last
+        ldj = ldj + jnp.sum(log_z + log_1mz + log_rem_before, axis=0)
+        log_phi_rows.append(log_y)
+        z_rows.append(jnp.exp(log_z))
+    log_phi = jnp.stack(log_phi_rows)  # [K, L, B]
+    z_phi = jnp.stack(z_rows)  # [K, L-1, B]
+    return log_phi, z_phi, zA, p11, ldj
+
+
+def _traj_kernel(
+    T,  # static
+    cap,  # static leapfrog bound
+    q_ref,  # [DIM, B]
+    p_ref,  # [DIM, B]
+    g_ref,  # [DIM, B]  (gradient at q, from the previous transition)
+    im_ref,  # [DIM, B] diagonal inverse mass
+    x_ref,  # [T, B] float symbols 0..8
+    sign_ref,  # [T, B] float 0=up / 1=down
+    mask_ref,  # [T, B]
+    eps_ref,  # [1, 1] SMEM
+    n_ref,  # [1, 1] SMEM int32
+    q1_ref,  # out [DIM, B]
+    p1_ref,  # out [DIM, B]
+    lp1_ref,  # out [1, B]
+    g1_ref,  # out [DIM, B]
+    alpha_scr,  # [T, K, B] VMEM scratch (normalized filter, then d_obs)
+    obs_scr,  # [T, K, B] VMEM scratch (per-leapfrog linear emissions)
+    c_scr,  # [T, B] VMEM scratch (per-step normalizers)
+):
+    B = q_ref.shape[1]
+    eps = eps_ref[0, 0]
+    n_steps = n_ref[0, 0]
+    # state sign groups, built in-kernel: states {1, 2} emit up legs
+    k_iota = lax.broadcasted_iota(jnp.int32, (_K, B), 0).astype(jnp.float32)
+    state_sign_b = jnp.where((k_iota == 1.0) | (k_iota == 2.0), _UP, _DOWN)
+
+    s0 = sign_ref[0]  # [B]
+    entry_down = (s0 == _DOWN).astype(jnp.float32)  # pi factor on state 0
+    entry_up = 1.0 - entry_down  # pi factor on state 2
+
+    def xoh_l(l):
+        """One-hot symbol plane [T, B], recomputed on demand (a VMEM
+        [T, L, B] scratch for all planes blows the 16M scoped limit)."""
+        return (x_ref[:] == float(l)).astype(jnp.float32)
+
+    def logp_grad(q):
+        log_phi, z_phi, zA, p11, ldj = _unpack(q)
+
+        # ---- SCALED (linear-space) Baum-Welch: per-step work is pure
+        # multiply/add + one [B]-wide log, instead of [K,K,B] exp +
+        # [K,B] log chains — the classical rescaled filter (Rabiner),
+        # exactly equal to the log-space recursion in exact arithmetic.
+        one_b = jnp.ones((B,), jnp.float32)
+        zero_b = jnp.zeros((B,), jnp.float32)
+        # linear sparse A (structural zeros exact)
+        A_lin = jnp.stack(
+            [
+                jnp.stack([zero_b, zA[0], 1.0 - zA[0], zero_b]),
+                jnp.stack([one_b, zero_b, zero_b, zero_b]),
+                jnp.stack([zA[1], zero_b, zero_b, 1.0 - zA[1]]),
+                jnp.stack([zero_b, zero_b, one_b, zero_b]),
+            ]
+        )  # [K(i), K(j), B]
+        # entry-gated linear pi: unit factor off the entry state
+        pi_eff = jnp.stack(
+            [
+                entry_down * p11 + (1.0 - entry_down),
+                one_b,
+                entry_up * (1.0 - p11) + (1.0 - entry_up),
+                one_b,
+            ]
+        )  # [K, B]
+
+        # linear emissions for ALL steps (9-term one-hot contraction);
+        # per-l operands via lax.slice_in_dim (mixed int+None indexing
+        # on 3-D values lowers to an unsupported gather)
+        phi_lin = jnp.exp(log_phi)  # [K, L, B]
+        acc = jnp.zeros((T, _K, B), jnp.float32)
+        for l in range(_L):
+            phi_l = lax.slice_in_dim(phi_lin, l, l + 1, axis=1)  # [K, 1, B]
+            acc = acc + xoh_l(l)[:, None, :] * phi_l.reshape(1, _K, B)
+        obs_scr[:] = acc
+
+        def gate_at(t):
+            return (sign_ref[t][None] == state_sign_b).astype(jnp.float32)  # [K(j), B]
+
+        def A_eff_at(t):
+            g = gate_at(t)
+            # stan gating: unit transition factor on gated-off dests
+            return jnp.where(g[None, :, :] > 0, A_lin, 1.0), g
+
+        # ---- forward: normalized filter + per-step log-normalizer ----
+        m0 = mask_ref[0][None]
+        v0 = jnp.where(m0 > 0, pi_eff * obs_scr[0], pi_eff)
+        c0 = jnp.sum(v0, axis=0)  # [B]
+        alpha = v0 / c0[None]
+        alpha_scr[0] = alpha
+        c_scr[0] = c0
+
+        def fwd_body(t, carry):
+            alpha, ll = carry
+            Ae, _ = A_eff_at(t)
+            w = jnp.sum(alpha[:, None, :] * Ae, axis=0) * obs_scr[t]  # [K(j), B]
+            c = jnp.sum(w, axis=0)
+            m_t = mask_ref[t][None]
+            alpha = jnp.where(m_t > 0, w / c[None], alpha)
+            c = jnp.where(mask_ref[t] > 0, c, 1.0)
+            alpha_scr[t] = alpha
+            c_scr[t] = c
+            return alpha, ll + jnp.log(c)
+
+        alpha, ll = lax.fori_loop(1, T, fwd_body, (alpha, jnp.log(c0)))
+
+        # ---- backward; gamma_t overwrites alpha_scr[t] (already
+        # consumed), giving d_obs in scratch without a third buffer ----
+        beta0 = jnp.ones((_K, B), jnp.float32)
+        dA0 = jnp.zeros((_K, _K, B), jnp.float32)
+
+        def bwd_body(i, carry):
+            beta, dA = carry
+            t = T - 1 - i
+            m_t = mask_ref[t][None]
+            m01 = (m_t > 0).astype(jnp.float32)
+            gamma_t = alpha_scr[t] * beta * m01
+            Ae, g_t = A_eff_at(t)
+            e = obs_scr[t] * beta / c_scr[t][None]  # [K(j), B]
+            alpha_scr[t] = gamma_t  # safe: only alpha_scr[t-1] is read below
+            xi = alpha_scr[t - 1][:, None, :] * Ae * e[None, :, :] * g_t[None]
+            dA = dA + xi * m01[None]
+            new_beta = jnp.sum(Ae * e[None, :, :], axis=1)  # [K(i), B]
+            beta = jnp.where(m_t > 0, new_beta, beta)
+            return beta, dA
+
+        beta, dA = lax.fori_loop(0, T - 1, bwd_body, (beta0, dA0))
+        gamma0 = alpha_scr[0] * beta
+        m0_01 = (mask_ref[0][None] > 0).astype(jnp.float32)
+        alpha_scr[0] = gamma0 * m0_01
+        dpi = gamma0  # [K, B]
+
+        # emission gradients: one vectorized contraction over T
+        # demis[k, l, b] = sum_t gamma[t, k, b] * xoh[t, l, b]
+        dgamma = alpha_scr[:]  # [T, K, B]
+        demis = jnp.stack(
+            [
+                jnp.sum(dgamma * xoh_l(l)[:, None, :], axis=0)
+                for l in range(_L)
+            ],
+            axis=1,
+        )  # [K, L, B]
+
+        # ---- chain rule to the 35 unconstrained coordinates ----
+        # (assembled by concatenation — Mosaic has no scatter)
+        # p_11 (UnitInterval + entry-gated pi factor)
+        dq0 = (
+            dpi[0] * entry_down * (1.0 - p11)
+            - dpi[2] * entry_up * p11
+            + (1.0 - 2.0 * p11)
+        )
+        # A_row 2-simplexes: g = (d/dlog y_0, d/dlog y_1)
+        dq1 = dA[0, 1] * (1.0 - zA[0]) - zA[0] * dA[0, 2] + (1.0 - 2.0 * zA[0])
+        dq2 = dA[2, 0] * (1.0 - zA[1]) - zA[1] * dA[2, 3] + (1.0 - 2.0 * zA[1])
+        # phi 9-simplex rows: suffix-sum stick-breaking VJP + ldj grad
+        dphi = []
+        for k in range(_K):
+            g = demis[k]  # [L, B] = d ll / d log_y
+            z = z_phi[k]  # [L-1, B]
+            # S_j = sum_{d > j} g_d (unrolled suffix sum, no cumsum/flip)
+            s_rows = [None] * (_L - 1)
+            acc_s = g[_L - 1]
+            for j in range(_L - 2, -1, -1):
+                s_rows[j] = acc_s
+                acc_s = acc_s + g[j]
+            S = jnp.stack(s_rows)  # [L-1, B]
+            jidx = lax.broadcasted_iota(jnp.int32, (_L - 1, B), 0).astype(jnp.float32)
+            dldj = 1.0 - 2.0 * z - z * (float(_L - 2) - jidx)
+            dphi.append(g[:-1] * (1.0 - z) - z * S + dldj)
+        grad = jnp.concatenate(
+            [dq0[None], dq1[None], dq2[None]] + dphi, axis=0
+        )  # [DIM, B]
+        return ll + ldj, grad
+
+    # ---- leapfrog trajectory (dynamic count, static cap) ----
+    q = q_ref[:]
+    p = p_ref[:]
+    grad = g_ref[:]
+    im = im_ref[:]
+    logp = jnp.zeros((B,), jnp.float32)
+
+    def lf_body(i, carry):
+        q, p, logp, grad = carry
+        p_half = p + 0.5 * eps * grad
+        q = q + eps * im * p_half
+        logp, grad = logp_grad(q)
+        p = p_half + 0.5 * eps * grad
+        return q, p, logp, grad
+
+    # dynamic trip count (the jittered ChEES step count lives in SMEM);
+    # `cap` only bounds it on the caller side
+    q, p, logp, grad = lax.fori_loop(
+        0, jnp.minimum(n_steps, cap), lf_body, (q, p, logp, grad)
+    )
+    q1_ref[:] = q
+    p1_ref[:] = p
+    lp1_ref[0] = logp
+    g1_ref[:] = grad
+
+
+def tayal_trajectory(
+    q: jnp.ndarray,  # [N, DIM]
+    p: jnp.ndarray,  # [N, DIM]
+    grad: jnp.ndarray,  # [N, DIM]
+    inv_mass: jnp.ndarray,  # [N, DIM]
+    eps: jnp.ndarray,  # scalar
+    n_steps: jnp.ndarray,  # scalar int32 (1..cap)
+    x: jnp.ndarray,  # [N, T] int symbols 0..8
+    sign: jnp.ndarray,  # [N, T] int 0=up / 1=down
+    mask: Optional[jnp.ndarray],  # [N, T] or None
+    cap: int,
+    *,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One fused trajectory for a flat batch of Tayal posteriors.
+
+    Returns ``(q1, p1, logp1, grad1)`` — the state after ``n_steps``
+    leapfrogs of the stan-gate Tayal density (loglik + log|Jacobian|),
+    matching `infer/chees.py::leapfrogs` with `TayalHHMM().make_vg`.
+    """
+    N, D = q.shape
+    T = x.shape[1]
+    if D != _DIM:
+        raise ValueError(f"expected dim {_DIM}, got {D}")
+    if mask is None:
+        mask = jnp.ones((N, T), jnp.float32)
+    Np = -(-N // _LANES) * _LANES
+
+    def pad(a):
+        return jnp.pad(a, [(0, Np - N)] + [(0, 0)] * (a.ndim - 1))
+
+    q_t = pad(q).T  # [DIM, Np]
+    p_t = pad(p).T
+    g_t = pad(grad).T
+    im_t = jnp.pad(inv_mass, [(0, Np - N), (0, 0)], constant_values=1.0).T
+    x_t = pad(x.astype(jnp.float32)).T  # [T, Np]
+    sign_t = pad(sign.astype(jnp.float32)).T
+    mask_t = jnp.pad(mask, [(0, Np - N), (0, 0)], constant_values=1.0).T
+
+    eps_s = jnp.asarray(eps, jnp.float32).reshape(1, 1)
+    n_s = jnp.asarray(n_steps, jnp.int32).reshape(1, 1)
+
+    grid = (Np // _LANES,)
+
+    def lanes(*blk):
+        return pl.BlockSpec(
+            blk + (_LANES,),
+            index_map=lambda b: (0,) * len(blk) + (b,),
+            memory_space=pltpu.VMEM,
+        )
+
+    smem = pl.BlockSpec((1, 1), index_map=lambda b: (0, 0), memory_space=pltpu.SMEM)
+    in_specs = [
+        lanes(_DIM),
+        lanes(_DIM),
+        lanes(_DIM),
+        lanes(_DIM),
+        lanes(T),
+        lanes(T),
+        lanes(T),
+        smem,
+        smem,
+    ]
+    out_shape = (
+        jax.ShapeDtypeStruct((_DIM, Np), jnp.float32),
+        jax.ShapeDtypeStruct((_DIM, Np), jnp.float32),
+        jax.ShapeDtypeStruct((1, Np), jnp.float32),
+        jax.ShapeDtypeStruct((_DIM, Np), jnp.float32),
+    )
+    q1, p1, lp1, g1 = pl.pallas_call(
+        partial(_traj_kernel, T, cap),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=(lanes(_DIM), lanes(_DIM), lanes(1), lanes(_DIM)),
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((T, _K, _LANES), jnp.float32),
+            pltpu.VMEM((T, _K, _LANES), jnp.float32),
+            pltpu.VMEM((T, _LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q_t, p_t, g_t, im_t, x_t, sign_t, mask_t, eps_s, n_s)
+    return q1.T[:N], p1.T[:N], lp1[0, :N], g1.T[:N]
+
+
+def make_tayal_trajectory(data, cap: int, interpret: bool = False):
+    """Build a `trajectory_fn` for `sample_chees_batched`: signature
+    ``(inv_mass [B, dim], eps, n_steps, q [B, C, dim], p, logp, grad) ->
+    (q, p, logp, grad)``. ``data``: dict with per-series ``x``/``sign``
+    [B, T] (and optional ``mask``) for the stan-gate `TayalHHMM`."""
+    x = jnp.asarray(data["x"])
+    sign = jnp.asarray(data["sign"])
+    mask = data.get("mask")
+    if mask is not None:
+        mask = jnp.asarray(mask)
+
+    def trajectory(inv_mass, eps, n_steps, q, p, logp, grad):
+        B, C, D = q.shape
+        T = x.shape[1]
+        rep = lambda a: jnp.repeat(a, C, axis=0)  # [B, T] -> [B*C, T]
+        q1, p1, lp1, g1 = tayal_trajectory(
+            q.reshape(B * C, D),
+            p.reshape(B * C, D),
+            grad.reshape(B * C, D),
+            jnp.repeat(inv_mass, C, axis=0),
+            eps,
+            n_steps,
+            rep(x),
+            rep(sign),
+            None if mask is None else rep(mask),
+            cap,
+            interpret=interpret,
+        )
+        return (
+            q1.reshape(B, C, D),
+            p1.reshape(B, C, D),
+            lp1.reshape(B, C),
+            g1.reshape(B, C, D),
+        )
+
+    return trajectory

@@ -1,0 +1,171 @@
+"""Fused Tayal trajectory kernel (`kernels/pallas_traj.py`) parity
+tests: the kernel's in-kernel bijectors, gating, Baum-Welch chain rule,
+and leapfrog algebra must reproduce the unfused reference path —
+`infer/chees.py::leapfrogs` over `TayalHHMM().make_vg` — exactly
+(f32 tolerances), in interpreter mode on CPU."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from __graft_entry__ import _tayal_batch
+from hhmm_tpu.kernels.pallas_traj import make_tayal_trajectory, tayal_trajectory
+from hhmm_tpu.models import TayalHHMM
+
+
+def _reference_trajectory(model, data, inv_mass, eps, n_steps, q, p, grad):
+    """The unfused leapfrog loop of `infer/chees.py::leapfrogs` with the
+    per-series fused value+grad (series x chains batch)."""
+    B, C, D = q.shape
+
+    def lp_one(xi, si, qi):
+        return model.make_vg({"x": xi, "sign": si})(qi)
+
+    def lp_bc(qs):
+        lps, grads = jax.vmap(
+            lambda xi, si, qc: jax.vmap(lambda qq: lp_one(xi, si, qq))(qc)
+        )(data["x"], data["sign"], qs)
+        return lps, grads
+
+    logp, g = lp_bc(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(grad), rtol=1e-4, atol=1e-4)
+    for _ in range(int(n_steps)):
+        p_half = p + 0.5 * eps * g
+        q = q + eps * inv_mass[:, None, :] * p_half
+        logp, g = lp_bc(q)
+        p = p_half + 0.5 * eps * g
+    return q, p, logp, g
+
+
+class TestTrajectoryParity:
+    @pytest.mark.parametrize("n_steps", [1, 3, 8])
+    def test_matches_unfused_leapfrogs(self, n_steps):
+        B, C, T, D = 3, 2, 64, 35
+        model = TayalHHMM()  # stan gate — the bench ChEES model
+        x, sign = _tayal_batch(B, T, seed=5)
+        data = {"x": x, "sign": sign}
+        key = jax.random.PRNGKey(0)
+        q = jnp.stack(
+            [
+                jnp.stack(
+                    [
+                        model.init_unconstrained(
+                            jax.random.fold_in(key, b * 10 + c),
+                            {"x": x[b], "sign": sign[b]},
+                        )
+                        for c in range(C)
+                    ]
+                )
+                for b in range(B)
+            ]
+        )  # [B, C, D]
+        p = 0.7 * jax.random.normal(jax.random.fold_in(key, 99), (B, C, D))
+        inv_mass = jnp.exp(
+            0.3 * jax.random.normal(jax.random.fold_in(key, 98), (B, D))
+        )
+        eps = jnp.asarray(0.02, jnp.float32)
+
+        # gradient at the start point (what the sampler carries)
+        def vg_flat(qf, xb, sb):
+            return model.make_vg({"x": xb, "sign": sb})(qf)
+
+        g0 = jnp.stack(
+            [
+                jnp.stack([vg_flat(q[b, c], x[b], sign[b])[1] for c in range(C)])
+                for b in range(B)
+            ]
+        )
+
+        traj = make_tayal_trajectory(data, cap=8, interpret=True)
+        q1, p1, lp1, g1 = traj(
+            inv_mass, eps, jnp.asarray(n_steps, jnp.int32), q, p, None, g0
+        )
+        qr, pr, lpr, gr = _reference_trajectory(
+            model, data, inv_mass, float(eps), n_steps, q, p, g0
+        )
+        np.testing.assert_allclose(np.asarray(q1), np.asarray(qr), rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(p1), np.asarray(pr), rtol=2e-3, atol=2e-3)
+        np.testing.assert_allclose(np.asarray(lp1), np.asarray(lpr), rtol=1e-4, atol=5e-3)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(gr), rtol=2e-3, atol=2e-3)
+
+    def test_chees_with_fused_trajectory_samples_same_posterior(self):
+        """End-to-end: `sample_chees_batched` with the fused trajectory
+        targets the same posterior as the unfused path (f32 rounding
+        diverges individual chains chaotically, so the gate is
+        statistical: posterior means within MC error, no divergences)."""
+        from hhmm_tpu.infer import ChEESConfig, make_lp_bc, sample_chees_batched
+        from hhmm_tpu.batch import default_init
+
+        B, C, T = 4, 2, 96
+        model = TayalHHMM()
+        x, sign = _tayal_batch(B, T, seed=11)
+        data = {"x": x, "sign": sign}
+        cfg = ChEESConfig(num_warmup=120, num_samples=150, num_chains=C, max_leapfrogs=8)
+        init = default_init(model, data, B, C, jax.random.PRNGKey(3))
+        lp_bc = make_lp_bc(model, data)
+        probe = model.make_vg({"x": x[0], "sign": sign[0]})
+        qs_u, st_u = sample_chees_batched(
+            lp_bc, jax.random.PRNGKey(4), init, cfg, probe_vg=probe
+        )
+        traj = make_tayal_trajectory(data, cap=cfg.max_leapfrogs, interpret=True)
+        qs_f, st_f = sample_chees_batched(
+            lp_bc, jax.random.PRNGKey(4), init, cfg, probe_vg=probe,
+            trajectory_fn=traj,
+        )
+        assert not bool(np.asarray(st_f["diverging"]).any())
+        m_u = np.asarray(qs_u).reshape(B, -1, qs_u.shape[-1]).mean(axis=1)
+        m_f = np.asarray(qs_f).reshape(B, -1, qs_f.shape[-1]).mean(axis=1)
+        sd = np.asarray(qs_u).reshape(B, -1, qs_u.shape[-1]).std(axis=1)
+        np.testing.assert_array_less(
+            np.abs(m_u - m_f), 5.0 * sd / np.sqrt(20.0) + 0.25
+        )
+
+    def test_masked_padding_matches_truncated(self):
+        B, C, T = 2, 2, 48
+        model = TayalHHMM()
+        x, sign = _tayal_batch(B, T, seed=9)
+        Tv = 32
+        mask = np.zeros((B, T), np.float32)
+        mask[:, :Tv] = 1.0
+        key = jax.random.PRNGKey(1)
+        q = jnp.stack(
+            [
+                jnp.stack(
+                    [
+                        model.init_unconstrained(
+                            jax.random.fold_in(key, b * 7 + c),
+                            {"x": x[b, :Tv], "sign": sign[b, :Tv]},
+                        )
+                        for c in range(C)
+                    ]
+                )
+                for b in range(B)
+            ]
+        )
+        p = 0.5 * jax.random.normal(jax.random.fold_in(key, 5), q.shape)
+        im = jnp.ones((B, q.shape[-1]))
+        eps = jnp.asarray(0.03, jnp.float32)
+
+        def g_of(data_b, qf):
+            return model.make_vg(data_b)(qf)[1]
+
+        g0 = jnp.stack(
+            [
+                jnp.stack([g_of({"x": x[b, :Tv], "sign": sign[b, :Tv]}, q[b, c]) for c in range(2)])
+                for b in range(B)
+            ]
+        )
+        full = make_tayal_trajectory(
+            {"x": x, "sign": sign, "mask": mask}, cap=4, interpret=True
+        )
+        trunc = make_tayal_trajectory(
+            {"x": x[:, :Tv], "sign": sign[:, :Tv]}, cap=4, interpret=True
+        )
+        n = jnp.asarray(3, jnp.int32)
+        out_f = full(im, eps, n, q, p, None, g0)
+        out_t = trunc(im, eps, n, q, p, None, g0)
+        for a, b_ in zip(out_f, out_t):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), rtol=2e-4, atol=2e-4
+            )

@@ -114,6 +114,32 @@ class TestGrammar:
         np.testing.assert_allclose(ticks["t_seconds"], [100.0, 102.0])
         np.testing.assert_allclose(ticks["price"], [3.0, 1.0])
 
+    def test_altrep_wrap_real_pairlist_state(self, tmp_path):
+        """R >= 3.5 serializes ALTREP wrapper state as the pairlist
+        CONS(wrapped, metadata) (altclasses.c) — e.g. a sort()-ed
+        vector carrying sortedness metadata."""
+        wrapped = _realsxp([3.0, 1.0, 2.0])
+        meta = _intsxp([0, 0])
+        # ALTREP_SXP: info pairlist (class sym, package sym, type int),
+        # then state, then attributes
+        info = (
+            _int(2 | 0x400)  # LISTSXP with tag? info is a plain list:
+        )
+        # info = list(class_sym, package_sym, type): serialize.c writes a
+        # pairlist CONS(sym, CONS(sym, CONS(int, NIL)))
+        info = (
+            _int(2) + _symsxp("wrap_real")
+            + _int(2) + _symsxp("base")
+            + _int(2) + _intsxp([14]) + _int(254)
+        )
+        state = _int(2) + wrapped + _int(2) + meta + _int(254)
+        altrep = _int(238) + info + state + _int(254)  # attr = NULL
+        raw = _rdx2(_pairlist([("v", altrep)]))
+        p = tmp_path / "alt.RData"
+        p.write_bytes(gzip.compress(raw))
+        out = load_rdata(str(p))
+        np.testing.assert_allclose(np.asarray(out["v"].values), [3.0, 1.0, 2.0])
+
     def test_uncompressed_and_bad_magic(self, tmp_path):
         p = tmp_path / "plain.RData"
         p.write_bytes(_rdx2(_pairlist([("v", _realsxp([1.0]))])))
