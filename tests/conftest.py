@@ -30,6 +30,14 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-minute tests (full apps, SBC suites, batch engines); "
+        "`pytest -m 'not slow'` is the fast iteration subset (~13 min)",
+    )
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

@@ -6,6 +6,8 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute suites; fast subset: -m 'not slow'
+
 from hhmm_tpu.batch import (
     ResultCache,
     digest_key,

@@ -74,6 +74,8 @@ class TestGaussianTarget:
             )
 
 
+
+@pytest.mark.slow
 class TestCrossSamplerAgreement:
     def test_matches_nuts_on_multinomial_hmm(self, rng):
         """ChEES and NUTS target the identical posterior; their
@@ -145,6 +147,8 @@ class TestRaggedChunk:
         assert np.isfinite(np.asarray(stats["logp"])).all()
 
 
+
+@pytest.mark.slow
 class TestAppHarnesses:
     """The walk-forward application harnesses accept a ChEESConfig and
     route both the batched fit and (Hassan) the warm-start pilot through

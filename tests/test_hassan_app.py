@@ -5,6 +5,8 @@ error metrics, and the batched walk-forward harness on synthetic OHLC."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute suites; fast subset: -m 'not slow'
+
 from hhmm_tpu.apps.hassan import (
     forecast_errors,
     make_dataset,

@@ -175,6 +175,8 @@ class TestSimulatorMatchesCompiler:
         np.testing.assert_allclose(occ_rec, stat, atol=0.03)
 
 
+
+@pytest.mark.slow
 class TestTreeToPosteriorRoundTrip:
     """End-to-end: tree DSL → recursive engine data → NUTS fit of the
     flat model → state recovery (the reference's simulate→fit→diagnose

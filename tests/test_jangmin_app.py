@@ -8,6 +8,8 @@ import jax
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute suites; fast subset: -m 'not slow'
+
 from hhmm_tpu.apps.jangmin import (
     N_REGIMES,
     fit_market,

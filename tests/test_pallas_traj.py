@@ -9,6 +9,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute suites; fast subset: -m 'not slow'
+
 from __graft_entry__ import _tayal_batch
 from hhmm_tpu.kernels.pallas_traj import make_tayal_trajectory, tayal_trajectory
 from hhmm_tpu.models import TayalHHMM

@@ -14,6 +14,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # multi-minute suites; fast subset: -m 'not slow'
 from scipy.stats import kstest
 
 from scipy.stats import truncnorm

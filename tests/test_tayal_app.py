@@ -164,6 +164,8 @@ class TestAnalytics:
         assert "Unconditional" in summary
 
 
+
+@pytest.mark.slow
 class TestPipeline:
     def test_window_end_to_end(self):
         """Synthetic ticks with planted regimes: the fitted window must

@@ -103,6 +103,8 @@ class TestGradients:
         assert np.isfinite(np.asarray(gr)).all()
 
 
+
+@pytest.mark.slow
 class TestRecovery:
     def test_hmix_unsup_recovery(self):
         """Flat 2-component mixture tree: recover ±5 means and the
@@ -157,6 +159,8 @@ class TestRecovery:
         assert (top_hat == top_true).mean() > 0.95
 
 
+
+@pytest.mark.slow
 class TestGaussianLeafPriors:
     """Weakly-informative priors on Gaussian leaves (μ ~ N(0, s_mu),
     σ ~ half-N(0, s_sigma)). A deep tree routinely has leaves with no
