@@ -1,0 +1,106 @@
+"""Figures from the committed real-data replication artifacts.
+
+Reads ``results/tayal_replication.json`` (no TPU needed) and renders:
+
+- ``tayal_phi_posterior.png`` — the G.TO 4x9 emission posterior
+  (mean ± sd per state) with the published spot-checks marked, the
+  equivalent of the reference's per-state parameter panels
+  (`tayal2009/main.Rmd:540-558`);
+- ``tayal_wf_lags.png`` — mean daily return and hit rate per strategy
+  (buy-and-hold + lags 0..5) over the 204-window backtest, the summary
+  view of the reference's 1,428-return appendix table
+  (`tayal2009/Rmd/appendix-wf.Rmd`).
+
+Run: ``python examples/replication_figures.py`` (writes docs/figures).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RESULTS = os.path.join(ROOT, "results", "tayal_replication.json")
+OUT = os.path.join(ROOT, "docs", "figures")
+
+
+def main():
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    with open(RESULTS) as f:
+        rep = json.load(f)
+    os.makedirs(OUT, exist_ok=True)
+
+    # --- emission posterior panels ---
+    single = rep["single"]
+    mean = np.asarray(single["phi_mean"])  # [4, 9]
+    sd = np.asarray(single["phi_sd"])
+    titles = [
+        "state 1 (bear, down legs)",
+        "state 2 (bear, up legs)",
+        "state 3 (bull, up legs)",
+        "state 4 (bull, down legs)",
+    ]
+    fig, axes = plt.subplots(1, 4, figsize=(13, 3.2), sharey=True)
+    for k, ax in enumerate(axes):
+        ax.bar(np.arange(1, 10), mean[k], yerr=sd[k], color="#4878b0", capsize=2)
+        ax.set_title(titles[k], fontsize=9)
+        ax.set_xlabel("symbol")
+        ax.set_xticks(range(1, 10))
+    axes[0].set_ylabel("posterior probability")
+    axes[0].set_ylim(0, 1.0)
+    # published spot checks (main.Rmd:560): phi_45 on panel 4, phi_25 on 2
+    axes[3].axhline(0.88, ls="--", color="#b04848", lw=1)
+    axes[3].annotate("published 0.88", (0.6, 0.92), fontsize=8, color="#b04848")
+    axes[1].axhline(0.80, ls="--", color="#b04848", lw=1)
+    axes[1].annotate("published 0.80", (0.6, 0.84), fontsize=8, color="#b04848")
+    fig.suptitle(
+        "G.TO emission posterior (real TSX ticks, 2007-05-01..07 in-sample) — "
+        f"replicated phi_45 = {single['replicated']['phi_45']:.3f}, "
+        f"phi_25 = {single['replicated']['phi_25']:.3f}",
+        fontsize=10,
+    )
+    fig.tight_layout()
+    path = os.path.join(OUT, "tayal_phi_posterior.png")
+    fig.savefig(path, dpi=110, bbox_inches="tight")
+    print("wrote", path)
+
+    # --- walk-forward strategy summary ---
+    agg = rep["wf"]["aggregate"]
+    names = ["bnh"] + [f"lag{i}" for i in range(6)]
+    means = [agg[n]["mean_daily_pct"] for n in names]
+    hits = [agg[n]["hit_rate"] for n in names]
+    fig, ax1 = plt.subplots(figsize=(7, 3.6))
+    xs = np.arange(len(names))
+    ax1.bar(xs, means, color=["#777777"] + ["#4878b0"] * 6)
+    ax1.set_xticks(xs)
+    ax1.set_xticklabels(["buy&hold"] + [f"lag {i}" for i in range(6)])
+    ax1.set_ylabel("mean daily return (%)")
+    ax1.axhline(0, color="black", lw=0.8)
+    ax2 = ax1.twinx()
+    ax2.plot(xs, hits, "o-", color="#b04848", ms=4)
+    ax2.set_ylabel("hit rate", color="#b04848")
+    ax2.set_ylim(0, 1)
+    n = rep["wf"]["config"]["n_tasks"]
+    ax1.set_title(
+        f"Walk-forward backtest, 12 TSX tickers x {n // 12} windows "
+        f"({n} trading days; signal at a zig-zag extremum, so lag 0 fills "
+        "at the locally worst price)",
+        fontsize=9,
+    )
+    fig.tight_layout()
+    path = os.path.join(OUT, "tayal_wf_lags.png")
+    fig.savefig(path, dpi=110, bbox_inches="tight")
+    print("wrote", path)
+
+
+if __name__ == "__main__":
+    main()
