@@ -8,6 +8,7 @@ from hhmm_tpu.apps.tayal.features import (
     extract_features,
     to_model_inputs,
     expand_to_ticks,
+    expand_to_ticks_xts,
 )
 from hhmm_tpu.apps.tayal.trading import Trades, topstate_trading, buyandhold, equity_curve
 from hhmm_tpu.apps.tayal.analytics import (
@@ -26,6 +27,7 @@ __all__ = [
     "extract_features",
     "to_model_inputs",
     "expand_to_ticks",
+    "expand_to_ticks_xts",
     "Trades",
     "topstate_trading",
     "buyandhold",

@@ -36,7 +36,13 @@ from hhmm_tpu.apps.tayal.constants import (
     VOLUME_UP,
 )
 
-__all__ = ["ZigZag", "extract_features", "to_model_inputs", "expand_to_ticks"]
+__all__ = [
+    "ZigZag",
+    "extract_features",
+    "to_model_inputs",
+    "expand_to_ticks",
+    "expand_to_ticks_xts",
+]
 
 # (f0, f1, f2) → 1..18 symbol table (`feature-extraction.R:92-110`)
 _LEG_TABLE = {
@@ -196,10 +202,65 @@ def to_model_inputs(feature: np.ndarray, L: int = 9) -> Tuple[np.ndarray, np.nda
 
 
 def expand_to_ticks(values: np.ndarray, zig: ZigZag, T: int) -> np.ndarray:
-    """Broadcast per-leg values back to tick resolution (the reference's
-    ``xts_expand`` left-join + locf, `feature-extraction.R:1-5`)."""
+    """Broadcast per-leg values back to tick resolution by the legs'
+    positional [start, end] ranges — the *clean* reading of the
+    reference's ``xts_expand`` (`feature-extraction.R:1-5`): every tick
+    carries the value of the leg that contains it."""
     values = np.asarray(values)
     out = np.empty((T,) + values.shape[1:], dtype=values.dtype)
     for i in range(len(zig)):
         out[zig.start[i] : zig.end[i] + 1] = values[i]
     return out
+
+
+def expand_to_ticks_xts(
+    values: np.ndarray, zig: ZigZag, t_seconds: np.ndarray
+) -> np.ndarray:
+    """Leg→tick expansion with the reference's *actual* xts semantics
+    (`feature-extraction.R:1-5`): the zig series is stamped at each
+    leg's ending-extremum timestamp, left-joined onto the tick index,
+    then NA-filled backward (``na.locf fromLast``) and forward.
+
+    Two timestamp artifacts distinguish this from :func:`expand_to_ticks`
+    on real tick data (~43% duplicated timestamps on the TSX series):
+
+    - zoo's merge matches duplicate index values PAIRWISE — the k-th
+      tick at timestamp T matches the k-th zig stamp at T. With unique
+      stamps, only the FIRST tick of a same-timestamp burst receives the
+      stamped leg's value; the rest of the burst backward-fills from the
+      NEXT stamp. Regime switches are therefore ADVANCED to just after
+      the first tick of the burst containing the extremum — often
+      before the extremum itself. This is an unintended look-ahead leak
+      in the reference, and it is what makes its published lag-0/1
+      walk-forward returns (`main.pdf` Tables 5-6, 9-20) reachable:
+      with the positional expansion the same decodes lose the bid-ask
+      bounce on every switch (measured ~−7%/day at lag 0 on G.TO
+      2007-05-08 vs published +3.99; this expansion reproduces the
+      published row; see docs/results.md).
+    - ticks of a new leg that still share the previous extremum's
+      timestamp keep the OLD leg's value (switch delay), the mirror
+      image of the same join rule.
+
+    Use this expansion for parity with the reference's backtest tables;
+    use :func:`expand_to_ticks` for artifact-free evaluation.
+    """
+    values = np.asarray(values)
+    t = np.asarray(t_seconds)
+    T = t.shape[0]
+    stamps = t[np.asarray(zig.end)]
+    sidx = np.searchsorted(stamps, t, side="left")
+    sidx2 = np.searchsorted(stamps, t, side="right") - 1
+    # occurrence rank of each tick within its same-timestamp burst
+    first_of_burst = np.concatenate([[True], t[1:] != t[:-1]])
+    burst_id = np.cumsum(first_of_burst) - 1
+    burst_start = np.flatnonzero(first_of_burst)
+    occ = np.arange(T) - burst_start[burst_id]
+    match = sidx + occ  # k-th occurrence pairs with k-th stamp at t[u]
+    exact = (sidx <= sidx2) & (match <= sidx2)
+    # backward fill = value of the next stamped tick at-or-after u
+    out_idx = np.full(T, len(values), dtype=np.int64)
+    out_idx[exact] = match[exact]
+    out_idx = np.minimum.accumulate(out_idx[::-1])[::-1]
+    # forward-fill the tail (ticks after the last stamp keep the last leg)
+    out_idx = np.minimum(out_idx, len(values) - 1)
+    return values[out_idx]

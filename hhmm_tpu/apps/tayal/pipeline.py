@@ -23,6 +23,7 @@ from hhmm_tpu.apps.tayal.analytics import (
 from hhmm_tpu.apps.tayal.features import (
     ZigZag,
     expand_to_ticks,
+    expand_to_ticks_xts,
     extract_features,
     to_model_inputs,
 )
@@ -78,11 +79,23 @@ def label_and_trade(
     leg_state: np.ndarray,
     ins_end_tick: int,
     lags: Sequence[int],
+    t_seconds: Optional[np.ndarray] = None,
+    expansion: Optional[str] = None,
 ) -> LabeledWindow:
     """Bottom states → top states → ex-post bear/bull relabel → tick
     expansion → per-lag OOS trades + buy-and-hold
     (`tayal2009/main.R:157-235`); shared by the single-window pipeline
-    and the walk-forward harness."""
+    and the walk-forward harness.
+
+    ``expansion`` selects the leg→tick broadcast: ``"xts"`` (requires
+    ``t_seconds``) reproduces the reference's timestamp-join semantics —
+    including its duplicate-timestamp look-ahead advance, which the
+    published backtest tables depend on at lags 0-2 — while
+    ``"positional"`` is the artifact-free containing-leg expansion (see
+    :func:`hhmm_tpu.apps.tayal.features.expand_to_ticks_xts`). Default:
+    "xts" when ``t_seconds`` is given, else "positional"."""
+    if expansion is None:
+        expansion = "xts" if t_seconds is not None else "positional"
     price = np.asarray(price)
     leg_top = map_to_topstate(leg_state)
     runs = topstate_runs(leg_top, zig.start, zig.end, price)
@@ -90,7 +103,14 @@ def label_and_trade(
     runs = TopRuns(
         topstate=run_top, start=runs.start, end=runs.end, length=runs.length, ret=runs.ret
     )
-    tick_top = expand_to_ticks(leg_top, zig, len(price))
+    if expansion == "xts":
+        if t_seconds is None:
+            raise ValueError("expansion='xts' requires t_seconds")
+        tick_top = expand_to_ticks_xts(leg_top, zig, t_seconds)
+    elif expansion == "positional":
+        tick_top = expand_to_ticks(leg_top, zig, len(price))
+    else:
+        raise ValueError("expansion must be 'xts' or 'positional'")
     oos = slice(ins_end_tick + 1, len(price))
     return LabeledWindow(
         leg_topstate=leg_top,
@@ -158,7 +178,7 @@ def run_window(
 
     # thin draws for generated quantities (reference computes per draw)
     leg_state = decode_states(model, qs, data)
-    lw = label_and_trade(price, zig, leg_state, ins_end_tick, lags)
+    lw = label_and_trade(price, zig, leg_state, ins_end_tick, lags, t_seconds=t_seconds)
     return TayalWindowResult(
         zig=zig,
         n_ins_legs=n_ins,

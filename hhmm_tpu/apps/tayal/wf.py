@@ -54,6 +54,11 @@ class WFResult:
     n_ins_legs: int
     diverged: float
     swapped: bool
+    n_oos_legs: int = 0
+    oos_leg_switches: int = 0
+    chains_pooled: int = 0
+    run_len_mean: float = 0.0
+    run_len_median: float = 0.0
 
 
 def build_tasks(
@@ -95,6 +100,8 @@ def wf_trade(
     chunk_size: int = 64,
     mesh=None,
     cache_dir: Optional[str] = None,
+    expansion: str = "xts",
+    basin_nats: float = 10.0,
 ) -> List[WFResult]:
     """Run all tasks as one batched fit + per-task host post-processing
     (`wf-trade.R:30-179`, minus the socket cluster).
@@ -102,7 +109,18 @@ def wf_trade(
     ``config`` may be a :class:`SamplerConfig` (NUTS) or a
     :class:`hhmm_tpu.infer.ChEESConfig` (shared-adaptation batch
     sampler, ``num_chains >= 2``) — `fit_batched` dispatches on the
-    type."""
+    type.
+
+    With multiple chains, the per-task decode pools only chains whose
+    mean log-density is within ``basin_nats`` of the task's best chain:
+    real-data posteriors split across ~50-nat non-symmetric basins, and
+    a median filtered-probability over mixed-basin draws flattens into
+    leg-level flicker (the round-2 backtest failure mode; the
+    reference's single Stan chain reports whichever basin it lands in).
+    ``expansion`` follows :func:`hhmm_tpu.apps.tayal.pipeline
+    .label_and_trade` — "xts" reproduces the reference's
+    timestamp-join tick expansion, which its published tables require.
+    """
     if key is None:
         key = jax.random.PRNGKey(0)
 
@@ -192,11 +210,25 @@ def wf_trade(
                 (np.arange(b_oos) < n_oos).astype(np.float32)
             ),
         }
-        padded_state = decode_states(model, qs[i], per_task)
+        # basin selection before the median-α decode: pool only chains
+        # within `basin_nats` of this task's best chain
+        chain_lp = np.asarray(stats["logp"][i]).mean(axis=-1)  # [chains]
+        keep = chain_lp >= chain_lp.max() - basin_nats
+        draws = np.asarray(qs[i])[keep].reshape(-1, qs[i].shape[-1])
+        padded_state = decode_states(model, draws, per_task)
         leg_state = np.concatenate(
             [padded_state[:n_ins], padded_state[b_ins : b_ins + n_oos]]
         )
-        lw = label_and_trade(task.price, zig, leg_state, task.ins_end_tick, lags)
+        lw = label_and_trade(
+            task.price,
+            zig,
+            leg_state,
+            task.ins_end_tick,
+            lags,
+            t_seconds=task.t_seconds,
+            expansion=expansion,
+        )
+        oos_top = lw.leg_topstate[n_ins:]
         results.append(
             WFResult(
                 symbol=task.symbol,
@@ -208,6 +240,11 @@ def wf_trade(
                 n_ins_legs=n_ins,
                 diverged=float(np.asarray(stats["diverging"][i]).mean()),
                 swapped=lw.swapped,
+                n_oos_legs=n_oos,
+                oos_leg_switches=int((oos_top[1:] != oos_top[:-1]).sum()),
+                chains_pooled=int(keep.sum()),
+                run_len_mean=float(np.mean(lw.runs.length)),
+                run_len_median=float(np.median(lw.runs.length)),
             )
         )
     return results

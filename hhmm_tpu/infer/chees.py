@@ -178,6 +178,15 @@ def sample_chees_batched(
         raise ValueError(
             f"init_q has {C} chains per series, config.num_chains={config.num_chains}"
         )
+    traj_cap = getattr(trajectory_fn, "cap", None)
+    if traj_cap is not None and traj_cap < config.max_leapfrogs:
+        # the fused kernel clamps its step count to `cap`; a cap below
+        # the sampler's bound would silently shorten trajectories and
+        # skew the u·traj/eps adaptation statistics
+        raise ValueError(
+            f"trajectory_fn caps leapfrogs at {traj_cap} < "
+            f"config.max_leapfrogs={config.max_leapfrogs}"
+        )
     dtype = init_q.dtype
     if series_weight is None:
         series_weight = jnp.ones((B,), dtype)

@@ -402,16 +402,45 @@ def tayal_trajectory(
     return q1.T[:N], p1.T[:N], lp1[0, :N], g1.T[:N]
 
 
+def trajectory_vmem_bytes(T: int) -> int:
+    """Per-tile VMEM footprint of the fused trajectory kernel: two
+    [T, K, 128] f32 scratches + one [T, 128] f32 scratch + three
+    [T, 128] input tiles (x/sign/mask), ≈ 6.1 KB per time step."""
+    per_step = (2 * _K * _LANES + _LANES) * 4 + 3 * _LANES * 4
+    return T * per_step
+
+
+# leave headroom under the ~16 MB scoped VMEM of a v5e core for q/p/g
+# tiles, temporaries, and compiler slack; beyond this the Mosaic
+# compile fails with an opaque scoped-allocation error
+_VMEM_BUDGET_BYTES = 13 * 1024 * 1024
+
+
 def make_tayal_trajectory(data, cap: int, interpret: bool = False):
     """Build a `trajectory_fn` for `sample_chees_batched`: signature
     ``(inv_mass [B, dim], eps, n_steps, q [B, C, dim], p, logp, grad) ->
     (q, p, logp, grad)``. ``data``: dict with per-series ``x``/``sign``
-    [B, T] (and optional ``mask``) for the stan-gate `TayalHHMM`."""
+    [B, T] (and optional ``mask``) for the stan-gate `TayalHHMM`.
+
+    Raises ``ValueError`` when T exceeds the VMEM budget (the scratch
+    scales linearly with T; ~T > 2200 on a 16 MB-VMEM core) — callers
+    should fall back to the unfused leapfrog path. The returned closure
+    carries ``.cap`` so `sample_chees_batched` can verify the kernel's
+    step bound covers ``config.max_leapfrogs`` (the kernel silently
+    clamps ``n_steps`` to ``cap``, which would otherwise skew ChEES
+    adaptation statistics)."""
     x = jnp.asarray(data["x"])
     sign = jnp.asarray(data["sign"])
     mask = data.get("mask")
     if mask is not None:
         mask = jnp.asarray(mask)
+    need = trajectory_vmem_bytes(int(x.shape[1]))
+    if not interpret and need > _VMEM_BUDGET_BYTES:
+        raise ValueError(
+            f"fused trajectory kernel needs ~{need / 2**20:.1f} MiB VMEM "
+            f"at T={x.shape[1]} (budget {_VMEM_BUDGET_BYTES / 2**20:.0f} "
+            "MiB); use the unfused leapfrog path for long series"
+        )
 
     def trajectory(inv_mass, eps, n_steps, q, p, logp, grad):
         B, C, D = q.shape
@@ -437,4 +466,5 @@ def make_tayal_trajectory(data, cap: int, interpret: bool = False):
             g1.reshape(B, C, D),
         )
 
+    trajectory.cap = cap
     return trajectory

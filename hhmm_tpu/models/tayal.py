@@ -24,8 +24,16 @@ at t=0 the ``log π[j]`` factor, restricted to entry states j∈{2 up, 0
 down}) is applied only when the destination j is sign-consistent;
 inconsistent destinations keep their emission term with a unit
 transition factor. ``gate_mode="hard"`` instead forbids inconsistent
-destinations (−inf emissions) — the clean reading, exact when the sign
-sequence strictly alternates (which zig-zag legs do by construction).
+destinations (−inf emissions) — the clean reading, equivalent only when
+the sign sequence strictly alternates. NOTE: real tick data does NOT
+strictly alternate — a flat stretch restarts a leg in the same
+direction (`feature-extraction.R:27-29`), and ~1/3 of adjacent legs on
+the TSX series share a sign (`tests/test_replication_record.py`). On
+such data the hard gate leaves same-sign steps with no sign-consistent
+path and its filter/FFBS output degrades to normalization noise there;
+use ``gate_mode="stan"`` (the reference's own semantics) for anything
+fit to real ticks, and the hard gate for model-generated data, which
+does alternate by construction of A.
 
 The lite variant (`hhmm-tayal2009-lite.stan:94-158`) adds out-of-sample
 generated quantities: forward filtering + Viterbi on a held-out suffix,

@@ -1,0 +1,151 @@
+"""Regression gates for the real-data Tayal replication path.
+
+These tests run the committed-artifact pipeline (RData load → zig-zag →
+stan-gate decode → xts expansion → trading) on the REAL G.TO tick data
+with the reference's PUBLISHED posterior means (main.pdf Table 8), so
+the evidence behind `results/tayal_replication.json` cannot silently
+rot. No MCMC: a single published-parameter draw decodes in well under a
+second on CPU, keeping this in the `not slow` subset.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+DATA = "/root/reference/tayal2009/data/G.TO"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(DATA), reason="reference tick data not present"
+)
+
+# published posterior means, main.pdf Table 8 (G.TO 2007-05-04..10)
+PUB_PI1 = 0.51
+PUB_A = [[0.46, 0.54], [0.09, 0.91]]
+PUB_PHI = np.array([
+    [0.01, 0.02, 0.01, 0.34, 0.22, 0.35, 0.03, 0.01, 0.02],
+    [0.00, 0.02, 0.00, 0.05, 0.80, 0.02, 0.08, 0.00, 0.02],
+    [0.01, 0.00, 0.03, 0.36, 0.20, 0.39, 0.00, 0.02, 0.00],
+    [0.02, 0.00, 0.06, 0.02, 0.88, 0.01, 0.00, 0.01, 0.00],
+])
+# main.pdf Table 5, row 2007-05-11 (the Rmd window's own OOS day):
+# [buy&hold, lag0..lag5] compound daily returns in percent
+PUB_T5_0511 = [-0.04, 0.18, 0.10, 1.13, -0.50, -0.64, 0.29]
+
+
+@pytest.fixture(scope="module")
+def rmd_window():
+    from hhmm_tpu.apps.rdata import load_tick_days_rdata
+    from hhmm_tpu.apps.tayal.features import extract_features
+
+    days = load_tick_days_rdata(DATA)[3:9]  # 05-04..10 ins, 05-11 oos
+    price = np.concatenate([d["price"] for d in days])
+    size = np.concatenate([d["size"] for d in days])
+    t = np.concatenate([d["t_seconds"] for d in days])
+    ins_end = sum(len(d["price"]) for d in days[:-1]) - 1
+    zig = extract_features(price, size, t, alpha=0.25)
+    return price, size, t, ins_end, zig
+
+
+class TestRmdWindowParity:
+    def test_leg_count_matches_published(self, rmd_window):
+        """main.pdf §3.6.1: 'In-sample dataset reduced to 8386
+        zig-zags' — a bit-level pin of the feature extraction on the
+        real ticks."""
+        price, size, t, ins_end, zig = rmd_window
+        assert int((zig.end <= ins_end).sum()) == 8386
+
+    def test_timestamp_duplication_is_material(self, rmd_window):
+        """~43% of ticks share a timestamp — the xts-join look-ahead
+        artifact is not a corner case on this data."""
+        price, size, t, ins_end, zig = rmd_window
+        frac = 1.0 - len(np.unique(t)) / len(t)
+        assert 0.3 < frac < 0.6
+
+    def test_sign_sequence_does_not_alternate(self, rmd_window):
+        """~1/3 of adjacent legs share a sign (flat-gap legs,
+        `feature-extraction.R:27-29`): the hard gate's strict
+        alternation assumption fails on real ticks, which is why the
+        replication path uses gate_mode='stan'."""
+        price, size, t, ins_end, zig = rmd_window
+        sign = (zig.feature > 9).astype(int)
+        frac = float((sign[1:] == sign[:-1]).mean())
+        assert 0.2 < frac < 0.45
+
+
+class TestPublishedParamsDecode:
+    @pytest.fixture(scope="class")
+    def decoded(self, rmd_window):
+        import jax.numpy as jnp
+        from hhmm_tpu.apps.tayal.features import to_model_inputs
+        from hhmm_tpu.apps.tayal.pipeline import classify_hard, label_and_trade
+        from hhmm_tpu.models import TayalHHMMLite
+
+        price, size, t, ins_end, zig = rmd_window
+        model = TayalHHMMLite(gate_mode="stan")
+        theta = model.pack(
+            {
+                "p_11": jnp.asarray(PUB_PI1),
+                "A_row": jnp.asarray(PUB_A),
+                "phi_k": jnp.asarray(PUB_PHI / PUB_PHI.sum(axis=1, keepdims=True)),
+            }
+        )[None, :]
+        x, sign = to_model_inputs(zig.feature)
+        n_ins = int((zig.end <= ins_end).sum())
+        data = {
+            "x": jnp.asarray(x[:n_ins]),
+            "sign": jnp.asarray(sign[:n_ins]),
+            "x_oos": jnp.asarray(x[n_ins:]),
+            "sign_oos": jnp.asarray(sign[n_ins:]),
+        }
+        gen = model.generated(jnp.asarray(theta), data)
+        leg_state = np.concatenate(
+            [classify_hard(gen["alpha"]), classify_hard(gen["alpha_oos"])]
+        )
+        lags = (0, 1, 2, 3, 4, 5)
+        lw_xts = label_and_trade(
+            price, zig, leg_state, ins_end, lags, t_seconds=t, expansion="xts"
+        )
+        lw_pos = label_and_trade(
+            price, zig, leg_state, ins_end, lags, expansion="positional"
+        )
+        return n_ins, lw_xts, lw_pos
+
+    @staticmethod
+    def _compound_pct(ret):
+        return float((np.prod(1 + ret) - 1) * 100)
+
+    def test_buy_and_hold_matches_published(self, decoded):
+        _, lw, _ = decoded
+        assert abs(self._compound_pct(lw.bnh) - PUB_T5_0511[0]) < 0.05
+
+    def test_oos_switch_rate_band(self, decoded):
+        n_ins, lw, _ = decoded
+        top = lw.leg_topstate[n_ins:]
+        switches = int((top[1:] != top[:-1]).sum())
+        # published-params decode switches every ~2.2 legs (measured
+        # 625 over 1380 OOS legs); a drift out of this band means the
+        # filter or classification changed
+        assert 500 <= switches <= 750
+
+    def test_xts_advance_lifts_low_lags(self, decoded):
+        """The timestamp-join expansion advances entries into the
+        extremum bursts: same signals (equal trade counts), strictly
+        better lag-0 compound return than the positional expansion
+        (measured −0.71% vs −3.39% on 05-11)."""
+        _, lw_xts, lw_pos = decoded
+        assert len(lw_xts.trades[0]) == len(lw_pos.trades[0])
+        lift = self._compound_pct(lw_xts.trades[0].ret) - self._compound_pct(
+            lw_pos.trades[0].ret
+        )
+        assert lift > 1.0
+
+    def test_low_lag_returns_near_published(self, decoded):
+        """With the xts expansion the published-params decode lands
+        within ~1% of the published Table 5 row at every lag (the
+        residual is decode noise: published numbers come from 250
+        posterior draws, this gate uses the posterior mean)."""
+        _, lw, _ = decoded
+        for lag in range(6):
+            got = self._compound_pct(lw.trades[lag].ret)
+            assert abs(got - PUB_T5_0511[1 + lag]) < 1.5, (lag, got)

@@ -10,6 +10,7 @@ from hhmm_tpu.apps.tayal import (
     buyandhold,
     equity_curve,
     expand_to_ticks,
+    expand_to_ticks_xts,
     extract_features,
     map_to_topstate,
     relabel_by_return,
@@ -110,6 +111,86 @@ class TestFeatures:
             np.testing.assert_array_equal(
                 tick_vals[zig.start[i] : zig.end[i] + 1], zig.feature[i]
             )
+
+    @staticmethod
+    def _xts_expand_oracle(values, zig, t):
+        """Literal transliteration of the reference's ``xts_expand``
+        (`feature-extraction.R:1-5`): zig stamped at leg-end timestamps,
+        zoo left-join with PAIRWISE duplicate matching (k-th tick at a
+        timestamp matches the k-th stamp at it), na.locf backward then
+        forward."""
+        stamps = list(t[np.asarray(zig.end)])
+        out = [None] * len(t)
+        used = {}
+        for u in range(len(t)):
+            k = used.get(t[u], 0)
+            # find the k-th stamp equal to t[u]
+            seen = 0
+            for m, s in enumerate(stamps):
+                if s == t[u]:
+                    if seen == k:
+                        out[u] = values[m]
+                        used[t[u]] = k + 1
+                        break
+                    seen += 1
+        nxt = None
+        for u in range(len(t) - 1, -1, -1):
+            if out[u] is not None:
+                nxt = out[u]
+            elif nxt is not None:
+                out[u] = nxt
+        prev = None
+        for u in range(len(t)):
+            if out[u] is not None:
+                prev = out[u]
+            elif prev is not None:
+                out[u] = prev
+        return np.array(out)
+
+    def test_expand_xts_equals_positional_without_duplicates(self):
+        price, size, t, _ = self._ticks(5)
+        zig = extract_features(price, size, t)
+        assert len(np.unique(t)) == len(t)
+        np.testing.assert_array_equal(
+            expand_to_ticks_xts(zig.feature, zig, t),
+            expand_to_ticks(zig.feature, zig, len(price)),
+        )
+
+    def test_expand_xts_matches_join_oracle_with_duplicates(self):
+        price, size, t, _ = self._ticks(6, n_legs=60)
+        # coarsen timestamps so ~half the ticks share a second, like the
+        # real TSX series (~43% duplicated stamps)
+        t = np.floor(t / 40.0) * 40.0
+        zig = extract_features(price, size, t)
+        got = expand_to_ticks_xts(zig.feature, zig, t)
+        want = self._xts_expand_oracle(zig.feature, zig, t)
+        np.testing.assert_array_equal(got, want)
+
+    def test_expand_xts_advances_switch_into_burst(self):
+        """A same-timestamp burst that spans a leg's ending extremum
+        advances the next leg's values to just after the burst's first
+        tick — the reference's look-ahead leak (main.pdf Tables 5/6
+        depend on it at low lags; see docs/results.md)."""
+        # zig-zag between 10 and 12: legs [0..2], [3..4], [5..6], ...
+        price = np.array([10.0, 11.0, 12.0] + [11.0, 10.0, 11.0, 12.0] * 3 + [11.0, 10.0])
+        size = np.ones_like(price)
+        # ticks 1 and 2 share a timestamp: the burst contains the first
+        # leg's ending extremum (tick 2)
+        t = np.concatenate([[0.0, 1.0, 1.0], np.arange(2.0, len(price) - 1)])
+        zig = extract_features(price, size, t)
+        # leg 0 is the flat opening tick; leg 1 = [1..2] ends at the max
+        np.testing.assert_array_equal(zig.start[:3], [0, 1, 3])
+        np.testing.assert_array_equal(zig.end[:3], [0, 2, 4])
+        vals = 10 * (1 + np.arange(len(zig)))
+        pos = expand_to_ticks(vals, zig, len(price))
+        xts = expand_to_ticks_xts(vals, zig, t)
+        # leg 1's stamp (t=1.0 at its extremum tick 2) matches the FIRST
+        # tick of the burst (tick 1); tick 2 backward-fills from the
+        # NEXT stamp → the switch to leg 2's value lands one tick early
+        np.testing.assert_array_equal(pos[:5], [10, 20, 20, 30, 30])
+        np.testing.assert_array_equal(xts[:5], [10, 20, 30, 30, 30])
+        # away from the burst the two expansions agree
+        np.testing.assert_array_equal(pos[5:], xts[5:])
 
 
 class TestTrading:
