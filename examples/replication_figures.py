@@ -9,7 +9,11 @@ Reads ``results/tayal_replication.json`` (no TPU needed) and renders:
 - ``tayal_wf_lags.png`` — mean daily return and hit rate per strategy
   (buy-and-hold + lags 0..5) over the 204-window backtest, the summary
   view of the reference's 1,428-return appendix table
-  (`tayal2009/Rmd/appendix-wf.Rmd`).
+  (`tayal2009/Rmd/appendix-wf.Rmd`);
+- ``docs/appendix-wf.md`` + ``appendix_equity_<SYM>.png`` — the
+  per-stock appendix layer (`tayal2009/Rmd/appendix-wf.Rmd`, main.pdf
+  §5.2): per-day compound-return tables and equity lines for all 12
+  tickers, with the published per-stock Total row for comparison.
 
 Run: ``python examples/replication_figures.py`` (writes docs/figures).
 """
@@ -23,6 +27,9 @@ import sys
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+# sibling driver (for the published-table constants), importable even
+# when this module is imported from outside examples/ (tests)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 RESULTS = os.path.join(ROOT, "results", "tayal_replication.json")
@@ -100,6 +107,92 @@ def main():
     path = os.path.join(OUT, "tayal_wf_lags.png")
     fig.savefig(path, dpi=110, bbox_inches="tight")
     print("wrote", path)
+
+    appendix(rep, plt)
+
+
+def appendix(rep, plt):
+    """Per-stock appendix (`tayal2009/Rmd/appendix-wf.Rmd`, main.pdf
+    §5.2): one per-day return table + one equity-line figure per
+    ticker, generated from the committed ``wf.per_window`` artifact."""
+    from tayal_replication import PUBLISHED_T5_DAYS
+
+    wf = rep["wf"]
+    rows = wf["per_window"]
+    lags = sorted(
+        int(k[3:-4]) for k in rows[0] if k.startswith("lag") and k.endswith("_pct")
+        and "_sum" not in k and "_trades" not in k
+    )
+    names = ["bnh"] + [f"lag{lag}" for lag in lags]
+    labels = ["buy&hold"] + [f"lag {lag}" for lag in lags]
+    stock_pub = wf.get("stock_totals_vs_published", {})
+    symbols = sorted({r["symbol"] for r in rows})
+
+    md = [
+        "# Appendix — per-stock walk-forward results",
+        "",
+        "Analog of the reference's `tayal2009/Rmd/appendix-wf.Rmd` "
+        "(rendered as main.pdf §5.2): per-day compound returns (%) of "
+        "buy-and-hold and the lag-0..5 top-state strategies, one table "
+        "and equity line per ticker, from the committed "
+        "`results/tayal_replication.json` `wf.per_window` record "
+        f"({len(rows)} windows, {wf['config']['n_returns']} returns). "
+        "`Total` compounds the daily returns; `Published total` is the "
+        "reference's per-stock Total row (main.pdf Tables 9-20, as "
+        "fractions). Generated by `examples/replication_figures.py`.",
+        "",
+    ]
+    for sym in symbols:
+        srows = sorted((r for r in rows if r["symbol"] == sym), key=lambda r: r["window"])
+        full_cal = len(srows) == len(PUBLISHED_T5_DAYS)
+        md += [f"## {sym}", ""]
+        md.append("| day | " + " | ".join(labels) + " |")
+        md.append("|---|" + "---|" * len(labels))
+        series = {n: [] for n in names}
+        for r in srows:
+            day = PUBLISHED_T5_DAYS[r["window"]] if full_cal else f"w{r['window']}"
+            vals = [r["bnh_pct"]] + [r[f"lag{lag}_pct"] for lag in lags]
+            for n, v in zip(names, vals):
+                series[n].append(v)
+            md.append(
+                f"| {day} | " + " | ".join(f"{v:.2f}" for v in vals) + " |"
+            )
+        totals = [
+            float(np.prod(1 + np.array(series[n]) / 100) - 1) for n in names
+        ]
+        md.append(
+            "| **Total %** | "
+            + " | ".join(f"{v * 100:.1f}" for v in totals) + " |"
+        )
+        pub = stock_pub.get(sym, {}).get("published_total")
+        if pub:  # published rows are fractions — render in % too
+            md.append(
+                "| **Published total %** | "
+                + " | ".join(f"{v * 100:.1f}" for v in pub) + " |"
+            )
+        md += ["", f"![{sym} equity](figures/appendix_equity_{sym}.png)", ""]
+
+        fig, ax = plt.subplots(figsize=(7, 3.2))
+        xs = np.arange(len(srows) + 1)
+        for n, lab in zip(names, labels):
+            eq = np.concatenate([[1.0], np.cumprod(1 + np.array(series[n]) / 100)])
+            kw = {"color": "#777777", "lw": 2} if n == "bnh" else {"lw": 1}
+            ax.plot(xs, eq, label=lab, **kw)
+        ax.set_title(f"{sym} — walk-forward equity (per-day compounding)", fontsize=9)
+        ax.set_xlabel("trading day")
+        ax.set_ylabel("equity (x initial)")
+        ax.axhline(1.0, color="black", lw=0.6)
+        ax.legend(fontsize=7, ncol=4)
+        fig.tight_layout()
+        path = os.path.join(OUT, f"appendix_equity_{sym}.png")
+        fig.savefig(path, dpi=110, bbox_inches="tight")
+        plt.close(fig)
+        print("wrote", path)
+
+    apx = os.path.join(ROOT, "docs", "appendix-wf.md")
+    with open(apx, "w") as f:
+        f.write("\n".join(md))
+    print("wrote", apx)
 
 
 if __name__ == "__main__":

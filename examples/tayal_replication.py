@@ -103,6 +103,33 @@ def _toronto(y, m, d, hh, mm):
     )
 
 
+def _load_days_cached(path: str, cache_root: str | None):
+    """Per-symbol tick-array cache: the RDX2/XDR parse of 22 day files
+    per symbol costs minutes per run, which matters because device-
+    tunnel sessions die after ~10 minutes and the wf driver resumes
+    itself from the chunk cache — the reload must be cheap."""
+    from hhmm_tpu.apps.rdata import load_tick_days_rdata
+
+    keys = ("price", "size", "t_seconds")
+    if cache_root:
+        f = os.path.join(cache_root, f"ticks_{os.path.basename(path)}.npz")
+        if os.path.exists(f):
+            z = np.load(f)
+            return [
+                {k: z[f"{k}_{i}"] for k in keys}
+                for i in range(int(z["n_days"]))
+            ]
+    days = load_tick_days_rdata(path)
+    if cache_root:
+        os.makedirs(cache_root, exist_ok=True)
+        np.savez(
+            f,
+            n_days=len(days),
+            **{f"{k}_{i}": d[k] for i, d in enumerate(days) for k in keys},
+        )
+    return days
+
+
 def _phi_draws(model, samples: np.ndarray) -> np.ndarray:
     """Posterior draws of the emission matrix, [draws, K, L]."""
     import jax
@@ -120,13 +147,15 @@ def _phi_draws(model, samples: np.ndarray) -> np.ndarray:
 _PAIR_SWAP = np.array([3, 2, 1, 0])
 
 
-def _canonical_phi_per_chain(model, res, price, zig) -> Dict:
-    """Pool emission draws across chains AFTER per-chain ex-post
-    relabeling: the pair-swap symmetry (p11 <-> 1-p11 etc.) is a true
-    posterior mode pair, and chains land in either mode — averaging raw
-    draws across chains mixes the modes and shrinks φ̂ toward 0.5. The
-    reference relabels its single chain by mean-return ordering
-    (`tayal2009/main.R:176-184`); we apply that rule chain-wise."""
+def _relabeled_phis(model, res, price, zig):
+    """Per-chain ex-post relabeling: the pair-swap symmetry (p11 <->
+    1-p11 etc.) is a true posterior mode pair, and chains land in either
+    mode — averaging raw draws across chains mixes the modes and shrinks
+    φ̂ toward 0.5. The reference relabels its single chain by mean-return
+    ordering (`tayal2009/main.R:176-184`); we apply that rule chain-wise.
+    Returns ``(phis [C][draws,4,9], per_chain meta, chain_lp [C])``;
+    basin selection is the caller's job (it may pool chains across
+    independent restarts)."""
     from hhmm_tpu.apps.tayal.analytics import (
         map_to_topstate,
         relabel_by_return,
@@ -162,15 +191,39 @@ def _canonical_phi_per_chain(model, res, price, zig) -> Dict:
              "phi_25": float(phi_c[:, 1, 4].mean()),
              "mean_logp": float(chain_lp[c])}
         )
-    # mode selection: the posterior is multimodal beyond the exact pair
-    # symmetry (minor modes swap emission structure within a pair);
-    # chains stuck in dominated modes would bias the pooled estimate, so
-    # pool only chains whose mean log-density reaches the best chain's
-    # (within a few nats — the reference's single Stan chain reports the
-    # dominant mode it lands in)
-    keep = chain_lp >= chain_lp.max() - 10.0
+    return phis, per_chain, chain_lp
+
+
+def _pool_dominant_basin(phis, per_chain, chain_lp, nats: float = 10.0) -> Dict:
+    """Mode selection: the posterior is multimodal beyond the exact pair
+    symmetry (minor modes swap emission structure within a pair); chains
+    stuck in dominated modes would bias the pooled estimate, so pool
+    only chains whose mean log-density reaches the best chain's (within
+    a few nats — the reference's single Stan chain reports the dominant
+    mode it lands in). ``phis``/``per_chain``/``chain_lp`` may span
+    several independent restarts (ChEES shares adaptation within a run,
+    so basin DIVERSITY comes from restarts, not from more chains)."""
+    chain_lp = np.asarray(chain_lp)
+    keep = chain_lp >= chain_lp.max() - nats
     phi = np.concatenate([p for p, k in zip(phis, keep) if k])
-    return {"phi": phi, "per_chain": per_chain,
+    # mode-family statistics across ALL chains: the real-data posterior
+    # is rugged (chain-level φ̂₄₅ spans ~0.55-0.94 at comparable
+    # density), so alongside the dominant-basin pool we report the full
+    # chain-level distribution of the two published spot-check
+    # coordinates — the honest context for a single-chain published
+    # value (the reference's φ̂ is one Stan chain's mode)
+    p45 = np.array([pc["phi_45"] for pc in per_chain])
+    p25 = np.array([pc["phi_25"] for pc in per_chain])
+    family = {
+        "n_chains": int(len(per_chain)),
+        "phi_45_mean": float(p45.mean()), "phi_45_sd": float(p45.std()),
+        "phi_45_q10_q90": [float(np.quantile(p45, 0.1)), float(np.quantile(p45, 0.9))],
+        "phi_25_mean": float(p25.mean()), "phi_25_sd": float(p25.std()),
+        "phi_25_q10_q90": [float(np.quantile(p25, 0.1)), float(np.quantile(p25, 0.9))],
+        "frac_phi45_ge_0p8": float((p45 >= 0.8).mean()),
+        "lp_range_nats": [float(chain_lp.min()), float(chain_lp.max())],
+    }
+    return {"phi": phi, "per_chain": per_chain, "mode_family": family,
             "chains_pooled": int(keep.sum()), "chain_mean_logp": chain_lp.tolist()}
 
 
@@ -235,17 +288,28 @@ def run_single(args) -> Dict:
     ins_end = int(np.searchsorted(t, _toronto(*ins_end_t, 16, 30), "right")) - 1
 
     cfg = _sampler_config(args)
-    res = run_window(
-        price, size, t, ins_end, config=cfg, key=jax.random.PRNGKey(args.seed)
-    )
     from hhmm_tpu.models import TayalHHMMLite
 
-    canon = _canonical_phi_per_chain(TayalHHMMLite(), res, price, res.zig)
+    phis, per_chain, lps = [], [], []
+    res = None
+    for rs in range(max(1, args.restarts)):
+        res_r = run_window(
+            price, size, t, ins_end, config=cfg,
+            key=jax.random.PRNGKey(args.seed + rs),
+        )
+        p_r, pc_r, lp_r = _relabeled_phis(TayalHHMMLite(), res_r, price, res_r.zig)
+        phis += p_r
+        per_chain += [{**pc, "restart": rs} for pc in pc_r]
+        lps += lp_r.tolist()
+        if res is None or lp_r.max() >= max(lps):
+            res = res_r  # keep the restart holding the best chain
+    canon = _pool_dominant_basin(phis, per_chain, lps)
     phi = canon["phi"]
     checks = spot_checks(phi.mean(axis=0))
     checks["per_chain"] = canon["per_chain"]
     checks["chains_pooled"] = canon["chains_pooled"]
     checks["chain_mean_logp"] = canon["chain_mean_logp"]
+    checks["mode_family"] = canon["mode_family"]
     out = {
         "config": {
             "ticker": "G.TO",
@@ -257,6 +321,7 @@ def run_single(args) -> Dict:
             "warmup": args.warmup,
             "samples": args.samples,
             "chains": args.chains,
+            "restarts": max(1, args.restarts),
             "sampler": args.sampler,
             "seed": args.seed,
         },
@@ -281,7 +346,6 @@ def run_single(args) -> Dict:
 
 def run_wf(args) -> Dict:
     import jax
-    from hhmm_tpu.apps.rdata import load_tick_days_rdata
     from hhmm_tpu.apps.tayal.wf import build_tasks, wf_trade
 
     symbols = sorted(
@@ -291,7 +355,7 @@ def run_wf(args) -> Dict:
     if args.symbols:
         symbols = [s for s in symbols if s in args.symbols.split(",")]
     days = {
-        sym: load_tick_days_rdata(os.path.join(DATA_ROOT, sym))
+        sym: _load_days_cached(os.path.join(DATA_ROOT, sym), args.cache_dir)
         for sym in symbols
     }
     tasks = build_tasks(days, train_days=5, trade_days=1)
@@ -444,6 +508,15 @@ def main():
     ap.add_argument("--chunk", type=int, default=64)
     ap.add_argument("--symbols", type=str, default="")
     ap.add_argument("--window", choices=["rmd", "mainr"], default="rmd")
+    ap.add_argument(
+        "--restarts",
+        type=int,
+        default=1,
+        help="single stage: independent fit restarts (fresh adaptation "
+        "per restart) pooled by dominant basin across ALL chains — "
+        "ChEES shares step-size/trajectory adaptation within a run, so "
+        "basin diversity comes from restarts, not from more chains",
+    )
     ap.add_argument("--max-tasks", type=int, default=0)
     ap.add_argument("--cache-dir", type=str, default=None)
     ap.add_argument("--out", type=str, default=None)
@@ -457,6 +530,17 @@ def main():
             "remains available for synthetic model-generated data via "
             "hhmm_tpu.apps.tayal.wf.wf_trade directly."
         )
+
+    if args.cache_dir:
+        # persistent XLA compilation cache: tunnel sessions die ~10 min
+        # after connect, so resumed runs must not re-pay multi-minute
+        # compiles on every relaunch
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir", os.path.join(args.cache_dir, "xla_cache")
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
 
     out = run_single(args) if args.stage == "single" else run_wf(args)
     os.makedirs(RESULTS, exist_ok=True)

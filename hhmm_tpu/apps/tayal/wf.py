@@ -159,30 +159,57 @@ def wf_trade(
             }
         )
 
-    padded_ins = pad_datasets(
-        [{"x": d["x"], "sign": d["sign"]} for d in datasets], time_keys=["x", "sign"]
-    )
-    padded_oos = pad_datasets(
-        [{"x_oos": d["x_oos"], "sign_oos": d["sign_oos"]} for d in datasets],
-        time_keys=["x_oos", "sign_oos"],
-    )
-    data = {
-        "x": padded_ins["x"],
-        "sign": padded_ins["sign"],
-        "mask": padded_ins["mask"],
-        "x_oos": padded_oos["x_oos"],
-        "sign_oos": padded_oos["sign_oos"],
-        "mask_oos": padded_oos["mask"],
-    }
-    qs, stats = fit_batched(
-        model,
-        data,
-        key,
-        config,
-        chunk_size=chunk_size,
-        mesh=mesh,
-        cache_dir=cache_dir,
-    )
+    # Fit in LENGTH-SORTED groups, each padded to a 1024-multiple
+    # bucket, instead of one global pad: window lengths vary ~10x
+    # across symbols (973..10725 legs), so a global pad makes every
+    # dispatch pay the longest window's sequential scan — and a stiff
+    # chunk at full padding can exceed the device tunnel's per-
+    # execution watchdog (the ChEES leapfrog count is adaptive, so a
+    # hard posterior runs the full cap every transition). Sorting packs
+    # similar lengths together; buckets keep the compile count small.
+    # Only in-sample arrays go to the fit — the OOS suffix enters in
+    # the per-task decode below.
+    B = len(datasets)
+    n_lens = [len(d["x"]) for d in datasets]
+    order = np.argsort(n_lens, kind="stable")
+    groups = [order[i : i + chunk_size] for i in range(0, B, chunk_size)]
+    qs_list: List[Optional[np.ndarray]] = [None] * B
+    logp_list: List[Optional[np.ndarray]] = [None] * B
+    div_list: List[Optional[np.ndarray]] = [None] * B
+    for gi, g in enumerate(groups):
+        # mesh sharding needs a device-divisible batch: repeat-pad the
+        # ragged final group (same semantics as fit_batched's internal
+        # ragged-chunk padding) and drop the extras when scattering back
+        g_fit = g
+        if mesh is not None:
+            n_dev = mesh.shape["series"]
+            rem = len(g) % n_dev
+            if rem:
+                g_fit = np.concatenate([g, np.repeat(g[-1:], n_dev - rem)])
+        padded = pad_datasets(
+            [{"x": datasets[j]["x"], "sign": datasets[j]["sign"]} for j in g_fit],
+            time_keys=["x", "sign"],
+        )
+        T_g = padded["x"].shape[1]
+        bucket = max(1024, -(-T_g // 1024) * 1024)
+        if bucket > T_g:
+            pad_w = ((0, 0), (0, bucket - T_g))
+            padded = {k: np.pad(v, pad_w) for k, v in padded.items()}
+        qs_g, stats_g = fit_batched(
+            model,
+            padded,
+            jax.random.fold_in(key, gi),
+            config,
+            chunk_size=len(g_fit),
+            mesh=mesh,
+            cache_dir=cache_dir,
+        )
+        for li, j in enumerate(g):
+            qs_list[j] = np.asarray(qs_g[li])
+            logp_list[j] = np.asarray(stats_g["logp"][li])
+            div_list[j] = np.asarray(stats_g["diverging"][li])
+    qs = qs_list
+    stats = {"logp": logp_list, "diverging": div_list}
 
     def _bucket(n: int) -> int:
         """Next power of two >= max(n, 1024): per-task decode shapes
@@ -215,10 +242,31 @@ def wf_trade(
         chain_lp = np.asarray(stats["logp"][i]).mean(axis=-1)  # [chains]
         keep = chain_lp >= chain_lp.max() - basin_nats
         draws = np.asarray(qs[i])[keep].reshape(-1, qs[i].shape[-1])
-        padded_state = decode_states(model, draws, per_task)
-        leg_state = np.concatenate(
-            [padded_state[:n_ins], padded_state[b_ins : b_ins + n_oos]]
-        )
+        # decode cache: same restartability contract as the fit chunks
+        # (`wf-trade.R:86-109`) — a dropped device session mid-decode
+        # resumes instead of recomputing every window
+        leg_state = None
+        dk = None
+        if cache_dir is not None:
+            from hhmm_tpu.batch.cache import ResultCache, digest_key
+
+            dcache = ResultCache(cache_dir)
+            dk = digest_key(
+                {"stage": "wf-decode-v1", "gate_mode": gate_mode},
+                {"x": x, "sign": sign},
+                {"n_ins": n_ins},
+                draws,
+            )
+            hit = dcache.get(dk)
+            if hit is not None:
+                leg_state = np.asarray(hit["leg_state"])
+        if leg_state is None:
+            padded_state = decode_states(model, draws, per_task)
+            leg_state = np.concatenate(
+                [padded_state[:n_ins], padded_state[b_ins : b_ins + n_oos]]
+            )
+            if dk is not None:
+                dcache.put(dk, {"leg_state": np.asarray(leg_state)})
         lw = label_and_trade(
             task.price,
             zig,

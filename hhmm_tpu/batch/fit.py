@@ -37,6 +37,9 @@ from hhmm_tpu.infer.run import SamplerConfig
 
 __all__ = ["default_init", "fit_batched"]
 
+# base backoff between chunk retries on device faults (tests zero this)
+_RETRY_SLEEP_S = 15.0
+
 
 def _model_fingerprint(model) -> Dict[str, Any]:
     """Stable identity of a model instance for cache keys. Array-valued
@@ -111,7 +114,27 @@ def fit_batched(
     B = sizes.pop()
     C = config.num_chains
     if init is None:
-        init = default_init(model, data, B, C, key)
+        if cache_dir is None:
+            init = default_init(model, data, B, C, key)
+        else:
+            # data-driven inits (k-means etc.) cost minutes of host time
+            # at hundreds of series x chains — cache them with the same
+            # digest discipline as the fit chunks so resumed sweeps
+            # skip the work
+            icache = ResultCache(cache_dir)
+            ik = digest_key(
+                _model_fingerprint(model),
+                {k: np.asarray(v) for k, v in data.items()},
+                {"B": B, "C": C},
+                np.asarray(key),
+                "stage=init-v1",
+            )
+            hit = icache.get(ik)
+            if hit is not None:
+                init = hit["init"]
+            else:
+                init = default_init(model, data, B, C, key)
+                icache.put(ik, {"init": np.asarray(init)})
     init = jnp.asarray(init)
     if init.shape[:2] != (B, C):
         raise ValueError(f"init must be [B={B}, chains={C}, dim], got {init.shape}")
@@ -204,6 +227,9 @@ def fit_batched(
             {k: np.asarray(v) for k, v in chunk_data.items()},
             vars(config),
             np.asarray(chunk_keys),
+            # inits determine the draws: without them in the key, two
+            # warm starts over the same data alias to one cache entry
+            np.asarray(chunk_init),
             # v2: the _da_init log_eps_bar fix (infer/run.py) changed
             # short-warmup draws for both HMC samplers
             (
@@ -219,9 +245,28 @@ def fit_batched(
         if hit is not None:
             qs = jnp.asarray(hit.pop("samples"))
             stats = {k: jnp.asarray(v) for k, v in hit.items()}
+            print(f"# fit_batched chunk {s//chunk + 1}/{-(-B//chunk)}: cache hit", flush=True)
         else:
-            qs, stats = jax.block_until_ready(run(chunk_data, chunk_init, chunk_keys, chunk_w))
+            # bounded retry on device faults: the tunnel occasionally
+            # drops an execution mid-sweep (UNAVAILABLE); together with
+            # the digest cache this gives the reference's crash-recovery
+            # semantics (`wf-trade.R:86-109`) without losing the sweep
+            for attempt in range(4):
+                try:
+                    qs, stats = jax.block_until_ready(
+                        run(chunk_data, chunk_init, chunk_keys, chunk_w)
+                    )
+                    break
+                except Exception as e:  # UNAVAILABLE surfaces as
+                    # JaxRuntimeError OR ValueError depending on where
+                    # in the dispatch the fault lands
+                    if "UNAVAILABLE" not in repr(e) or attempt == 3:
+                        raise
+                    import time as _time
+
+                    _time.sleep(_RETRY_SLEEP_S * (attempt + 1))
             cache.put(ck, {"samples": np.asarray(qs), **{k: np.asarray(v) for k, v in stats.items()}})
+            print(f"# fit_batched chunk {s//chunk + 1}/{-(-B//chunk)}: computed + cached", flush=True)
         qs_parts.append(qs[:n])
         stats_parts.append({k: v[:n] for k, v in stats.items()})
 

@@ -106,7 +106,8 @@ class TestFitBatched:
         qs1, _ = fit_batched(*args, chunk_size=2, cache_dir=str(tmp_path))
         n_files = len(list(tmp_path.glob("*.npz")))
         qs2, _ = fit_batched(*args, chunk_size=2, cache_dir=str(tmp_path))
-        assert n_files == len(list(tmp_path.glob("*.npz"))) == 1
+        # one fit-chunk entry + one init entry, both reused on rerun
+        assert n_files == len(list(tmp_path.glob("*.npz"))) == 2
         np.testing.assert_array_equal(np.asarray(qs1), np.asarray(qs2))
 
     def test_padding_invariance(self):
@@ -180,3 +181,52 @@ class TestFitBatched:
         )
         qs, _ = fit_batched(model, {"x": x[None]}, jax.random.PRNGKey(0), CFG, init=init)
         assert qs.shape[:2] == (1, 2)
+
+
+class TestChunkRetry:
+    def test_unavailable_retries_then_succeeds(self, tmp_path, monkeypatch):
+        """Device faults (UNAVAILABLE — the tunnel drops executions
+        mid-sweep) are retried per chunk instead of killing the sweep;
+        non-UNAVAILABLE errors propagate immediately."""
+        import hhmm_tpu.batch.fit as fit_mod
+
+        B, T = 2, 120
+        xs = np.stack([_series(jax.random.PRNGKey(i), T) for i in range(B)])
+        model = GaussianHMM(K=2)
+        cfg = SamplerConfig(num_warmup=30, num_samples=20, num_chains=1, max_treedepth=4)
+
+        real_block = fit_mod.jax.block_until_ready
+        fails = {"n": 2}
+
+        def flaky(x):
+            if fails["n"] > 0:
+                fails["n"] -= 1
+                raise ValueError("UNAVAILABLE: TPU device error (injected)")
+            return real_block(x)
+
+        monkeypatch.setattr(fit_mod.jax, "block_until_ready", flaky)
+        monkeypatch.setattr(fit_mod, "_RETRY_SLEEP_S", 0.0, raising=False)
+        qs, _ = fit_batched(
+            model, {"x": xs}, jax.random.PRNGKey(0), cfg,
+            chunk_size=2, cache_dir=str(tmp_path),
+        )
+        assert fails["n"] == 0
+        assert qs.shape[0] == B
+
+    def test_other_errors_propagate(self, tmp_path, monkeypatch):
+        import hhmm_tpu.batch.fit as fit_mod
+
+        B, T = 2, 120
+        xs = np.stack([_series(jax.random.PRNGKey(i), T) for i in range(B)])
+        model = GaussianHMM(K=2)
+        cfg = SamplerConfig(num_warmup=30, num_samples=20, num_chains=1, max_treedepth=4)
+
+        def broken(x):
+            raise RuntimeError("INTERNAL: something else")
+
+        monkeypatch.setattr(fit_mod.jax, "block_until_ready", broken)
+        with pytest.raises(RuntimeError, match="INTERNAL"):
+            fit_batched(
+                model, {"x": xs}, jax.random.PRNGKey(0), cfg,
+                chunk_size=2, cache_dir=str(tmp_path),
+            )

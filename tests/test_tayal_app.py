@@ -309,3 +309,24 @@ class TestPipeline:
         np.testing.assert_array_equal(
             results[0].leg_topstate, results2[0].leg_topstate
         )
+
+    def test_walk_forward_mesh_ragged(self, tayal_wf_tasks):
+        """Length-sorted group fitting under a series mesh: the ragged
+        final group must be repeat-padded to a device-divisible batch
+        (round-3 regression: chunk % mesh series axis)."""
+        import jax
+        from jax.sharding import Mesh
+
+        from hhmm_tpu.infer import SamplerConfig
+
+        tasks = tayal_wf_tasks[:3]  # groups of 2 + 1 -> ragged final
+        mesh = Mesh(np.array(jax.devices()[:2]), ("series",))
+        results = wf_trade(
+            tasks,
+            config=SamplerConfig(num_warmup=40, num_samples=40, num_chains=1,
+                                 max_treedepth=5),
+            chunk_size=2,
+            mesh=mesh,
+        )
+        assert len(results) == 3
+        assert all(np.isfinite(r.bnh).all() for r in results)
