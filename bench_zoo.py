@@ -34,8 +34,9 @@ import jax
 import jax.numpy as jnp
 
 
-def _time_fit(model, data, config, key):
+def _time_fit(model, data, config, key, fused_traj=False):
     from hhmm_tpu.infer import ChEESConfig, GibbsConfig, sample_chees, sample_gibbs, sample_nuts
+    from hhmm_tpu.infer.diagnostics import ess
 
     np_data = {k: np.asarray(v) for k, v in data.items()}
     data = {k: jnp.asarray(v) for k, v in data.items()}
@@ -57,17 +58,43 @@ def _time_fit(model, data, config, key):
         # cross-chain criterion replaces NUTS's per-transition trees
         from hhmm_tpu.batch import default_init
 
-        vg = model.make_vg(data)
-        theta0 = default_init(
+        theta0_b = default_init(
             model,
             {k: v[None] for k, v in np_data.items()},
             1,
             config.num_chains,
             jax.random.PRNGKey(7),
-        )[0]
+        )
+        if fused_traj:
+            # whole-trajectory Pallas kernel (kernels/pallas_traj.py)
+            # run as a B=1 batch — VERDICT r2 #4: the single-fit path
+            # gets the same fused hot loop as the batched bench
+            from hhmm_tpu.infer import make_lp_bc, sample_chees_batched
+            from hhmm_tpu.kernels.pallas_traj import make_tayal_trajectory
 
-        def run(key):
-            return sample_chees(None, key, theta0, config, jit=False, vg_fn=vg)
+            data_b = {k: v[None] for k, v in data.items()}
+            traj = make_tayal_trajectory(data_b, cap=config.max_leapfrogs)
+            lp_bc = make_lp_bc(model, data_b)
+            probe = model.make_vg(data)
+
+            def run(key):
+                qs, stats = sample_chees_batched(
+                    lp_bc, key, theta0_b, config, jit=False,
+                    probe_vg=probe, trajectory_fn=traj,
+                )
+                # keep only the per-series stats _time_fit reads
+                # (inv_mass has no leading batch axis)
+                return qs[0], {
+                    "diverging": stats["diverging"][0],
+                    "logp": stats["logp"][0],
+                }
+
+        else:
+            vg = model.make_vg(data)
+            theta0 = theta0_b[0]
+
+            def run(key):
+                return sample_chees(None, key, theta0, config, jit=False, vg_fn=vg)
 
     else:
         vg = model.make_vg(data)
@@ -82,7 +109,9 @@ def _time_fit(model, data, config, key):
     _, stats = jax.block_until_ready(runj(key))
     dt = time.time() - t0
     div = float(np.asarray(stats["diverging"]).mean())
-    return dt, div
+    lp = np.asarray(stats["logp"])
+    ess_lp = float(ess(lp.reshape(-1, lp.shape[-1])))
+    return dt, div, ess_lp
 
 
 def bench_hmm(cfg):
@@ -103,8 +132,8 @@ def bench_hmm(cfg):
         if isinstance(cfg, GibbsConfig)
         else GaussianHMM(K=K)
     )
-    dt, div = _time_fit(model, {"x": x}, cfg, jax.random.PRNGKey(1))
-    return "gaussian_hmm_fit", dt, div, 300.0  # ≈5-min CPU budget class
+    dt, div, ess_lp = _time_fit(model, {"x": x}, cfg, jax.random.PRNGKey(1))
+    return "gaussian_hmm_fit", dt, div, ess_lp, 300.0  # ≈5-min CPU budget class
 
 
 def bench_iohmm(cfg):
@@ -117,10 +146,10 @@ def bench_iohmm(cfg):
     w = rng.normal(size=(K, M)) * 1.5
     b = rng.normal(size=(K, M))
     sim = iohmm_sim(jax.random.PRNGKey(0), u, w, obsmodel_reg(b, np.full(K, 0.4)))
-    dt, div = _time_fit(
+    dt, div, ess_lp = _time_fit(
         IOHMMReg(K=K, M=M), {"u": sim["u"], "x": sim["x"]}, cfg, jax.random.PRNGKey(1)
     )
-    return "iohmm_reg_fit", dt, div, 300.0
+    return "iohmm_reg_fit", dt, div, ess_lp, 300.0
 
 
 def bench_hmix(cfg):
@@ -131,10 +160,10 @@ def bench_hmix(cfg):
     ohlc = simulate_ohlc(np.random.default_rng(2), 160)
     ds = make_dataset(np.asarray(ohlc))
     model = IOHMMHMix(K=4, M=4, L=3, hyperparams=DEFAULT_HYPERPARAMS)
-    dt, div = _time_fit(
+    dt, div, ess_lp = _time_fit(
         model, {"u": ds.u, "x": ds.x}, cfg, jax.random.PRNGKey(1)
     )
-    return "iohmm_hmix_hassan_fit", dt, div, 1800.0  # reference: ≈30 min for K=4
+    return "iohmm_hmix_hassan_fit", dt, div, ess_lp, 1800.0  # reference: ≈30 min for K=4
 
 
 def bench_tayal(cfg):
@@ -146,10 +175,11 @@ def bench_tayal(cfg):
     # strictly-alternating zig-zag signs)
     model = TayalHHMM(gate_mode="hard") if isinstance(cfg, GibbsConfig) else TayalHHMM()
     x, sign = _tayal_batch(1, 1024, seed=3)
-    dt, div = _time_fit(
-        model, {"x": x[0], "sign": sign[0]}, cfg, jax.random.PRNGKey(1)
+    dt, div, ess_lp = _time_fit(
+        model, {"x": x[0], "sign": sign[0]}, cfg, jax.random.PRNGKey(1),
+        fused_traj=True,  # chees: whole-trajectory Pallas kernel
     )
-    return "tayal_single_fit", dt, div, 120.0
+    return "tayal_single_fit", dt, div, ess_lp, 120.0
 
 
 def bench_jangmin(cfg):
@@ -160,10 +190,10 @@ def bench_jangmin(cfg):
     m = simulate_market(100, np.random.default_rng(0))
     model = TreeHMM(jangmin2004_tree(), semisup=True, gate_mode="hard", order_mu="none")
     data = {"x": m["x"], "g": m["regime"]}
-    dt, div = _time_fit(model, data, cfg, jax.random.PRNGKey(1))
+    dt, div, ess_lp = _time_fit(model, data, cfg, jax.random.PRNGKey(1))
     # reference: ≈25 min for a 23-state toy at 100 obs / 200 samples;
     # this is the full 63-leaf tree — same baseline, conservatively
-    return "jangmin_tree_fit", dt, div, 1500.0
+    return "jangmin_tree_fit", dt, div, ess_lp, 1500.0
 
 
 CONFIGS = {
@@ -230,7 +260,7 @@ def main() -> None:
                 f"(tayal, hmm); drop {bad} or use --configs tayal hmm"
             )
     for name in args.configs:
-        metric, dt, div, baseline_s = CONFIGS[name](cfg)
+        metric, dt, div, ess_lp, baseline_s = CONFIGS[name](cfg)
         print(
             json.dumps(
                 {
@@ -239,6 +269,8 @@ def main() -> None:
                     "unit": "sec/fit",
                     "vs_baseline": round(baseline_s / dt, 2),
                     "divergence_rate": round(div, 4),
+                    "ess_lp": round(ess_lp, 1),
+                    "ess_lp_per_sec": round(ess_lp / dt, 1),
                 }
             )
         )

@@ -32,3 +32,61 @@ def test_hmm_main_quick_runs():
     assert out.returncode == 0, out.stderr[-2000:]
     assert "filtered accuracy" in out.stdout
     assert "divergence rate" in out.stdout
+
+
+def test_replication_figures_appendix(tmp_path):
+    """The per-stock appendix generator (`tayal2009/Rmd/appendix-wf.Rmd`
+    analog) renders tables + equity figures from the committed wf
+    artifact without touching a device."""
+    import json
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    sys.path.insert(0, _EXAMPLES)
+    try:
+        import replication_figures as rf
+    finally:
+        sys.path.remove(_EXAMPLES)
+
+    root = os.path.dirname(_EXAMPLES)
+    with open(os.path.join(root, "results", "tayal_replication.json")) as f:
+        rep = json.load(f)
+    os.makedirs(tmp_path / "docs" / "figures", exist_ok=True)
+    old_out, old_root = rf.OUT, rf.ROOT
+    rf.OUT, rf.ROOT = str(tmp_path / "docs" / "figures"), str(tmp_path)
+    try:
+        rf.appendix(rep, plt)
+    finally:
+        rf.OUT, rf.ROOT = old_out, old_root
+    apx = (tmp_path / "docs" / "appendix-wf.md").read_text()
+    symbols = {r["symbol"] for r in rep["wf"]["per_window"]}
+    for sym in symbols:
+        assert f"## {sym}" in apx
+        assert (tmp_path / "docs" / "figures" / f"appendix_equity_{sym}.png").exists()
+    assert "| **Total %** |" in apx
+
+
+@pytest.mark.slow
+def test_bench_quick_cpu_runs():
+    """`bench.py --quick --cpu` end-to-end: the driver-facing benchmark
+    must keep emitting its one-line JSON schema (incl. the round-3
+    roofline fields) without a device."""
+    import json
+
+    root = os.path.dirname(_EXAMPLES)
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "bench.py"), "--quick", "--cpu"],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        cwd=root,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = json.loads(out.stdout.strip().splitlines()[-1])
+    assert line["metric"] == "tayal_batched_posterior_throughput"
+    assert line["unit"] == "series/sec"
+    for field in ("vs_baseline", "achieved_gflops", "hbm_gbps", "peak_fraction"):
+        assert field in line
