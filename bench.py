@@ -121,9 +121,10 @@ def main() -> None:
         "--samples",
         type=int,
         default=None,
-        help="default: 2500 (gibbs — draws are nearly free on the idle "
-        "chip and make the worst-parameter ESS gate meaningful) / 250 "
-        "(nuts) / 150 (chees; x2 chains pools 300 draws)",
+        help="default: 2500 (gibbs — a 10x-Stan recorded budget; draws "
+        "are nearly free on the idle chip. The worst-parameter ESS gate "
+        "additionally runs its own UNTIMED 16k-draw pass) / 250 (nuts) "
+        "/ 150 (chees; x2 chains pools 300 draws)",
     )
     # Treedepth bound: in a vmapped batch every series steps in lockstep,
     # so the whole batch pays the deepest trajectory. Measured on this
@@ -367,9 +368,9 @@ def main() -> None:
         """UNTIMED long run for the worst-parameter ESS gate: the
         weakly-identified emission-simplex corners mix slowly through
         the sticky state path, so an honest ESS >= 50 on the worst
-        coordinate needs ~10k draws — nearly free on the idle chip
-        (VERDICT r2 #2: spend the chip on draws), while the TIMED
-        headline stays at the Stan-comparable budget."""
+        coordinate needs ~16k draws — nearly free on the idle chip
+        (VERDICT r2 #2: spend the chip on draws). The TIMED headline
+        run keeps its own (smaller, 10x-Stan) --samples budget."""
         from hhmm_tpu.infer import GibbsConfig, sample_gibbs
 
         qcfg = GibbsConfig(
@@ -409,7 +410,7 @@ def main() -> None:
         The exact pair-swap label symmetry is folded out per draw by
         anchored phi distance (shared anchors across samplers).
 
-        Budget: the chip is idle at 8 series, so both samplers run 4
+        Budget: the chip is idle at 8 series, so both samplers run 8
         chains (vmapped — same wall-clock as 1) and thousands of draws;
         the gate is an ABSOLUTE bound (gap <= 0.05 with a measured MC
         floor <= 0.02), not a floor-relative one that a noisy statistic

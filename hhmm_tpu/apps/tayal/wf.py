@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from hhmm_tpu.apps.tayal.features import extract_features, to_model_inputs
-from hhmm_tpu.apps.tayal.pipeline import decode_states, label_and_trade
+from hhmm_tpu.apps.tayal.pipeline import label_and_trade
 from hhmm_tpu.apps.tayal.trading import Trades
 from hhmm_tpu.batch import fit_batched, pad_datasets
 from hhmm_tpu.infer import SamplerConfig
@@ -221,52 +221,103 @@ def wf_trade(
     def _pad_to(a, n, fill=0):
         return np.pad(np.asarray(a), (0, n - len(a)), constant_values=fill)
 
-    results = []
+    # ---- decode phase: BATCHED by (b_ins, b_oos) bucket pair ----
+    # The per-task generated pass is latency-bound (~seconds per
+    # dispatch); 204 sequential decodes dominated the backtest's
+    # wall-clock. Tasks sharing a bucket pair vmap into one dispatch
+    # (fixed thinned-draw count D_DEC so draw stacks are uniform).
+    # Decode results are digest-cached per task — same restartability
+    # contract as the fit chunks (`wf-trade.R:86-109`).
+    from hhmm_tpu.batch.cache import ResultCache, digest_key
+
+    D_DEC = 100  # thinned draws per task for the median-α classifier
+    G_DEC = 8  # tasks per decode dispatch (bounds device memory)
+    dcache = ResultCache(cache_dir) if cache_dir is not None else None
+    leg_states: List[Optional[np.ndarray]] = [None] * B
+    meta = []  # per-task (n_ins, n_oos, b_ins, b_oos, keep, draws_thin, dk)
+    pend: Dict[tuple, List[int]] = {}
     for i, (task, (zig, x, sign, n_ins)) in enumerate(zip(tasks, feats)):
         n_oos = len(x) - n_ins
         b_ins, b_oos = _bucket(n_ins), _bucket(n_oos)
-        per_task = {
-            "x": jnp.asarray(_pad_to(x[:n_ins], b_ins)),
-            "sign": jnp.asarray(_pad_to(sign[:n_ins], b_ins)),
-            "mask": jnp.asarray(
-                (np.arange(b_ins) < n_ins).astype(np.float32)
-            ),
-            "x_oos": jnp.asarray(_pad_to(x[n_ins:], b_oos)),
-            "sign_oos": jnp.asarray(_pad_to(sign[n_ins:], b_oos)),
-            "mask_oos": jnp.asarray(
-                (np.arange(b_oos) < n_oos).astype(np.float32)
-            ),
-        }
         # basin selection before the median-α decode: pool only chains
         # within `basin_nats` of this task's best chain
         chain_lp = np.asarray(stats["logp"][i]).mean(axis=-1)  # [chains]
         keep = chain_lp >= chain_lp.max() - basin_nats
         draws = np.asarray(qs[i])[keep].reshape(-1, qs[i].shape[-1])
-        # decode cache: same restartability contract as the fit chunks
-        # (`wf-trade.R:86-109`) — a dropped device session mid-decode
-        # resumes instead of recomputing every window
-        leg_state = None
+        sel = np.linspace(0, len(draws) - 1, min(D_DEC, len(draws))).astype(int)
+        draws_t = draws[sel]
+        if len(draws_t) < D_DEC:  # repeat-pad tiny posteriors to fixed D
+            draws_t = draws_t[np.arange(D_DEC) % len(draws_t)]
         dk = None
-        if cache_dir is not None:
-            from hhmm_tpu.batch.cache import ResultCache, digest_key
-
-            dcache = ResultCache(cache_dir)
+        if dcache is not None:
             dk = digest_key(
-                {"stage": "wf-decode-v1", "gate_mode": gate_mode},
+                {"stage": "wf-decode-v2", "gate_mode": gate_mode},
                 {"x": x, "sign": sign},
                 {"n_ins": n_ins},
-                draws,
+                draws_t,
             )
             hit = dcache.get(dk)
             if hit is not None:
-                leg_state = np.asarray(hit["leg_state"])
-        if leg_state is None:
-            padded_state = decode_states(model, draws, per_task)
-            leg_state = np.concatenate(
-                [padded_state[:n_ins], padded_state[b_ins : b_ins + n_oos]]
+                leg_states[i] = np.asarray(hit["leg_state"])
+        meta.append((n_ins, n_oos, b_ins, b_oos, keep, draws_t, dk))
+        if leg_states[i] is None:
+            pend.setdefault((b_ins, b_oos), []).append(i)
+
+    gen_fn = jax.jit(jax.vmap(model.generated))
+    for (b_ins, b_oos), idxs in pend.items():
+        for c0 in range(0, len(idxs), G_DEC):
+            grp = idxs[c0 : c0 + G_DEC]
+            pad_n = G_DEC - len(grp)
+            grp_fit = grp + [grp[-1]] * pad_n  # repeat-pad: one compile
+            data_g = {
+                "x": np.stack(
+                    [_pad_to(feats[j][1][: meta[j][0]], b_ins) for j in grp_fit]
+                ),
+                "sign": np.stack(
+                    [_pad_to(feats[j][2][: meta[j][0]], b_ins) for j in grp_fit]
+                ),
+                "mask": np.stack(
+                    [
+                        (np.arange(b_ins) < meta[j][0]).astype(np.float32)
+                        for j in grp_fit
+                    ]
+                ),
+                "x_oos": np.stack(
+                    [_pad_to(feats[j][1][meta[j][0] :], b_oos) for j in grp_fit]
+                ),
+                "sign_oos": np.stack(
+                    [_pad_to(feats[j][2][meta[j][0] :], b_oos) for j in grp_fit]
+                ),
+                "mask_oos": np.stack(
+                    [
+                        (np.arange(b_oos) < meta[j][1]).astype(np.float32)
+                        for j in grp_fit
+                    ]
+                ),
+            }
+            samples_g = np.stack([meta[j][5] for j in grp_fit])
+            out = gen_fn(
+                jnp.asarray(samples_g),
+                {k: jnp.asarray(v) for k, v in data_g.items()},
             )
-            if dk is not None:
-                dcache.put(dk, {"leg_state": np.asarray(leg_state)})
+            alpha = np.asarray(out["alpha"])  # [G, D, b_ins, K]
+            alpha_o = np.asarray(out["alpha_oos"])
+            for li, j in enumerate(grp):
+                n_ins_j, n_oos_j = meta[j][0], meta[j][1]
+                ins_state = np.argmax(
+                    np.median(alpha[li], axis=0), axis=-1
+                )[:n_ins_j]
+                oos_state = np.argmax(
+                    np.median(alpha_o[li], axis=0), axis=-1
+                )[:n_oos_j]
+                leg_states[j] = np.concatenate([ins_state, oos_state])
+                if meta[j][6] is not None:
+                    dcache.put(meta[j][6], {"leg_state": leg_states[j]})
+
+    results = []
+    for i, (task, (zig, x, sign, n_ins)) in enumerate(zip(tasks, feats)):
+        n_oos, keep = meta[i][1], meta[i][4]
+        leg_state = leg_states[i]
         lw = label_and_trade(
             task.price,
             zig,

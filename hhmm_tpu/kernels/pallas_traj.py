@@ -429,6 +429,14 @@ def make_tayal_trajectory(data, cap: int, interpret: bool = False):
     step bound covers ``config.max_leapfrogs`` (the kernel silently
     clamps ``n_steps`` to ``cap``, which would otherwise skew ChEES
     adaptation statistics)."""
+    if not interpret and jax.default_backend() != "tpu":
+        # the Mosaic kernel only lowers on TPU; raising here (the same
+        # contract as the VMEM check below) lets callers fall back to
+        # the unfused leapfrog path on CPU/GPU
+        raise ValueError(
+            "fused trajectory kernel requires the TPU backend "
+            f"(got {jax.default_backend()!r}); use the unfused path"
+        )
     x = jnp.asarray(data["x"])
     sign = jnp.asarray(data["sign"])
     mask = data.get("mask")
