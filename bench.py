@@ -204,7 +204,7 @@ def main() -> None:
 
     from __graft_entry__ import _tayal_batch
     from hhmm_tpu.infer import ChEESConfig, SamplerConfig, sample_nuts
-    from hhmm_tpu.infer.diagnostics import ess
+    from hhmm_tpu.infer.diagnostics import ess_many
     from hhmm_tpu.models import TayalHHMM
 
     # Gibbs needs the exact-HMM factorization (hard gate; SBC-validated —
@@ -350,13 +350,9 @@ def main() -> None:
         (n_eff of the worst parameter), over ALL series, not a
         subsample."""
         mats, _ = constrained_canonical(qs_all, model)  # [B, chains, draws, P]
-        B = mats.shape[0]
-        per_param = np.stack(
-            [
-                np.array([ess(mats[b, :, :, p]) for p in range(mats.shape[-1])])
-                for b in range(B)
-            ]
-        )  # [B, P]
+        B, C_m, S_m, P = mats.shape
+        rows = np.moveaxis(mats, -1, 1).reshape(B * P, C_m, S_m)
+        per_param = ess_many(rows).reshape(B, P)
         mins = per_param.min(axis=1)
         return {
             "ess_param_min_mean": round(float(mins.mean()), 1),
@@ -432,6 +428,18 @@ def main() -> None:
             jax.random.PRNGKey(1300),
         )  # [B_a, C_a, dim]
 
+        D_TS = 500  # fixed thinned-draw count: one compile for every call
+
+        @jax.jit
+        def _pbull_series(thin, xb, sb):
+            """[D_TS, dim] draws -> smoothed bull-pair probability paths
+            [D_TS, T], entirely on device: the generated pass must run
+            jitted — eager vmap dispatches op-by-op through the device
+            tunnel (~10 s/call of pure latency at ~0 compute)."""
+            gen = hard.generated(thin, {"x": xb, "sign": sb})
+            gamma = gen["gamma"]
+            return gamma[..., 2] + gamma[..., 3]
+
         def top_state_mean(qs, anchors=None, chain_keep=None):
             """[B_a, chains, draws, dim] -> posterior-mean bull-pair
             smoothed probability [B_a, T]. The exact pair-swap symmetry
@@ -449,12 +457,10 @@ def main() -> None:
                 if chain_keep is not None:
                     qb = qb[chain_keep[b]]
                 flat = qb.reshape(-1, qb.shape[-1])
-                thin = flat[:: max(1, len(flat) // 500)]
-                gen = hard.generated(
-                    jnp.asarray(thin), {"x": x[b], "sign": sign[b]}
-                )
-                gamma = np.asarray(gen["gamma"])  # [draws, T, 4]
-                p_bull = gamma[..., 2] + gamma[..., 3]  # [draws, T]
+                sel = np.linspace(0, len(flat) - 1, D_TS).astype(int)
+                p_bull = np.asarray(
+                    _pbull_series(jnp.asarray(flat[sel]), x[b], sign[b])
+                )  # [D_TS, T]
                 a = p_bull[0] if anchors is None else anchors[b]
                 made_anchors.append(a)
                 d_id = ((p_bull - a) ** 2).sum(axis=1)
@@ -478,6 +484,7 @@ def main() -> None:
             return jax.vmap(one)(x, sign, init, keys)
 
         run_g_j = jax.jit(run_g)
+        t_ = time.time()
         qs_g = run_g_j(
             x[:B_a], sign[:B_a], init_a,
             jax.random.split(jax.random.PRNGKey(7), B_a),
@@ -486,12 +493,18 @@ def main() -> None:
         # the MC noise FLOOR of the statistic on these exact series —
         # the floor is REPORTED and gated (<= 0.02), not used to scale
         # the tolerance
+        jax.block_until_ready(qs_g)
+        print(f"#   gibbs pass 1: {time.time() - t_:.1f}s", file=sys.stderr)
+        t_ = time.time()
         qs_g2 = run_g_j(
             x[:B_a], sign[:B_a], init_a,
             jax.random.split(jax.random.PRNGKey(71), B_a),
         )
+        jax.block_until_ready(qs_g2)
+        print(f"#   gibbs pass 2: {time.time() - t_:.1f}s", file=sys.stderr)
+        t_ = time.time()
         ncfg = SamplerConfig(
-            num_warmup=500, num_samples=6000, num_chains=1, max_treedepth=6
+            num_warmup=500, num_samples=4000, num_chains=1, max_treedepth=6
         )
 
         def run_n(x, sign, init, keys):
@@ -507,7 +520,7 @@ def main() -> None:
 
             return jax.vmap(one)(x, sign, init, keys)
 
-        # dispatch in two series-halves: one 8x8x6500-iteration NUTS
+        # dispatch in two series-halves: one 8x8x4500-iteration NUTS
         # program runs long enough to trip the tunnel's per-execution
         # watchdog; two half-size programs do not
         run_n_j = jax.jit(run_n)
@@ -544,21 +557,25 @@ def main() -> None:
 
         def marginal_ll_per_chain(qs):
             """[B_a, C, draws, dim] -> per-chain mean marginal loglik
-            [B_a, C]."""
+            [B_a, C]. One jitted call per series (chains batched as a
+            flat draw axis) — per-call tunnel latency dominates the
+            actual compute at these sizes."""
+            D_ML = 64
             out = []
             for b in range(B_a):
-                row = []
-                for c in range(qs.shape[1]):
-                    flat = np.asarray(qs[b, c])
-                    thin = jnp.asarray(flat[:: max(1, len(flat) // 64)])
-                    row.append(
-                        float(np.mean(np.asarray(ll_fn(thin, x[b], sign[b]))))
-                    )
-                out.append(row)
+                qb = np.asarray(qs[b])  # [C, draws, dim]
+                sel = np.linspace(0, qb.shape[1] - 1, D_ML).astype(int)
+                flat = qb[:, sel].reshape(-1, qb.shape[-1])
+                lls = np.asarray(ll_fn(jnp.asarray(flat), x[b], sign[b]))
+                out.append(lls.reshape(qb.shape[0], D_ML).mean(axis=1))
             return np.array(out)
 
+        print(f"#   nuts passes: {time.time() - t_:.1f}s", file=sys.stderr)
+        t_ = time.time()
         mlc_g = marginal_ll_per_chain(np.asarray(qs_g))  # [B_a, C_a]
         mlc_n = marginal_ll_per_chain(np.asarray(qs_n))
+        print(f"#   marginal ll: {time.time() - t_:.1f}s", file=sys.stderr)
+        t_ = time.time()
         # basin-select NUTS chains per series (keep chains within 10
         # nats of the series' best chain — the replication protocol);
         # Gibbs pools all chains: it mixes across basins and any
@@ -590,6 +607,7 @@ def main() -> None:
                 second_half[b, kept] = True
         pb_n1, _ = top_state_mean(jnp.asarray(qs_n), anchors, chain_keep=first_half)
         pb_n2, _ = top_state_mean(jnp.asarray(qs_n), anchors, chain_keep=second_half)
+        print(f"#   top-state means: {time.time() - t_:.1f}s", file=sys.stderr)
         floor_g = np.abs(pb_g - pb_g2)  # MC noise, Gibbs side
         floor_n = np.abs(pb_n1 - pb_n2) / 2.0  # half-ensembles: /2 ~ full-ensemble noise
         gap = np.abs(pb_g - pb_n)  # [B_a, T]
@@ -680,19 +698,23 @@ def main() -> None:
     # correctness gates + honest ESS (not timed): worst-parameter ESS
     # over ALL series, and the Gibbs-vs-NUTS posterior agreement check
     lp = np.asarray(logps)  # [B, chains, draws]
-    ess_vals = [ess(lp[i]) for i in range(args.series)]
+    ess_vals = ess_many(lp)
     if args.quick:  # smoke config: draw counts too small for the gates
         ess_param = {"ess_param_min_mean": None, "ess_param_min_worst": None}
         agree = {"agreement_ok": True, "agreement_skipped": "quick"}
     else:
         # the ESS gate gets its own untimed long run (gibbs); HMC
         # benches reuse the timed draws
+        t_q = time.time()
         ess_param = (
             quality_pass_gibbs()
             if args.sampler == "gibbs"
             else param_ess_min(qs_all)
         )
+        print(f"# quality pass: {time.time() - t_q:.1f}s", file=sys.stderr)
+        t_a = time.time()
         agree = agreement_check()
+        print(f"# agreement check: {time.time() - t_a:.1f}s", file=sys.stderr)
     print(
         json.dumps(
             {

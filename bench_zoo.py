@@ -66,6 +66,7 @@ def _time_fit(model, data, config, key, fused_traj=False):
             jax.random.PRNGKey(7),
         )
         traj = None
+        data_b = {k: v[None] for k, v in data.items()}
         if fused_traj:
             # whole-trajectory Pallas kernel (kernels/pallas_traj.py)
             # run as a B=1 batch — VERDICT r2 #4: the single-fit path
@@ -73,16 +74,12 @@ def _time_fit(model, data, config, key, fused_traj=False):
             from hhmm_tpu.kernels.pallas_traj import make_tayal_trajectory
 
             try:
-                traj = make_tayal_trajectory(
-                    {k: v[None] for k, v in data.items()},
-                    cap=config.max_leapfrogs,
-                )
+                traj = make_tayal_trajectory(data_b, cap=config.max_leapfrogs)
             except ValueError as e:  # non-TPU backend or T over VMEM
                 print(f"# fused trajectory disabled: {e}", flush=True)
         if traj is not None:
             from hhmm_tpu.infer import make_lp_bc, sample_chees_batched
 
-            data_b = {k: v[None] for k, v in data.items()}
             lp_bc = make_lp_bc(model, data_b)
             probe = model.make_vg(data)
 

@@ -242,7 +242,9 @@ def wf_trade(
         # basin selection before the median-α decode: pool only chains
         # within `basin_nats` of this task's best chain
         chain_lp = np.asarray(stats["logp"][i]).mean(axis=-1)  # [chains]
-        keep = chain_lp >= chain_lp.max() - basin_nats
+        keep = chain_lp >= np.nanmax(chain_lp) - basin_nats
+        if not keep.any():  # all-NaN logp (fully diverged window):
+            keep[:] = True  # decode from everything rather than abort
         draws = np.asarray(qs[i])[keep].reshape(-1, qs[i].shape[-1])
         sel = np.linspace(0, len(draws) - 1, min(D_DEC, len(draws))).astype(int)
         draws_t = draws[sel]

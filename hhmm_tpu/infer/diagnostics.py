@@ -14,7 +14,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
-__all__ = ["split_rhat", "ess", "summary"]
+__all__ = ["split_rhat", "ess", "ess_many", "summary"]
 
 
 def _split_chains(x: np.ndarray) -> np.ndarray:
@@ -84,6 +84,57 @@ def ess(x: np.ndarray) -> float:
     return float(min(m * n / tau, m * n * np.log10(m * n)))
 
 
+def ess_many(x: np.ndarray, chunk: int = 512) -> np.ndarray:
+    """Vectorized :func:`ess` over a leading batch axis.
+
+    ``x``: [N, chains, draws] → [N] bulk ESS, identical to calling
+    ``ess`` per row (same split-chain, FFT autocovariance, and Geyer
+    initial-positive-monotone truncation). The bench's worst-parameter
+    gate evaluates ~10k (series × parameter) rows of 16k draws — one
+    batched FFT per chunk instead of 10k Python calls. ``chunk`` bounds
+    the FFT workspace (complex128 [chunk, 2·chains, 2^ceil(log2(2n))]).
+    """
+    x = np.asarray(x, dtype=np.float64)
+    N, c, n0 = x.shape
+    half = n0 // 2
+    m, n = 2 * c, half
+    if n < 4:
+        return np.full(N, float(m * n))
+    out = np.empty(N)
+    for s in range(0, N, chunk):
+        xs = x[s : s + chunk]
+        b = xs.shape[0]
+        split = np.concatenate([xs[:, :, :half], xs[:, :, n0 - half :]], axis=1)
+        xc = split - split.mean(axis=-1, keepdims=True)
+        pad = int(2 ** np.ceil(np.log2(2 * n)))
+        f = np.fft.rfft(xc, pad, axis=-1)
+        acov = np.fft.irfft(f * np.conj(f), pad, axis=-1)[..., :n].real / n
+        chain_var = acov[..., 0] * n / (n - 1.0)  # [b, m]
+        mean_var = chain_var.mean(axis=-1)  # [b]
+        var_plus = mean_var * (n - 1.0) / n
+        if m > 1:
+            var_plus = var_plus + split.mean(axis=-1).var(axis=-1, ddof=1)
+        safe_vp = np.where(var_plus > 0, var_plus, 1.0)
+        rho = 1.0 - (mean_var[:, None] - acov.mean(axis=1)) / safe_vp[:, None]
+        max_pairs = (n - 1) // 2
+        paired = (
+            rho[:, 0 : 2 * max_pairs : 2] + rho[:, 1 : 2 * max_pairs + 1 : 2]
+        )  # [b, P]
+        positive = paired > 0
+        has_neg = ~positive
+        first_neg = np.where(
+            has_neg.any(axis=1), has_neg.argmax(axis=1), paired.shape[1]
+        )
+        pmin = np.minimum.accumulate(paired, axis=1)
+        valid = np.arange(paired.shape[1])[None, :] < first_neg[:, None]
+        tau = -1.0 + 2.0 * np.sum(np.where(valid, pmin, 0.0), axis=1)
+        tau = np.where(positive[:, 0], tau, 1.0)
+        tau = np.maximum(tau, 1.0 / np.log10(m * n + 10))
+        vals = np.minimum(m * n / tau, m * n * np.log10(m * n))
+        out[s : s + chunk] = np.where(var_plus <= 0, float(m * n), vals)
+    return out
+
+
 def summary(
     samples: Dict[str, np.ndarray],
     probs=(0.025, 0.25, 0.5, 0.75, 0.975),
@@ -103,7 +154,7 @@ def summary(
         stats = {
             "mean": flat.mean(axis=(0, 1)),
             "sd": flat.std(axis=(0, 1), ddof=1),
-            "n_eff": np.array([ess(flat[:, :, i]) for i in range(flatdim)]),
+            "n_eff": ess_many(np.moveaxis(flat, -1, 0)),
             "rhat": np.array([split_rhat(flat[:, :, i]) for i in range(flatdim)]),
         }
         for p in probs:

@@ -130,3 +130,27 @@ def test_simplex_uniform_sampling_is_dirichlet1():
         y, ldj = bij.forward(x)
         assert np.isfinite(ldj)
         np.testing.assert_allclose(np.sum(np.asarray(y)), 1.0, rtol=2e-4)
+
+
+class TestEssMany:
+    def test_matches_scalar_ess(self):
+        """ess_many == per-row ess across shapes, including AR(1)
+        autocorrelation, near-constant rows, and odd draw counts."""
+        from hhmm_tpu.infer.diagnostics import ess, ess_many
+
+        rng = np.random.default_rng(0)
+        rows = []
+        for i in range(12):
+            phi = [0.0, 0.5, 0.9, 0.99][i % 4]
+            z = np.empty((2, 301))
+            z[:, 0] = rng.normal(size=2)
+            e = rng.normal(size=(2, 301))
+            for t in range(1, 301):
+                z[:, t] = phi * z[:, t - 1] + e[:, t]
+            if i == 7:
+                z[:] = 3.14  # constant row -> var_plus <= 0 branch
+            rows.append(z)
+        x = np.stack(rows)  # [12, 2, 301]
+        got = ess_many(x, chunk=5)  # exercise chunking
+        want = np.array([ess(x[i]) for i in range(len(x))])
+        np.testing.assert_allclose(got, want, rtol=1e-10)
