@@ -14,7 +14,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
-__all__ = ["split_rhat", "ess", "ess_many", "summary"]
+__all__ = ["split_rhat", "split_rhat_many", "ess", "ess_many", "summary"]
 
 
 def _split_chains(x: np.ndarray) -> np.ndarray:
@@ -36,6 +36,29 @@ def split_rhat(x: np.ndarray) -> float:
     if W <= 0:
         return 1.0
     return float(np.sqrt(var_plus / W))
+
+
+def _split_chains_batched(x: np.ndarray) -> np.ndarray:
+    """[N, chains, draws] → [N, 2*chains, draws//2] (the batched analog
+    of :func:`_split_chains` — single source of the split semantics for
+    the vectorized estimators)."""
+    n0 = x.shape[-1]
+    half = n0 // 2
+    return np.concatenate([x[:, :, :half], x[:, :, n0 - half :]], axis=1)
+
+
+def split_rhat_many(x: np.ndarray) -> np.ndarray:
+    """Vectorized :func:`split_rhat` over a leading batch axis:
+    ``x`` [N, chains, draws] → [N], identical to the scalar per row."""
+    xs = _split_chains_batched(np.asarray(x, dtype=np.float64))
+    n = xs.shape[-1]
+    chain_means = xs.mean(axis=-1)  # [N, m]  (m = 2*chains >= 2)
+    chain_vars = xs.var(axis=-1, ddof=1)
+    W = chain_vars.mean(axis=-1)  # [N]
+    B = n * chain_means.var(axis=-1, ddof=1)
+    var_plus = (n - 1) / n * W + B / n
+    safe_W = np.where(W > 0, W, 1.0)
+    return np.where(W <= 0, 1.0, np.sqrt(var_plus / safe_W))
 
 
 def _autocovariance_fft(x: np.ndarray) -> np.ndarray:
@@ -102,9 +125,7 @@ def ess_many(x: np.ndarray, chunk: int = 512) -> np.ndarray:
         return np.full(N, float(m * n))
     out = np.empty(N)
     for s in range(0, N, chunk):
-        xs = x[s : s + chunk]
-        b = xs.shape[0]
-        split = np.concatenate([xs[:, :, :half], xs[:, :, n0 - half :]], axis=1)
+        split = _split_chains_batched(x[s : s + chunk])
         xc = split - split.mean(axis=-1, keepdims=True)
         pad = int(2 ** np.ceil(np.log2(2 * n)))
         f = np.fft.rfft(xc, pad, axis=-1)
@@ -155,7 +176,7 @@ def summary(
             "mean": flat.mean(axis=(0, 1)),
             "sd": flat.std(axis=(0, 1), ddof=1),
             "n_eff": ess_many(np.moveaxis(flat, -1, 0)),
-            "rhat": np.array([split_rhat(flat[:, :, i]) for i in range(flatdim)]),
+            "rhat": split_rhat_many(np.moveaxis(flat, -1, 0)),
         }
         for p in probs:
             stats[f"q{int(p * 100)}" if p not in (0.025, 0.975) else f"q{p * 100:g}"] = (

@@ -154,3 +154,14 @@ class TestEssMany:
         got = ess_many(x, chunk=5)  # exercise chunking
         want = np.array([ess(x[i]) for i in range(len(x))])
         np.testing.assert_allclose(got, want, rtol=1e-10)
+
+    def test_split_rhat_many_matches_scalar(self):
+        from hhmm_tpu.infer.diagnostics import split_rhat, split_rhat_many
+
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(9, 2, 200))
+        x[3] += np.array([0.0, 5.0])[:, None]  # divergent chain means
+        x[5] = 2.0  # constant -> W <= 0 branch
+        got = split_rhat_many(x)
+        want = np.array([split_rhat(x[i]) for i in range(len(x))])
+        np.testing.assert_allclose(got, want, rtol=1e-12)

@@ -90,3 +90,24 @@ def test_bench_quick_cpu_runs():
     assert line["unit"] == "series/sec"
     for field in ("vs_baseline", "achieved_gflops", "hbm_gbps", "peak_fraction"):
         assert field in line
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("driver,args", [
+    ("hmm_main.py", ["--variant", "multinom", "--T", "250"]),
+    ("hmm_main.py", ["--variant", "semisup", "--T", "250"]),
+    ("iohmm_main.py", ["--variant", "reg", "--T", "200"]),
+])
+def test_driver_variants_run(driver, args):
+    """Run-through (not just compile-check) of the remaining reference
+    driver variants (`hmm/main-multinom*.R`, `iohmm-reg/main.R`)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(_EXAMPLES, driver), "--cpu", "--quick", *args],
+        capture_output=True,
+        text=True,
+        timeout=540,
+        env=env,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "divergence rate" in out.stdout
