@@ -683,6 +683,41 @@ def main() -> None:
 
     series_per_sec = args.series / exec_s
     vs_baseline = series_per_sec * STAN_SECONDS_PER_SERIES
+
+    # secondary timing at the reference's own 300-iteration budget
+    # (50 warmup + 250 draws — `tayal2009/main.R:34-39`), for cross-round
+    # comparability: the default budget above buys 10x the draws, so its
+    # series/sec is NOT the per-iteration speed
+    stan_budget = {}
+    if args.sampler == "gibbs" and not args.quick:
+        from hhmm_tpu.infer import GibbsConfig as _GC, sample_gibbs as _sg
+
+        scfg = _GC(num_warmup=50, num_samples=250, num_chains=chains)
+
+        def run_stan_budget(x, sign, init, keys):
+            def one(xi, si, qi, ki):
+                qs, st = _sg(
+                    model, {"x": xi, "sign": si}, ki, scfg, init_q=qi, jit=False
+                )
+                return qs
+
+            return jax.vmap(one)(x, sign, init, keys)
+
+        run_sb = jax.jit(run_stan_budget)
+        sb_warm = jax.random.split(jax.random.PRNGKey(555), chunk)
+        jax.block_until_ready(run_sb(x[:chunk], sign[:chunk], init[:chunk], sb_warm))
+        t0 = time.time()
+        for s in range(0, args.series, chunk):
+            sl = slice(s, s + chunk)
+            jax.block_until_ready(run_sb(x[sl], sign[sl], init[sl], keys[sl]))
+        sb_s = time.time() - t0
+        stan_budget = {
+            "series_per_sec_stan_budget": round(args.series / sb_s, 1),
+            "vs_baseline_stan_budget": round(
+                args.series / sb_s * STAN_SECONDS_PER_SERIES, 1
+            ),
+        }
+
     util = utilization_model(
         args.sampler,
         series=args.series,
@@ -731,6 +766,7 @@ def main() -> None:
                 ),
                 **agree,
                 **util,
+                **stan_budget,
                 "divergence_rate": round(float(np.asarray(div).mean()), 4),
                 "baseline_basis": {
                     "charged_stan_seconds_per_series": STAN_SECONDS_PER_SERIES,
@@ -756,6 +792,7 @@ def main() -> None:
                 "achieved_gflops": util["achieved_gflops"],
                 "hbm_gbps": util["hbm_gbps"],
                 "peak_fraction": util["peak_fraction_flops"],
+                **stan_budget,
             }
         )
     )
