@@ -251,14 +251,23 @@ def main() -> None:
     if args.sampler == "gibbs":
         from hhmm_tpu.infer import sample_gibbs
 
-        def run_chunk(x, sign, init, keys):
-            def one(xi, si, qi, ki):
-                qs, stats = sample_gibbs(
-                    model, {"x": xi, "sign": si}, ki, cfg, init_q=qi, jit=False
-                )
-                return qs, stats["logp"], stats["diverging"]
+        def make_gibbs_runner(gcfg):
+            """One runner shape for every gibbs timing in this bench
+            (main run + the secondary stan-budget timing) so the two
+            measurements can never drift apart in invocation details."""
 
-            return jax.vmap(one)(x, sign, init, keys)
+            def run_chunk(x, sign, init, keys):
+                def one(xi, si, qi, ki):
+                    qs, stats = sample_gibbs(
+                        model, {"x": xi, "sign": si}, ki, gcfg, init_q=qi, jit=False
+                    )
+                    return qs, stats["logp"], stats["diverging"]
+
+                return jax.vmap(one)(x, sign, init, keys)
+
+            return run_chunk
+
+        run_chunk = make_gibbs_runner(cfg)
 
     elif args.sampler == "chees":
         from hhmm_tpu.infer import make_lp_bc, sample_chees_batched
@@ -690,20 +699,10 @@ def main() -> None:
     # series/sec is NOT the per-iteration speed
     stan_budget = {}
     if args.sampler == "gibbs" and not args.quick:
-        from hhmm_tpu.infer import GibbsConfig as _GC, sample_gibbs as _sg
+        from hhmm_tpu.infer import GibbsConfig as _GC
 
         scfg = _GC(num_warmup=50, num_samples=250, num_chains=chains)
-
-        def run_stan_budget(x, sign, init, keys):
-            def one(xi, si, qi, ki):
-                qs, st = _sg(
-                    model, {"x": xi, "sign": si}, ki, scfg, init_q=qi, jit=False
-                )
-                return qs
-
-            return jax.vmap(one)(x, sign, init, keys)
-
-        run_sb = jax.jit(run_stan_budget)
+        run_sb = jax.jit(make_gibbs_runner(scfg))
         sb_warm = jax.random.split(jax.random.PRNGKey(555), chunk)
         jax.block_until_ready(run_sb(x[:chunk], sign[:chunk], init[:chunk], sb_warm))
         t0 = time.time()
