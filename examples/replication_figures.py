@@ -69,11 +69,19 @@ def main():
     axes[3].annotate("published 0.88", (0.6, 0.92), fontsize=8, color="#b04848")
     axes[1].axhline(0.80, ls="--", color="#b04848", lw=1)
     axes[1].annotate("published 0.80", (0.6, 0.84), fontsize=8, color="#b04848")
+    fam = single["replicated"].get("mode_family")
+    fam_note = (
+        f"; chain-level mode family phi_45 = {fam['phi_45_mean']:.2f} ± "
+        f"{fam['phi_45_sd']:.2f} (q90 {fam['phi_45_q10_q90'][1]:.2f}) — "
+        "the published value is ONE Stan chain from this family"
+        if fam
+        else ""
+    )
     fig.suptitle(
-        "G.TO emission posterior (real TSX ticks, 2007-05-01..07 in-sample) — "
-        f"replicated phi_45 = {single['replicated']['phi_45']:.3f}, "
-        f"phi_25 = {single['replicated']['phi_25']:.3f}",
-        fontsize=10,
+        "G.TO emission posterior (real TSX ticks, Rmd window) — "
+        f"dominant-basin pool phi_45 = {single['replicated']['phi_45']:.3f}, "
+        f"phi_25 = {single['replicated']['phi_25']:.3f}{fam_note}",
+        fontsize=9,
     )
     fig.tight_layout()
     path = os.path.join(OUT, "tayal_phi_posterior.png")
