@@ -102,6 +102,7 @@ def wf_trade(
     cache_dir: Optional[str] = None,
     expansion: str = "xts",
     basin_nats: float = 10.0,
+    warm_start: bool = False,
 ) -> List[WFResult]:
     """Run all tasks as one batched fit + per-task host post-processing
     (`wf-trade.R:30-179`, minus the socket cluster).
@@ -120,6 +121,16 @@ def wf_trade(
     ``expansion`` follows :func:`hhmm_tpu.apps.tayal.pipeline
     .label_and_trade` — "xts" reproduces the reference's
     timestamp-join tick expansion, which its published tables require.
+
+    ``warm_start``: fit one pilot per symbol (its first window) and
+    start every window's chains from the pilot's terminal draws — the
+    idiomatic improvement over Stan's cold restarts the reference
+    calls out as its pain point (`hassan2005/main.Rmd:795`; same
+    pilot-seeding design as `apps/hassan/wf.py`). Besides faster
+    convergence, pilot-seeded chains tend to land in the SAME
+    posterior basin across a symbol's windows, making regime labels
+    consistent through the calendar. Off by default: the recorded
+    replication protocol is cold starts (the reference's semantics).
     """
     if key is None:
         key = jax.random.PRNGKey(0)
@@ -170,46 +181,91 @@ def wf_trade(
     # Only in-sample arrays go to the fit — the OOS suffix enters in
     # the per-task decode below.
     B = len(datasets)
-    n_lens = [len(d["x"]) for d in datasets]
-    order = np.argsort(n_lens, kind="stable")
-    groups = [order[i : i + chunk_size] for i in range(0, B, chunk_size)]
-    qs_list: List[Optional[np.ndarray]] = [None] * B
-    logp_list: List[Optional[np.ndarray]] = [None] * B
-    div_list: List[Optional[np.ndarray]] = [None] * B
-    for gi, g in enumerate(groups):
-        # mesh sharding needs a device-divisible batch: repeat-pad the
-        # ragged final group (same semantics as fit_batched's internal
-        # ragged-chunk padding) and drop the extras when scattering back
-        g_fit = g
-        if mesh is not None:
-            n_dev = mesh.shape["series"]
-            rem = len(g) % n_dev
-            if rem:
-                g_fit = np.concatenate([g, np.repeat(g[-1:], n_dev - rem)])
-        padded = pad_datasets(
-            [{"x": datasets[j]["x"], "sign": datasets[j]["sign"]} for j in g_fit],
-            time_keys=["x", "sign"],
+
+    def _fit_grouped(indices, cfg_g, key_salt, init_by_idx=None):
+        """Fit the given task indices in length-sorted, 1024-bucket
+        padded groups (see the block comment above) and scatter the
+        results back by absolute index. Shared by the pilot fits and
+        the main sweep so both get the same watchdog-safe dispatch
+        shape, mesh sharding, and caching."""
+        indices = np.asarray(indices)
+        order_l = indices[
+            np.argsort([len(datasets[j]["x"]) for j in indices], kind="stable")
+        ]
+        out: Dict[int, tuple] = {}
+        for gi in range(0, len(order_l), chunk_size):
+            g = order_l[gi : gi + chunk_size]
+            # mesh sharding needs a device-divisible batch: repeat-pad
+            # the ragged final group (same semantics as fit_batched's
+            # internal ragged-chunk padding), drop extras on scatter
+            g_fit = g
+            if mesh is not None:
+                n_dev = mesh.shape["series"]
+                rem = len(g) % n_dev
+                if rem:
+                    g_fit = np.concatenate([g, np.repeat(g[-1:], n_dev - rem)])
+            padded = pad_datasets(
+                [
+                    {"x": datasets[j]["x"], "sign": datasets[j]["sign"]}
+                    for j in g_fit
+                ],
+                time_keys=["x", "sign"],
+            )
+            T_g = padded["x"].shape[1]
+            bucket = max(1024, -(-T_g // 1024) * 1024)
+            if bucket > T_g:
+                pad_w = ((0, 0), (0, bucket - T_g))
+                padded = {k: np.pad(v, pad_w) for k, v in padded.items()}
+            init_g = (
+                None
+                if init_by_idx is None
+                else np.stack([init_by_idx[j] for j in g_fit])
+            )
+            qs_g, stats_g = fit_batched(
+                model,
+                padded,
+                jax.random.fold_in(jax.random.fold_in(key, key_salt), gi),
+                cfg_g,
+                init=init_g,
+                chunk_size=len(g_fit),
+                mesh=mesh,
+                cache_dir=cache_dir,
+            )
+            for li, j in enumerate(g):
+                out[int(j)] = (
+                    np.asarray(qs_g[li]),
+                    np.asarray(stats_g["logp"][li]),
+                    np.asarray(stats_g["diverging"][li]),
+                )
+        return out
+
+    init_full = None
+    if warm_start:
+        # one pilot per symbol on its first window, at a REDUCED budget
+        # (only the terminal draws seed the sweep — same shrink rule as
+        # `apps/hassan/wf.py`); every window of the symbol starts from
+        # the pilot's terminal draws
+        from dataclasses import replace as _replace
+
+        sym_first: Dict[str, int] = {}
+        for i, t in enumerate(tasks):
+            sym_first.setdefault(t.symbol, i)
+        pilot_cfg = _replace(
+            config, num_samples=max(50, config.num_samples // 4)
         )
-        T_g = padded["x"].shape[1]
-        bucket = max(1024, -(-T_g // 1024) * 1024)
-        if bucket > T_g:
-            pad_w = ((0, 0), (0, bucket - T_g))
-            padded = {k: np.pad(v, pad_w) for k, v in padded.items()}
-        qs_g, stats_g = fit_batched(
-            model,
-            padded,
-            jax.random.fold_in(key, gi),
-            config,
-            chunk_size=len(g_fit),
-            mesh=mesh,
-            cache_dir=cache_dir,
-        )
-        for li, j in enumerate(g):
-            qs_list[j] = np.asarray(qs_g[li])
-            logp_list[j] = np.asarray(stats_g["logp"][li])
-            div_list[j] = np.asarray(stats_g["diverging"][li])
-    qs = qs_list
-    stats = {"logp": logp_list, "diverging": div_list}
+        pilots = _fit_grouped(list(sym_first.values()), pilot_cfg, 777)
+        term = {
+            sym: pilots[j][0][:, -1]  # [chains, dim]
+            for sym, j in sym_first.items()
+        }
+        init_full = {i: term[t.symbol] for i, t in enumerate(tasks)}
+
+    fits = _fit_grouped(np.arange(B), config, 0, init_by_idx=init_full)
+    qs = [fits[i][0] for i in range(B)]
+    stats = {
+        "logp": [fits[i][1] for i in range(B)],
+        "diverging": [fits[i][2] for i in range(B)],
+    }
 
     def _bucket(n: int) -> int:
         """Next power of two >= max(n, 1024): per-task decode shapes

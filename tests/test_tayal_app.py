@@ -330,3 +330,18 @@ class TestPipeline:
         )
         assert len(results) == 3
         assert all(np.isfinite(r.bnh).all() for r in results)
+
+    def test_walk_forward_warm_start(self, tayal_wf_tasks):
+        """Pilot-seeded warm starts (the reference's stated Stan pain
+        point, `hassan2005/main.Rmd:795`): runs end to end and yields
+        valid trades; cold remains the default protocol."""
+        from hhmm_tpu.infer import SamplerConfig
+
+        cfg = SamplerConfig(num_warmup=60, num_samples=60, num_chains=2,
+                            max_treedepth=5)
+        warm = wf_trade(tayal_wf_tasks, config=cfg, chunk_size=4, warm_start=True)
+        assert len(warm) == len(tayal_wf_tasks)
+        for r in warm:
+            assert set(r.trades) == {0, 1, 2, 3, 4, 5}
+            assert np.isfinite(r.bnh).all()
+            assert r.diverged < 0.5
