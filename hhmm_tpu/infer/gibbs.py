@@ -21,11 +21,22 @@ A model opts in by implementing ``gibbs_update(key, z, data, params)
 -> params`` (the conjugate block given the current params — models
 whose conditionals factor completely ignore ``params``; the Gaussian
 family uses it for its exact ordered-cone accept/reject step)
-alongside its standard ``build``; the
-factorization returned by ``build`` must be an exact HMM (for gated
-models: ``gate_mode="hard"`` — the stan-parity soft gate is not a
-product of standard HMM factors, so conjugacy fails there and
-:func:`sample_gibbs` rejects it).
+alongside its standard ``build``.
+
+Gated models: conjugacy does NOT require ``build`` to return a
+row-stochastic HMM — only a chain-structured factorization whose
+parameter conditionals stay in closed form. The stan-parity soft gate
+(`hhmm-tayal2009.stan:46-70`) keeps both properties: its pairwise
+factor is the unnormalized kernel ``Ã_t(i,j) = A(i,j)^{c_t(j)}`` with
+``c_t(j) = 1[j sign-consistent at t]``, so z | θ is still an exact
+FFBS draw (forward filter + backward sample work on arbitrary
+nonnegative chain potentials), and θ | z is Dirichlet/Beta with
+transition counts *weighted by destination consistency* (inconsistent
+steps contribute a unit factor — no information about A). A model
+declares which gate modes its ``gibbs_update`` implements via
+``gibbs_gate_modes`` (default: ``("hard",)``); :func:`sample_gibbs`
+rejects anything else so a not-actually-conjugate combination fails
+loudly.
 """
 
 from __future__ import annotations
@@ -37,7 +48,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from hhmm_tpu.kernels.ffbs import ffbs_fused
+from hhmm_tpu.kernels.ffbs import backward_sample, ffbs_fused
+from hhmm_tpu.kernels.filtering import forward_filter
 
 __all__ = ["GibbsConfig", "sample_gibbs", "transition_counts", "emission_counts"]
 
@@ -97,11 +109,13 @@ def sample_gibbs(
         raise ValueError("GibbsConfig.num_warmup must be >= 1")
     if not hasattr(model, "gibbs_update"):
         raise ValueError(f"{type(model).__name__} does not implement gibbs_update")
-    if getattr(model, "gate_mode", "hard") != "hard":
+    gate = getattr(model, "gate_mode", "hard")
+    if gate not in getattr(model, "gibbs_gate_modes", ("hard",)):
         raise ValueError(
-            "blocked Gibbs needs an exact HMM factorization: construct the "
-            "model with gate_mode='hard' (the stan-parity soft gate is not "
-            "conjugate)"
+            f"{type(model).__name__}.gibbs_update does not support "
+            f"gate_mode={gate!r} (supported: "
+            f"{getattr(model, 'gibbs_gate_modes', ('hard',))}); construct "
+            "the model with a supported gate or use an HMC sampler"
         )
     C = config.num_chains
     data = {k: jnp.asarray(v) for k, v in data.items()}
@@ -125,10 +139,17 @@ def sample_gibbs(
             # the whole transition is ONE fused FFBS (forward filter +
             # backward state draw + lp trace — a single Pallas kernel
             # launch on TPU, kernels/pallas_ffbs.py) plus scan-free
-            # conjugate count matmuls.
+            # conjugate count matmuls. Time-varying kernels (the soft
+            # sign gate materializes Ã_t [T-1, K, K]) take the
+            # scan-based FFBS instead — same draws-distribution, no
+            # Pallas eligibility.
             k_z, k_par = jax.random.split(k)
             log_pi, log_A, log_obs, mask = model.build(params, data)
-            z, ll = ffbs_fused(k_z, log_pi, log_A, log_obs, mask)
+            if log_A.ndim == 3:
+                log_alpha, ll = forward_filter(log_pi, log_A, log_obs, mask)
+                z = backward_sample(k_z, log_alpha, log_A, mask)
+            else:
+                z, ll = ffbs_fused(k_z, log_pi, log_A, log_obs, mask)
             new = model.gibbs_update(k_par, z, data, params)
             # record the params that produced ll (the pre-update state
             # of this transition — the first recorded pair is the init,

@@ -62,17 +62,24 @@ class MultinomialHMM(BaseHMMModel):
             data.get("mask"),
         )
 
-    def gibbs_update(self, key, z, data, params=None):
+    def gibbs_update(self, key, z, data, params=None, trans_weight=None):
         """Conjugate parameter block for blocked Gibbs
         (`infer/gibbs.py`): with the model's flat Dirichlet(1) priors,
         p_1k | z ~ Dir(1 + 1[z_1]), A rows ~ Dir(1 + transition
-        counts), phi rows ~ Dir(1 + emission counts)."""
+        counts), phi rows ~ Dir(1 + emission counts).
+
+        ``trans_weight``: optional [T] per-step weight on the
+        transition counts (defaults to the mask) — the hook gated
+        subclasses use to weight transitions by destination
+        consistency."""
         from hhmm_tpu.infer.gibbs import emission_counts, transition_counts
 
         x = data["x"].astype(jnp.int32)
         mask = data.get("mask")
+        if trans_weight is None:
+            trans_weight = mask
         k1, k2, k3 = jax.random.split(key, 3)
-        n_trans = transition_counts(z, self.K, mask)
+        n_trans = transition_counts(z, self.K, trans_weight)
         c_emis = emission_counts(z, x, self.K, self.L, mask)
         return {
             "p_1k": jax.random.dirichlet(
@@ -103,6 +110,12 @@ class SemisupMultinomialHMM(MultinomialHMM):
     def build(self, params, data):
         return (*self._gated(params, data), data.get("mask"))
 
+    def _consistency(self, g):
+        """[T, K] destination group-consistency — single source of
+        truth for the gate, shared by the build factorization and the
+        Gibbs count weights."""
+        return g[:, None] == jnp.asarray(self.groups)[None, :]
+
     def _gated(self, params, data):
         """Shared (log_pi, log_A_t, log_obs) with the selected gating —
         single source of truth for loglik AND generated quantities.
@@ -117,7 +130,7 @@ class SemisupMultinomialHMM(MultinomialHMM):
         log_phi = safe_log(params["phi_k"])
         # one-hot matmul rather than a gather: MXU-matmul VJP (see build)
         log_obs = jax.nn.one_hot(x, self.L, dtype=log_phi.dtype) @ log_phi.T  # [T, K]
-        consistent = g[:, None] == jnp.asarray(self.groups)[None, :]  # [T, K]
+        consistent = self._consistency(g)
         return semisup_gate(
             safe_log(params["p_1k"]),
             safe_log(params["A_ij"]),
@@ -125,6 +138,28 @@ class SemisupMultinomialHMM(MultinomialHMM):
             consistent,
             self.gate_mode,
         )
+
+    # both gates are conjugate (see gibbs_update); infer/gibbs.py guard
+    gibbs_gate_modes = ("hard", "stan")
+
+    def gibbs_update(self, key, z, data, params=None):
+        """Conjugate block under either gate. Hard gate: an exact HMM —
+        the inherited counts apply unchanged. Stan gate
+        (`hmm-multinom-semisup.stan:42-44`): the pairwise factor is
+        ``A(z_{t-1}, z_t)^{1[z_t group-consistent at t]}``, so the
+        A-row sufficient statistic weights each transition by
+        destination consistency (inconsistent steps contribute a unit
+        factor). The t=1 ``log p_1k`` factor is ungated in the
+        reference (`:33-35`), so the p_1k conditional is the standard
+        Dir(1 + 1[z_1]); emissions are ungated in both modes."""
+        if self.gate_mode == "hard":
+            return super().gibbs_update(key, z, data, params)
+        g = data["g"].astype(jnp.int32)
+        mask = data.get("mask")
+        # index the build's own [T, K] gate matrix at the sampled path
+        cons = self._consistency(g)[jnp.arange(z.shape[0]), z].astype(jnp.float32)
+        w_trans = cons if mask is None else mask * cons
+        return super().gibbs_update(key, z, data, params, trans_weight=w_trans)
 
     def build_vg(self, params, data):
         """Hot-loop build: stan-mode group gating via gate keys (the vg

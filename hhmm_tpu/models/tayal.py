@@ -90,6 +90,14 @@ class TayalHHMM(BaseHMMModel):
         A = A.at[3, 2].set(1.0)
         return pi, A
 
+    @staticmethod
+    def _consistency(sign):
+        """[T, K] destination sign-consistency — the single source of
+        truth for the gate, shared by the build factorization and the
+        Gibbs count weights so the two cannot drift apart."""
+        up = jnp.asarray(_UP_STATES)
+        return jnp.where(sign[:, None] == UP, up[None, :], ~up[None, :])
+
     def _terms(self, params, x, sign):
         x = x.astype(jnp.int32)
         sign = sign.astype(jnp.int32)
@@ -99,9 +107,7 @@ class TayalHHMM(BaseHMMModel):
         # matmul (onehot^T @ d_obs) instead of an XLA scatter — the
         # scatter was the single most expensive op in the leapfrog chain
         log_obs = jax.nn.one_hot(x, self.L, dtype=log_phi.dtype) @ log_phi.T  # [T, K]
-        up = jnp.asarray(_UP_STATES)
-        consistent = jnp.where(sign[:, None] == UP, up[None, :], ~up[None, :])
-        return pi, A, log_obs, consistent
+        return pi, A, log_obs, self._consistency(sign)
 
     @staticmethod
     def _stan_pi(pi, sign):
@@ -148,27 +154,61 @@ class TayalHHMM(BaseHMMModel):
         ).astype(jnp.float32)  # [K]
         return sign, state_sign
 
+    # gibbs_update implements both gates (see below); advertised to
+    # infer/gibbs.py's guard
+    gibbs_gate_modes = ("hard", "stan")
+
     def gibbs_update(self, key, z, data, params=None):
         """Conjugate parameter block for blocked Gibbs
-        (`infer/gibbs.py`, ``gate_mode="hard"`` only): with the model's
-        flat priors, p_11 | z_1 ~ Beta(1 + 1[z_1=0], 1 + 1[z_1=2]);
-        the two free transition rows ~ Dir(1 + counts) restricted to
-        their support (0 → {1,2}, 2 → {0,3}); phi rows ~ Dir(1 +
-        emission counts). Rows 1→0 and 3→2 are deterministic."""
+        (`infer/gibbs.py`): with the model's flat priors every
+        conditional is Beta/Dirichlet.
+
+        ``gate_mode="hard"`` (exact HMM on strictly-alternating data):
+        p_11 | z_1 ~ Beta(1 + 1[z_1=0], 1 + 1[z_1=2]); the two free
+        transition rows ~ Dir(1 + counts) restricted to their support
+        (0 → {1,2}, 2 → {0,3}); phi rows ~ Dir(1 + emission counts).
+        Rows 1→0 and 3→2 are deterministic.
+
+        ``gate_mode="stan"`` (the reference's soft gate,
+        `hhmm-tayal2009.stan:46-70` — the semantics fit to real ticks):
+        the pairwise factor is ``A(z_{t-1}, z_t)^{c_t}`` with ``c_t =
+        1[z_t sign-consistent with sign_t]``, so a sign-inconsistent
+        step contributes a unit factor carrying no information about A
+        — the transition-count sufficient statistic is weighted by
+        destination consistency. Emission factors apply at every step
+        regardless of consistency (unchanged counts). The t=0 factor is
+        π[entry] only when z_0 equals the sign-matching entry state
+        (`hhmm-tayal2009.stan:50-54`): p_11 ~ Beta(1 + 1[sign_0=down,
+        z_0=0], 1 + 1[sign_0=up, z_0=2]). Exactness of this pair of
+        conditionals against the joint density is pinned by a
+        density-ratio test (tests/test_gibbs.py)."""
         from hhmm_tpu.infer.gibbs import emission_counts, transition_counts
 
         x = data["x"].astype(jnp.int32)
         mask = data.get("mask")
         k1, k2, k3, k4 = jax.random.split(key, 4)
-        n = transition_counts(z, self.K, mask)
+        if self.gate_mode == "hard":
+            w_trans = mask
+            p11_a = 1.0 + (z[0] == 0).astype(jnp.float32)
+            p11_b = 1.0 + (z[0] == 2).astype(jnp.float32)
+        else:
+            sign = data["sign"].astype(jnp.int32)
+            # index the build's own [T, K] gate matrix at the sampled path
+            cons = self._consistency(sign)[jnp.arange(z.shape[0]), z].astype(
+                jnp.float32
+            )
+            w_trans = cons if mask is None else mask * cons
+            p11_a = 1.0 + jnp.logical_and(sign[0] == DOWN, z[0] == _ENTRY_DOWN).astype(
+                jnp.float32
+            )
+            p11_b = 1.0 + jnp.logical_and(sign[0] == UP, z[0] == _ENTRY_UP).astype(
+                jnp.float32
+            )
+        n = transition_counts(z, self.K, w_trans)
         c_emis = emission_counts(z, x, self.K, self.L, mask)
         a0 = jax.random.dirichlet(k2, 1.0 + jnp.stack([n[0, 1], n[0, 2]]))
         a2 = jax.random.dirichlet(k3, 1.0 + jnp.stack([n[2, 0], n[2, 3]]))
-        p11 = jax.random.beta(
-            k1,
-            1.0 + (z[0] == 0).astype(jnp.float32),
-            1.0 + (z[0] == 2).astype(jnp.float32),
-        )
+        p11 = jax.random.beta(k1, p11_a, p11_b)
         return {
             "p_11": p11,
             "A_row": jnp.stack([a0, a2]),

@@ -46,10 +46,17 @@ class TestGuards:
                 GibbsConfig(num_warmup=1, num_samples=1),
             )
 
-    def test_rejects_stan_gate(self):
-        with pytest.raises(ValueError, match="hard"):
+    def test_rejects_undeclared_gate(self):
+        """A gated model whose gibbs_update does not declare the active
+        gate mode must be rejected (not-actually-conjugate combinations
+        fail loudly)."""
+
+        class HardOnlyTayal(TayalHHMM):
+            gibbs_gate_modes = ("hard",)
+
+        with pytest.raises(ValueError, match="gate_mode"):
             sample_gibbs(
-                TayalHHMM(gate_mode="stan"),
+                HardOnlyTayal(gate_mode="stan"),
                 {"x": np.zeros(10, np.int32), "sign": np.zeros(10, np.int32)},
                 jax.random.PRNGKey(0),
             )
@@ -138,6 +145,260 @@ class TestCrossSamplerAgreement:
         # recovery sanity on the same fit
         d = model.constrained_draws(qg.reshape(-1, qg.shape[-1]))
         np.testing.assert_allclose(np.asarray(d["mu_k"]).mean(0), mu, atol=0.35)
+
+
+def _nonalternating_tayal_data(rng, T=240, frac_same=0.3):
+    """Synthetic (x, sign) with ~``frac_same`` same-sign adjacent legs —
+    the real-tick regime (flat stretches restart a leg in the same
+    direction, `feature-extraction.R:27-29`) where the hard gate is
+    invalid and the stan soft gate is the semantics under test."""
+    model = TayalHHMM(gate_mode="hard")
+    phi = np.array(
+        [rng.dirichlet(np.ones(9) * c) for c in (0.4, 0.4, 0.4, 0.4)]
+    )
+    params = {
+        "p_11": jnp.asarray(0.6),
+        "A_row": jnp.asarray(rng.dirichlet(np.ones(2), size=2)),
+        "phi_k": jnp.asarray(phi),
+    }
+    pi, A = model.assemble(params)
+    z, x = hmm_sim(
+        jax.random.PRNGKey(int(rng.integers(1 << 30))),
+        T,
+        np.asarray(A),
+        np.asarray(pi),
+        obsmodel_categorical(phi),
+        validate=False,
+    )
+    sign = np.where(_UP_STATES[np.asarray(z)], UP, 1 - UP).astype(np.int32)
+    # inject same-sign restarts: copy the previous leg's sign at random
+    # interior positions
+    flip = rng.random(T) < frac_same
+    flip[0] = False
+    for t in np.flatnonzero(flip):
+        sign[t] = sign[t - 1]
+    assert (sign[1:] == sign[:-1]).mean() > 0.15
+    return np.asarray(x, np.int32), sign
+
+
+def _simplex64(v):
+    """f32 simplex -> f64 renormalized (scipy.stats.dirichlet enforces
+    sum == 1 beyond f32 round-off)."""
+    v = np.asarray(v, np.float64)
+    return v / v.sum()
+
+
+class TestStanGateConjugacy:
+    """Exactness of the soft-gate blocked Gibbs (the semantics fit to
+    real ticks): z | θ via enumeration, θ | z via density ratios."""
+
+    def _logjoint(self, model, params, z, data):
+        """log of the augmented joint factorization defined by
+        ``model.build`` (flat priors: constant in θ, cancels in
+        ratios)."""
+        log_pi, log_A, log_obs, _ = model.build(params, data)
+        log_A = np.asarray(log_A)
+        z = np.asarray(z)
+        lp = float(np.asarray(log_pi)[z[0]] + np.asarray(log_obs)[0, z[0]])
+        for t in range(1, len(z)):
+            A_t = log_A[t - 1] if log_A.ndim == 3 else log_A
+            lp += float(A_t[z[t - 1], z[t]] + np.asarray(log_obs)[t, z[t]])
+        return lp
+
+    def test_tayal_stan_theta_conditional_density_ratio(self, rng):
+        """For fixed z the claimed Beta/Dirichlet conditional must be
+        proportional to the joint: log-ratio in θ of the joint equals
+        the log-ratio of the conditional, for random θ pairs — an
+        exact (non-statistical) check of the consistency-weighted
+        sufficient statistics."""
+        from scipy.stats import beta as sp_beta, dirichlet as sp_dir
+
+        from hhmm_tpu.kernels.ffbs import backward_sample
+        from hhmm_tpu.kernels.filtering import forward_filter
+
+        model = TayalHHMM(gate_mode="stan")
+        x, sign = _nonalternating_tayal_data(rng)
+        data = {"x": jnp.asarray(x), "sign": jnp.asarray(sign)}
+        T = len(x)
+
+        def rand_params():
+            return {
+                "p_11": jnp.asarray(rng.uniform(0.1, 0.9)),
+                "A_row": jnp.asarray(rng.dirichlet(np.ones(2), size=2)),
+                "phi_k": jnp.asarray(rng.dirichlet(np.ones(9), size=4)),
+            }
+
+        def log_q(params, z):
+            """Independent re-derivation of the claimed conditional."""
+            cons = (sign == UP) == _UP_STATES[np.asarray(z)]
+            n = np.zeros((4, 4))
+            for t in range(1, T):
+                if cons[t]:
+                    n[z[t - 1], z[t]] += 1
+            c = np.zeros((4, 9))
+            for t in range(T):
+                c[z[t], x[t]] += 1
+            a = 1.0 + float(sign[0] == 1 and z[0] == 0)
+            b = 1.0 + float(sign[0] == 0 and z[0] == 2)
+            lq = sp_beta.logpdf(float(params["p_11"]), a, b)
+            Ar = np.asarray(params["A_row"])
+            lq += sp_dir.logpdf(_simplex64(Ar[0]), 1.0 + np.array([n[0, 1], n[0, 2]]))
+            lq += sp_dir.logpdf(_simplex64(Ar[1]), 1.0 + np.array([n[2, 0], n[2, 3]]))
+            phi = np.asarray(params["phi_k"])
+            for k in range(4):
+                lq += sp_dir.logpdf(_simplex64(phi[k]), 1.0 + c[k])
+            return lq
+
+        # z from FFBS at a reference θ: guarantees positive support
+        # under every θ (the sparse-A zero pattern is θ-independent)
+        p0 = rand_params()
+        log_pi, log_A_t, log_obs, _ = model.build(p0, data)
+        log_alpha, _ = forward_filter(log_pi, log_A_t, log_obs, None)
+        for i in range(3):
+            z = backward_sample(jax.random.PRNGKey(i), log_alpha, log_A_t, None)
+            t1, t2 = rand_params(), rand_params()
+            lhs = self._logjoint(model, t1, z, data) - self._logjoint(
+                model, t2, z, data
+            )
+            rhs = log_q(t1, z) - log_q(t2, z)
+            assert abs(lhs - rhs) < 5e-2, f"draw {i}: joint ratio {lhs} vs conditional ratio {rhs}"
+
+    def test_semisup_stan_theta_conditional_density_ratio(self, rng):
+        """Same exactness check for the semisup multinomial soft gate
+        (`hmm-multinom-semisup.stan:42-44`): ungated p_1k, consistency-
+        weighted transition counts."""
+        from scipy.stats import dirichlet as sp_dir
+
+        from hhmm_tpu.models import SemisupMultinomialHMM
+
+        K, L, T = 4, 5, 150
+        groups = np.array([0, 1, 1, 0], np.int32)
+        model = SemisupMultinomialHMM(K=K, L=L, groups=groups, gate_mode="stan")
+        x = rng.integers(0, L, T).astype(np.int32)
+        g = rng.integers(0, 2, T).astype(np.int32)
+        data = {"x": jnp.asarray(x), "g": jnp.asarray(g)}
+
+        def rand_params():
+            return {
+                "p_1k": jnp.asarray(rng.dirichlet(np.ones(K))),
+                "A_ij": jnp.asarray(rng.dirichlet(np.ones(K), size=K)),
+                "phi_k": jnp.asarray(rng.dirichlet(np.ones(L), size=K)),
+            }
+
+        def log_q(params, z):
+            cons = g == groups[np.asarray(z)]
+            n = np.zeros((K, K))
+            for t in range(1, T):
+                if cons[t]:
+                    n[z[t - 1], z[t]] += 1
+            c = np.zeros((K, L))
+            for t in range(T):
+                c[z[t], x[t]] += 1
+            lq = sp_dir.logpdf(
+                _simplex64(params["p_1k"]),
+                1.0 + np.eye(K)[int(z[0])],
+            )
+            for k in range(K):
+                lq += sp_dir.logpdf(_simplex64(np.asarray(params["A_ij"])[k]), 1.0 + n[k])
+                lq += sp_dir.logpdf(_simplex64(np.asarray(params["phi_k"])[k]), 1.0 + c[k])
+            return lq
+
+        for i in range(3):
+            z = rng.integers(0, K, T)  # full support: any z is valid here
+            t1, t2 = rand_params(), rand_params()
+            lhs = self._logjoint(model, t1, z, data) - self._logjoint(
+                model, t2, z, data
+            )
+            rhs = log_q(t1, z) - log_q(t2, z)
+            assert abs(lhs - rhs) < 5e-2, f"draw {i}: {lhs} vs {rhs}"
+
+    def test_gated_ffbs_matches_enumeration(self, rng):
+        """z | θ under the time-varying gated kernel: FFBS pairwise
+        frequencies must match the brute-force posterior over all 4^T
+        paths of the build's factorization."""
+        from itertools import product
+
+        from scipy.special import logsumexp as lse
+
+        from hhmm_tpu.kernels.ffbs import backward_sample
+        from hhmm_tpu.kernels.filtering import forward_filter
+
+        model = TayalHHMM(gate_mode="stan")
+        T = 6
+        x = rng.integers(0, 9, T).astype(np.int32)
+        sign = np.array([1, 0, 0, 1, 1, 0], np.int32)  # non-alternating
+        data = {"x": jnp.asarray(x), "sign": jnp.asarray(sign)}
+        params = {
+            "p_11": jnp.asarray(0.55),
+            "A_row": jnp.asarray(rng.dirichlet(np.ones(2), size=2)),
+            "phi_k": jnp.asarray(rng.dirichlet(np.ones(9), size=4)),
+        }
+        log_pi, log_A_t, log_obs, _ = model.build(params, data)
+        lp_np, lA_np, lo_np = map(np.asarray, (log_pi, log_A_t, log_obs))
+        logp = {}
+        for path in product(range(4), repeat=T):
+            lp = lp_np[path[0]] + lo_np[0, path[0]]
+            for t in range(1, T):
+                lp += lA_np[t - 1, path[t - 1], path[t]] + lo_np[t, path[t]]
+            if np.isfinite(lp):
+                logp[path] = lp
+        total = lse(np.array(list(logp.values())))
+        pair = np.zeros((4, 4))
+        for path, lp in logp.items():
+            pair[path[2], path[3]] += np.exp(lp - total)
+
+        log_alpha, _ = forward_filter(log_pi, log_A_t, log_obs, None)
+        n = 8000
+        paths = np.asarray(
+            jax.vmap(lambda k: backward_sample(k, log_alpha, log_A_t, None))(
+                jax.random.split(jax.random.PRNGKey(2), n)
+            )
+        )
+        emp = np.zeros((4, 4))
+        for a in range(4):
+            for b in range(4):
+                emp[a, b] = np.mean((paths[:, 2] == a) & (paths[:, 3] == b))
+        np.testing.assert_allclose(emp, pair, atol=0.03)
+
+    def test_gibbs_matches_chees_on_stan_gate(self, rng):
+        """Cross-sampler agreement on the soft-gate density with
+        non-alternating data — the pair (z|θ exact FFBS, θ|z conjugate)
+        must target the same posterior the HMC samplers integrate."""
+        from hhmm_tpu.infer import ChEESConfig, sample_chees
+
+        model = TayalHHMM(gate_mode="stan")
+        x, sign = _nonalternating_tayal_data(rng, T=300)
+        data = {"x": jnp.asarray(x), "sign": jnp.asarray(sign)}
+
+        def canon(qs):
+            """Per-draw pair-swap fold (states (0,1,2,3)->(3,2,1,0)) —
+            an EMPIRICAL mode fold, not an exact likelihood symmetry
+            (the sparse A is asymmetric under it; see bench.py). Any
+            fixed measurable function of draws is a valid agreement
+            statistic; the fold just merges the near-symmetric modes to
+            cut MC variance. Orient by the two up-leg rows' first
+            symbol."""
+            d = model.constrained_draws(qs.reshape(-1, qs.shape[-1]))
+            phi = np.asarray(d["phi_k"])
+            swap = phi[:, 1, 0] < phi[:, 2, 0]
+            phi_c = np.where(swap[:, None, None], phi[:, [3, 2, 1, 0], :], phi)
+            Ar = np.asarray(d["A_row"])
+            Ar_c = np.where(swap[:, None, None], Ar[:, [1, 0], :], Ar)
+            return np.concatenate([phi_c.mean(0).ravel(), Ar_c.mean(0).ravel()])
+
+        qg, sg = sample_gibbs(
+            model, data, jax.random.PRNGKey(0),
+            GibbsConfig(num_warmup=300, num_samples=1200, num_chains=2),
+        )
+        qc, _ = sample_chees(
+            model.make_logp(data),
+            jax.random.PRNGKey(3),
+            init_chains(model, jax.random.PRNGKey(1), data, 8),
+            ChEESConfig(num_warmup=400, num_samples=400, num_chains=8,
+                        max_leapfrogs=32),
+        )
+        assert np.isfinite(np.asarray(sg["logp"])).all()
+        np.testing.assert_allclose(canon(qg), canon(qc), atol=0.06)
 
 
 class TestSBCGibbs:
