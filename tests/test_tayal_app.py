@@ -345,3 +345,58 @@ class TestPipeline:
             assert set(r.trades) == {0, 1, 2, 3, 4, 5}
             assert np.isfinite(r.bnh).all()
             assert r.diverged < 0.5
+
+
+class TestPerDrawRelabel:
+    def test_matches_chainwise_analytics_per_draw(self):
+        """`per_draw_relabel_stats` must reproduce, draw by draw, the
+        numpy analytics chain (topstate_runs + relabel_by_return) run on
+        the SAME FFBS path — the registered protocol's per-draw swap is
+        exactly Tayal's ex-post rule, not an approximation of it."""
+        import jax
+        import jax.numpy as jnp
+
+        from hhmm_tpu.apps.tayal.replication import per_draw_relabel_stats
+        from hhmm_tpu.kernels.ffbs import backward_sample
+        from hhmm_tpu.kernels.filtering import forward_filter
+        from hhmm_tpu.models import TayalHHMMLite
+
+        rng = np.random.default_rng(3)
+        price, size, t, _ = simulate_ticks(rng, n_legs=220)
+        zig = extract_features(price, size, t)
+        x, sign = to_model_inputs(zig.feature)
+        n_ins = len(zig) - 30
+        data = {"x": jnp.asarray(x[:n_ins]), "sign": jnp.asarray(sign[:n_ins])}
+        model = TayalHHMMLite(gate_mode="stan")
+
+        # a handful of dispersed draws (random unconstrained points are
+        # fine: the test is about the relabel rule, not the posterior)
+        N = 6
+        draws = np.stack(
+            [
+                np.asarray(model.init_unconstrained(k, data))
+                for k in jax.random.split(jax.random.PRNGKey(5), N)
+            ]
+        )
+        key = jax.random.PRNGKey(11)
+        got = per_draw_relabel_stats(
+            model, draws, data, zig.start[:n_ins], zig.end[:n_ins], price, key
+        )
+
+        # replay the identical FFBS keys and run the numpy analytics
+        ks = jax.random.split(jax.random.fold_in(key, 0), N)
+        for j in range(N):
+            params, _ = model.unpack(jnp.asarray(draws[j]))
+            log_pi, log_A, log_obs, _ = model.build(params, data)
+            log_alpha, ll = forward_filter(log_pi, log_A, log_obs, None)
+            z = np.asarray(backward_sample(ks[j], log_alpha, log_A, None))
+            top = map_to_topstate(z)
+            runs = topstate_runs(top, zig.start[:n_ins], zig.end[:n_ins], price)
+            _, _, swapped = relabel_by_return(runs, top)
+            assert bool(got["swapped"][j]) == bool(swapped), f"draw {j}"
+            phi = np.asarray(params["phi_k"])
+            if swapped:
+                phi = phi[[3, 2, 1, 0], :]
+            np.testing.assert_allclose(got["phi_45"][j], phi[3, 4], rtol=1e-5)
+            np.testing.assert_allclose(got["phi_25"][j], phi[1, 4], rtol=1e-5)
+            np.testing.assert_allclose(got["ll"][j], float(ll), rtol=1e-5)
