@@ -80,10 +80,18 @@ _GEN_JIT_CACHE: Dict = {}
 def _model_config_key(model):
     items = []
     for k, v in sorted(vars(model).items()):
-        if isinstance(v, (int, float, str, bool, tuple)):
+        if v is None or isinstance(v, (int, float, str, bool, tuple)):
             items.append((k, v))
         elif isinstance(v, (np.ndarray, jnp.ndarray)):
             items.append((k, np.asarray(v).tobytes()))
+        else:
+            # aliasing two configs onto one jitted closure must fail
+            # loudly, not silently reuse the first model's semantics
+            raise TypeError(
+                f"cannot key the generated-pass jit cache on "
+                f"{type(model).__name__}.{k} of type {type(v).__name__}; "
+                "add a hashable encoding here or bypass _generated_jit"
+            )
     return (type(model).__name__, tuple(items))
 
 

@@ -290,7 +290,7 @@ def wf_trade(
     G_DEC = 8  # tasks per decode dispatch (bounds device memory)
     dcache = ResultCache(cache_dir) if cache_dir is not None else None
     leg_states: List[Optional[np.ndarray]] = [None] * B
-    meta = []  # per-task (n_ins, n_oos, b_ins, b_oos, keep, draws_thin, dk)
+    meta = []  # per-task (n_ins, n_oos, b_ins, b_oos, keep, draws_thin, dk, n_uniq)
     pend: Dict[tuple, List[int]] = {}
     for i, (task, (zig, x, sign, n_ins)) in enumerate(zip(tasks, feats)):
         n_oos = len(x) - n_ins
@@ -304,20 +304,23 @@ def wf_trade(
         draws = np.asarray(qs[i])[keep].reshape(-1, qs[i].shape[-1])
         sel = np.linspace(0, len(draws) - 1, min(D_DEC, len(draws))).astype(int)
         draws_t = draws[sel]
-        if len(draws_t) < D_DEC:  # repeat-pad tiny posteriors to fixed D
-            draws_t = draws_t[np.arange(D_DEC) % len(draws_t)]
+        n_uniq = len(draws_t)
+        if n_uniq < D_DEC:  # repeat-pad tiny posteriors to fixed D;
+            # the median is later taken over the first n_uniq rows only,
+            # so padding never changes the statistic vs decode_states
+            draws_t = draws_t[np.arange(D_DEC) % n_uniq]
         dk = None
         if dcache is not None:
             dk = digest_key(
-                {"stage": "wf-decode-v2", "gate_mode": gate_mode},
+                {"stage": "wf-decode-v3", "gate_mode": gate_mode},
                 {"x": x, "sign": sign},
-                {"n_ins": n_ins},
+                {"n_ins": n_ins, "n_uniq": n_uniq},
                 draws_t,
             )
             hit = dcache.get(dk)
             if hit is not None:
                 leg_states[i] = np.asarray(hit["leg_state"])
-        meta.append((n_ins, n_oos, b_ins, b_oos, keep, draws_t, dk))
+        meta.append((n_ins, n_oos, b_ins, b_oos, keep, draws_t, dk, n_uniq))
         if leg_states[i] is None:
             pend.setdefault((b_ins, b_oos), []).append(i)
 
@@ -361,12 +364,12 @@ def wf_trade(
             alpha = np.asarray(out["alpha"])  # [G, D, b_ins, K]
             alpha_o = np.asarray(out["alpha_oos"])
             for li, j in enumerate(grp):
-                n_ins_j, n_oos_j = meta[j][0], meta[j][1]
+                n_ins_j, n_oos_j, n_uniq_j = meta[j][0], meta[j][1], meta[j][7]
                 ins_state = np.argmax(
-                    np.median(alpha[li], axis=0), axis=-1
+                    np.median(alpha[li][:n_uniq_j], axis=0), axis=-1
                 )[:n_ins_j]
                 oos_state = np.argmax(
-                    np.median(alpha_o[li], axis=0), axis=-1
+                    np.median(alpha_o[li][:n_uniq_j], axis=0), axis=-1
                 )[:n_oos_j]
                 leg_states[j] = np.concatenate([ins_state, oos_state])
                 if meta[j][6] is not None:

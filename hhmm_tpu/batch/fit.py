@@ -257,10 +257,13 @@ def fit_batched(
                         run(chunk_data, chunk_init, chunk_keys, chunk_w)
                     )
                     break
-                except Exception as e:  # UNAVAILABLE surfaces as
-                    # JaxRuntimeError OR ValueError depending on where
-                    # in the dispatch the fault lands
-                    if "UNAVAILABLE" not in repr(e) or attempt == 3:
+                except (jax.errors.JaxRuntimeError, ValueError) as e:
+                    # device faults surface as JaxRuntimeError OR a
+                    # ValueError wrapper depending on where in the
+                    # dispatch the fault lands; match the canonical
+                    # XLA status prefix so a deterministic error that
+                    # merely mentions the token is not retried
+                    if "UNAVAILABLE:" not in str(e) or attempt == 3:
                         raise
                     import time as _time
 
