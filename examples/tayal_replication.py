@@ -369,24 +369,58 @@ def run_registered(args) -> Dict:
     from hhmm_tpu.infer import ChEESConfig, GibbsConfig, sample_gibbs
     from hhmm_tpu.models import TayalHHMMLite
 
+    from hhmm_tpu.batch import ResultCache, digest_key
+
     price, size, t, ins_end, span = _load_gto_window(args.window)
     model = TayalHHMMLite()  # gate_mode="stan"
+    # per-piece result cache: the device tunnel dies ~10 min after
+    # connect, so the stage must be resumable piecewise (rerun the
+    # driver until it completes — the reference's RDS-cache discipline,
+    # `tayal2009/main.R:91-112`)
+    cache = ResultCache(args.cache_dir)
 
     # ---- primary arm: 4 restarts x 8 ChEES chains, ML-weighted ----
     cfg = ChEESConfig(num_warmup=400, num_samples=250, num_chains=8,
                       max_leapfrogs=args.max_leapfrogs)
     phis, per_chain, mlls = [], [], []
     for rs in range(4):
-        res_r = run_window(
-            price, size, t, ins_end, config=cfg,
-            key=jax.random.PRNGKey(9100 + rs),
+        ck = digest_key(
+            {"stage": "registered-chees-v1", "window": span, "restart": rs}
         )
-        p_r, pc_r, _ = _relabeled_phis(model, res_r, price, res_r.zig)
-        n_ins = res_r.n_ins_legs
-        x, sign = to_model_inputs(res_r.zig.feature)
-        data_ins = {"x": jnp.asarray(x[:n_ins]), "sign": jnp.asarray(sign[:n_ins])}
-        mll_r = chain_marginal_ll(model, res_r.samples, data_ins)
-        phis += p_r
+        hit = cache.get(ck)
+        if hit is not None:
+            pc_r = [
+                {
+                    "swapped": bool(hit["swapped"][c]),
+                    "phi_45": float(hit["phi_45"][c]),
+                    "phi_25": float(hit["phi_25"][c]),
+                    "mean_logp": float(hit["mean_logp"][c]),
+                }
+                for c in range(len(hit["phi_45"]))
+            ]
+            mll_r = np.asarray(hit["mll"])
+        else:
+            res_r = run_window(
+                price, size, t, ins_end, config=cfg,
+                key=jax.random.PRNGKey(9100 + rs),
+            )
+            _, pc_r, _ = _relabeled_phis(model, res_r, price, res_r.zig)
+            n_ins = res_r.n_ins_legs
+            x, sign = to_model_inputs(res_r.zig.feature)
+            data_ins = {
+                "x": jnp.asarray(x[:n_ins]), "sign": jnp.asarray(sign[:n_ins])
+            }
+            mll_r = chain_marginal_ll(model, res_r.samples, data_ins)
+            cache.put(
+                ck,
+                {
+                    "swapped": np.array([pc["swapped"] for pc in pc_r]),
+                    "phi_45": np.array([pc["phi_45"] for pc in pc_r]),
+                    "phi_25": np.array([pc["phi_25"] for pc in pc_r]),
+                    "mean_logp": np.array([pc["mean_logp"] for pc in pc_r]),
+                    "mll": mll_r,
+                },
+            )
         per_chain += [
             {**pc, "restart": rs, "mll": float(m)} for pc, m in zip(pc_r, mll_r)
         ]
@@ -402,16 +436,39 @@ def run_registered(args) -> Dict:
     )
 
     # ---- corroboration arm: soft-gate conjugate Gibbs ----
+    # run as 2 cached segments of 3,000 draws (segment 1 resumes from
+    # segment 0's final params — the same chain, tunnel-survivable);
+    # total budget matches the registered 1,000 + 6,000 x 16
     zig = extract_features(price, size, t)
     x, sign = to_model_inputs(zig.feature)
     ins = zig.end <= ins_end
     n_ins = int(ins.sum())
     data_ins = {"x": jnp.asarray(x[:n_ins]), "sign": jnp.asarray(sign[:n_ins])}
-    qs, stats = sample_gibbs(
-        model, data_ins, jax.random.PRNGKey(9200),
-        GibbsConfig(num_warmup=1000, num_samples=6000, num_chains=16),
-    )
-    kept = np.asarray(qs)[:, ::4]  # thin x4 -> 1500/chain
+    segs = []
+    init_q = None
+    for seg in range(2):
+        ck = digest_key(
+            {"stage": "registered-gibbs-v1", "window": span, "seg": seg}
+        )
+        hit = cache.get(ck)
+        if hit is not None:
+            qs_s, lp_s = hit["samples"], hit["logp"]
+        else:
+            qs_s, st_s = sample_gibbs(
+                model, data_ins, jax.random.PRNGKey(9200 + seg),
+                GibbsConfig(
+                    num_warmup=1000 if seg == 0 else 1,
+                    num_samples=3000, num_chains=16,
+                ),
+                init_q=init_q,
+            )
+            qs_s, lp_s = np.asarray(qs_s), np.asarray(st_s["logp"])
+            cache.put(ck, {"samples": qs_s, "logp": lp_s})
+        segs.append((np.asarray(qs_s), np.asarray(lp_s)))
+        init_q = jnp.asarray(segs[-1][0][:, -1])
+    qs = np.concatenate([s[0] for s in segs], axis=1)  # [16, 6000, dim]
+    lp_g = np.concatenate([s[1] for s in segs], axis=1)
+    kept = qs[:, ::4]  # thin x4 -> 1500/chain
     C, D, dim = kept.shape
     pd = per_draw_relabel_stats(
         model, kept.reshape(-1, dim), data_ins,
@@ -429,12 +486,10 @@ def run_registered(args) -> Dict:
         "frac_swapped": float(pd["swapped"].mean()),
         "per_chain_phi_45": np.round(p45.mean(axis=1), 4).tolist(),
         "per_chain_phi_25": np.round(p25.mean(axis=1), 4).tolist(),
-        "chain_mean_ll": np.round(
-            np.asarray(stats["logp"])[:, ::4].mean(axis=1), 1
-        ).tolist(),
+        "chain_mean_ll": np.round(lp_g[:, ::4].mean(axis=1), 1).tolist(),
         "kept_draws": int(C * D),
         "config": {"chains": 16, "warmup": 1000, "samples": 6000, "thin": 4,
-                   "seed": 9200},
+                   "seed": 9200, "segments": 2},
     }
 
     # ---- fixed decision rule (`docs/phi_protocol.md`) ----

@@ -164,6 +164,62 @@ class TestFitBatched:
         mu_p = np.asarray(model.constrained_draws(qs_plain)["mu_k"]).mean(axis=(1, 2))
         np.testing.assert_allclose(mu_s, mu_p, atol=0.25)
 
+    @pytest.mark.parametrize("gate_mode", ["hard", "stan"])
+    def test_mesh_sharded_gibbs(self, gate_mode):
+        """Conjugate Gibbs — the bench default sampler — over the
+        'series' mesh (VERDICT r3 #3): sharded draws must equal the
+        single-device draws (per-series computation is independent and
+        keyed identically; only the device layout differs). Covers both
+        the homogeneous-kernel path (hard gate) and the time-varying
+        soft-gate scan path (stan)."""
+        from jax.sharding import Mesh
+
+        from hhmm_tpu.infer import GibbsConfig
+        from hhmm_tpu.models import TayalHHMM
+        from hhmm_tpu.models.tayal import _UP_STATES
+        from hhmm_tpu.sim import obsmodel_categorical
+
+        devices = jax.devices()
+        if len(devices) < 8:
+            pytest.skip("needs 8 virtual devices")
+        rng = np.random.default_rng(2)
+        model = TayalHHMM(gate_mode=gate_mode)
+        A = np.array(
+            [[0.0, 0.4, 0.6, 0.0], [1.0, 0.0, 0.0, 0.0],
+             [0.3, 0.0, 0.0, 0.7], [0.0, 0.0, 1.0, 0.0]]
+        )
+        p1 = np.array([0.5, 0.0, 0.5, 0.0])
+        B, T = 8, 160
+        xs, signs = [], []
+        for i in range(B):
+            phi = rng.dirichlet(np.ones(9), size=4)
+            z, x = hmm_sim(
+                jax.random.PRNGKey(100 + i), T, A, p1,
+                obsmodel_categorical(phi), validate=False,
+            )
+            sign = np.where(_UP_STATES[np.asarray(z)], 0, 1).astype(np.int32)
+            if gate_mode == "stan":
+                # soft gate is the real-tick semantics: inject
+                # same-sign restarts so the time-varying kernel is
+                # actually exercised
+                for t in np.flatnonzero(rng.random(T) < 0.3)[1:]:
+                    sign[t] = sign[t - 1]
+            xs.append(np.asarray(x, np.int32))
+            signs.append(sign)
+        data = {"x": np.stack(xs), "sign": np.stack(signs)}
+        cfg = GibbsConfig(num_warmup=10, num_samples=25, num_chains=2)
+        mesh = Mesh(np.asarray(devices[:8]).reshape(8, 1)[:, 0], ("series",))
+        qs_sharded, st_s = fit_batched(
+            model, data, jax.random.PRNGKey(0), cfg, chunk_size=8, mesh=mesh
+        )
+        qs_plain, st_p = fit_batched(
+            model, data, jax.random.PRNGKey(0), cfg, chunk_size=8
+        )
+        assert np.isfinite(np.asarray(st_s["logp"])).all()
+        np.testing.assert_allclose(
+            np.asarray(qs_sharded), np.asarray(qs_plain), rtol=1e-5, atol=1e-5
+        )
+
     def test_warm_start_init(self):
         """Explicit init (walk-forward warm start) is honored."""
         T = 150
