@@ -32,6 +32,7 @@ import numpy as np
 
 __all__ = [
     "chain_marginal_ll",
+    "degenerate_mode_probe",
     "ml_weighted_pool",
     "per_draw_relabel_stats",
 ]
@@ -44,16 +45,21 @@ _PAIR_SWAP = jnp.array([3, 2, 1, 0])
 
 
 def chain_marginal_ll(model, samples, data, n_draws: int = 64) -> np.ndarray:
-    """Per-chain mean marginal log-likelihood over ``n_draws`` evenly
-    thinned draws — the chain weight statistic of the registered
-    protocol (same statistic as bench.py's agreement machinery; with
-    the model family's flat priors the posterior log-density IS the
-    marginal log-likelihood p(x|θ))."""
+    """Per-chain mean marginal log-likelihood p(x|θ) over ``n_draws``
+    evenly thinned draws — the chain weight statistic of the registered
+    protocol (same statistic as bench.py's agreement machinery:
+    ``model.loglik`` on the CONSTRAINED params, NOT ``make_logp``,
+    whose unconstrained-space value adds the bijector log-Jacobian —
+    ~-160 nats at these simplex concentrations, enough to reorder
+    chains; the first registered run shipped with that bug and was
+    re-pooled after the fix, documented in docs/phi_protocol.md)."""
     samples = np.asarray(samples)
     C, D, dim = samples.shape
     sel = np.linspace(0, D - 1, min(n_draws, D)).astype(int)
     flat = jnp.asarray(samples[:, sel].reshape(-1, dim))
-    lls = jax.jit(jax.vmap(model.make_logp(data)))(flat)
+    lls = jax.jit(
+        jax.vmap(lambda q: model.loglik(model.unpack(q)[0], data))
+    )(flat)
     return np.asarray(lls).reshape(C, len(sel)).mean(axis=1)
 
 
@@ -78,6 +84,50 @@ def ml_weighted_pool(per_chain: Dict[str, np.ndarray], mll: np.ndarray) -> Dict:
     out["top_chain_share"] = float(w.max())
     out["top_chain"] = int(w.argmax())
     return out
+
+
+def degenerate_mode_probe(model, theta, data, key: jax.Array) -> Dict:
+    """Evidence block for the soft-gate EMISSION-ONLY degenerate mode
+    (reference defect #8, discovered round 4 by the exact Gibbs
+    sampler).
+
+    The reference's gated forward pass
+    (`hhmm-tayal2009.stan:57-66`) adds the ``log A_ij`` transition
+    factor ONLY when the destination state is sign-consistent; an
+    inconsistent destination contributes its emission term with a UNIT
+    transition factor — including transitions whose A entry is a
+    structural zero. A path that stays sign-inconsistent therefore
+    pays no transition penalty at all, and on real tick data (~1/3
+    same-sign adjacent legs, but the track is open on alternating
+    steps too) the posterior mass concentrates on this track: higher
+    marginal "likelihood", no regime structure. A single Stan/HMC
+    chain initialized in the intended basin never finds it — the
+    published φ̂ spot-checks are conditional on that basin.
+
+    Returns the diagnostics that pin the story for one draw ``theta``:
+    the fraction of FFBS path steps that are sign-consistent (intended
+    mode ≈ 1.0; degenerate mode ≪ 0.5), state occupancy, the pure
+    marginal loglik, and the log-Jacobian (the quantity whose omission
+    vs inclusion reorders chains between loglik and HMC-target
+    rankings)."""
+    from hhmm_tpu.kernels.ffbs import backward_sample
+    from hhmm_tpu.kernels.filtering import forward_filter
+    from hhmm_tpu.models.tayal import _UP_STATES as up_states
+
+    sign = np.asarray(data["sign"])
+    params, ldj = model.unpack(jnp.asarray(theta))
+    log_pi, log_A, log_obs, _ = model.build(params, data)
+    log_alpha, ll = forward_filter(log_pi, log_A, log_obs, None)
+    z = np.asarray(backward_sample(key, log_alpha, log_A, None))
+    consistent = (sign == 0) == up_states[z]
+    return {
+        "path_sign_consistency": round(float(consistent.mean()), 4),
+        "state_occupancy": np.round(
+            np.bincount(z, minlength=4) / len(z), 4
+        ).tolist(),
+        "pure_loglik": round(float(ll), 1),
+        "log_jacobian": round(float(ldj), 1),
+    }
 
 
 def per_draw_relabel_stats(

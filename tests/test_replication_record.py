@@ -149,3 +149,93 @@ class TestPublishedParamsDecode:
         for lag in range(6):
             got = self._compound_pct(lw.trades[lag].ret)
             assert abs(got - PUB_T5_0511[1 + lag]) < 1.5, (lag, got)
+
+
+class TestDegenerateModeEvidence:
+    """Reference defect #8 (round 4): the soft gate's emission-only
+    track must remain demonstrable on the real window — the structural
+    fact behind the registered protocol's split headline
+    (`docs/phi_protocol.md`). Deterministic: one FFBS decode per θ, no
+    MCMC."""
+
+    def test_emission_only_track_dominates_published_mode(self, rmd_window):
+        """Three facts that pin the defect, all at fixed θ (no MCMC):
+
+        1. Under the soft gate the PATH posterior rides the
+           transition-free inconsistent track at ANY θ — even the
+           published posterior-mean θ decodes mostly inconsistent
+           (hard-gating the same θ forces consistency 1.0, at a
+           catastrophic loglik on this non-alternating data — the
+           known hard-gate invalidity).
+        2. In θ-space, a maximally sign-AGNOSTIC θ (every state emits
+           the pooled symbol frequencies — zero regime structure)
+           out-scores the published θ by >100 nats on the model's own
+           likelihood: the θ posterior is pulled away from the
+           published configuration.
+        3. The decode stays top-state-meaningful anyway: inconsistent
+           destinations still belong to the correct bear/bull PAIR,
+           which is why the trading tables replicate while the raw
+           emission coordinates depend on sampler provenance."""
+        import jax
+        import jax.numpy as jnp
+
+        from hhmm_tpu.apps.tayal.features import to_model_inputs
+        from hhmm_tpu.apps.tayal.replication import degenerate_mode_probe
+        from hhmm_tpu.models import TayalHHMMLite
+
+        price, size, t, ins_end, zig = rmd_window
+        x, sign = to_model_inputs(zig.feature)
+        ins = zig.end <= ins_end
+        n_ins = int(ins.sum())
+        data = {"x": jnp.asarray(x[:n_ins]), "sign": jnp.asarray(sign[:n_ins])}
+        model = TayalHHMMLite()
+
+        # published-mode θ (main.pdf Table 8 means)
+        pub = model.pack(
+            {
+                "p_11": jnp.asarray(PUB_PI1),
+                "A_row": jnp.asarray(PUB_A, jnp.float32),
+                "phi_k": jnp.asarray(PUB_PHI / PUB_PHI.sum(1, keepdims=True)),
+            }
+        )
+        probe_pub = degenerate_mode_probe(model, pub, data, jax.random.PRNGKey(0))
+
+        # sign-agnostic θ: every state emits the EMPIRICAL pooled symbol
+        # frequencies — no regime structure at all
+        freq = np.bincount(x[:n_ins], minlength=9) + 1.0
+        freq = freq / freq.sum()
+        agn = model.pack(
+            {
+                "p_11": jnp.asarray(0.5),
+                "A_row": jnp.full((2, 2), 0.5),
+                "phi_k": jnp.asarray(np.tile(freq, (4, 1)), jnp.float32),
+            }
+        )
+        probe_agn = degenerate_mode_probe(model, agn, data, jax.random.PRNGKey(1))
+
+        # fact 1: the free track dominates the path posterior at any θ
+        assert probe_pub["path_sign_consistency"] < 0.5
+        assert probe_agn["path_sign_consistency"] < 0.5
+        hard = degenerate_mode_probe(
+            TayalHHMMLite(gate_mode="hard"), pub, data, jax.random.PRNGKey(2)
+        )
+        assert hard["path_sign_consistency"] == 1.0
+        assert hard["pure_loglik"] < probe_pub["pure_loglik"] - 10_000.0
+        # fact 2: the defect in one inequality — no regime structure
+        # beats the published structure on the model's own likelihood
+        assert probe_agn["pure_loglik"] > probe_pub["pure_loglik"] + 100.0
+
+    def test_registered_record_is_coherent(self):
+        """The committed registered block: headline scope documented,
+        Gibbs in the degenerate mode, investigation fields present."""
+        import json
+
+        path = os.path.join(
+            os.path.dirname(__file__), "..", "results", "tayal_replication.json"
+        )
+        with open(path) as f:
+            reg = json.load(f)["registered"]
+        assert "basin" in reg["headline"]["scope"]
+        assert reg["investigation"]["gibbs_mode_probe"]["path_sign_consistency"] < 0.5
+        assert reg["gibbs_crosscheck"]["phi_45"] < 0.6  # degenerate mode
+        assert 0.7 <= reg["headline"]["phi_45"] <= 0.95  # intended basin
