@@ -339,12 +339,22 @@ class TestPipeline:
 
         cfg = SamplerConfig(num_warmup=60, num_samples=60, num_chains=2,
                             max_treedepth=5)
-        warm = wf_trade(tayal_wf_tasks, config=cfg, chunk_size=4, warm_start=True)
+        phases = {}
+        warm = wf_trade(
+            tayal_wf_tasks, config=cfg, chunk_size=4, warm_start=True,
+            phase_timings=phases,
+        )
         assert len(warm) == len(tayal_wf_tasks)
         for r in warm:
             assert set(r.trades) == {0, 1, 2, 3, 4, 5}
             assert np.isfinite(r.bnh).all()
             assert r.diverged < 0.5
+        # the profiling surface: every phase present and positive
+        assert set(phases) == {
+            "features", "pilot_fit", "fit", "decode", "host_trading"
+        }
+        assert all(v >= 0 for v in phases.values())
+        assert phases["fit"] > 0
 
 
 class TestPerDrawRelabel:
