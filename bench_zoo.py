@@ -112,11 +112,26 @@ def _time_fit(model, data, config, key, fused_traj=False):
                 return sample_chees(None, key, theta0, config, jit=False, vg_fn=vg)
 
     else:
-        vg = model.make_vg(data)
         theta0 = model.init_unconstrained(jax.random.PRNGKey(7), data)
 
+        # NUTS runs as a 1-series vmapped batch: the semantically
+        # identical UNBATCHED form (NUTS while_loop over the unbatched
+        # Pallas vg) trips a reproducible TPU compile fault on the
+        # current tunnel toolchain (3/3 attempts, round 4), while the
+        # vmapped form — the same program every batched driver uses —
+        # compiles and runs at the same per-fit cost (measured 3.81 s
+        # vs the r3 record's 3.74 s for tayal)
         def run(key):
-            return sample_nuts(None, key, theta0, config, jit=False, vg_fn=vg)
+            def one(qi, ki):
+                vg = model.make_vg(data)
+                qs, stats = sample_nuts(None, ki, qi, config, jit=False, vg_fn=vg)
+                # only the stats _time_fit reads: the full stats pytree
+                # (energies, accept probs, ...) both bloats transfers
+                # and has been implicated in the tunnel compile fault
+                return qs, {"logp": stats["logp"], "diverging": stats["diverging"]}
+
+            qs, stats = jax.vmap(one)(theta0[None], key[None])
+            return qs[0], {k: v[0] for k, v in stats.items()}
 
     runj = jax.jit(run)
     jax.block_until_ready(runj(jax.random.PRNGKey(999)))  # compile
