@@ -343,7 +343,22 @@ def wf_trade(
         if leg_states[i] is None:
             pend.setdefault((b_ins, b_oos), []).append(i)
 
-    gen_fn = jax.jit(jax.vmap(model.generated))
+    # Device-side median-α classification: the generated pass's full
+    # probability stacks ([G, D, T, K] f32 ≈ 250 MB/dispatch) dominated
+    # the decode phase as host-transfer time through the device tunnel;
+    # reducing to hard states on device ships [G, T] int32 instead
+    # (~400x less). The host fallback below keeps the exact
+    # unique-draw-count median semantics for under-filled tasks
+    # (n_uniq < D_DEC — only possible when basin selection keeps
+    # almost no draws).
+    def _gen_median_states(samples, data):
+        out = jax.vmap(model.generated)(samples, data)
+        ins = jnp.argmax(jnp.median(out["alpha"], axis=1), axis=-1)
+        oos = jnp.argmax(jnp.median(out["alpha_oos"], axis=1), axis=-1)
+        return ins, oos
+
+    gen_med_fn = jax.jit(_gen_median_states)
+    gen_fn = jax.jit(jax.vmap(model.generated))  # under-filled fallback
     for (b_ins, b_oos), idxs in pend.items():
         for c0 in range(0, len(idxs), G_DEC):
             grp = idxs[c0 : c0 + G_DEC]
@@ -376,10 +391,19 @@ def wf_trade(
                 ),
             }
             samples_g = np.stack([meta[j][5] for j in grp_fit])
-            out = gen_fn(
-                jnp.asarray(samples_g),
-                {k: jnp.asarray(v) for k, v in data_g.items()},
-            )
+            data_dev = {k: jnp.asarray(v) for k, v in data_g.items()}
+            if all(meta[j][7] == D_DEC for j in grp):
+                ins_s, oos_s = gen_med_fn(jnp.asarray(samples_g), data_dev)
+                ins_s, oos_s = np.asarray(ins_s), np.asarray(oos_s)
+                for li, j in enumerate(grp):
+                    n_ins_j, n_oos_j = meta[j][0], meta[j][1]
+                    leg_states[j] = np.concatenate(
+                        [ins_s[li][:n_ins_j], oos_s[li][:n_oos_j]]
+                    )
+                    if meta[j][6] is not None:
+                        dcache.put(meta[j][6], {"leg_state": leg_states[j]})
+                continue
+            out = gen_fn(jnp.asarray(samples_g), data_dev)
             alpha = np.asarray(out["alpha"])  # [G, D, b_ins, K]
             alpha_o = np.asarray(out["alpha_oos"])
             for li, j in enumerate(grp):

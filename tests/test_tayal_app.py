@@ -410,3 +410,49 @@ class TestPerDrawRelabel:
             np.testing.assert_allclose(got["phi_45"][j], phi[3, 4], rtol=1e-5)
             np.testing.assert_allclose(got["phi_25"][j], phi[1, 4], rtol=1e-5)
             np.testing.assert_allclose(got["ll"][j], float(ll), rtol=1e-5)
+
+
+class TestDeviceMedianDecode:
+    def test_device_reduction_equals_host_median_argmax(self):
+        """The wf decode's device-side median-α hard classification
+        (shipped as [G, T] int32 instead of [G, D, T, K] f32 — the
+        round-4 transfer optimization) must equal the host
+        np.median/np.argmax reduction on the same generated output."""
+        import jax
+        import jax.numpy as jnp
+
+        from hhmm_tpu.models import TayalHHMMLite
+
+        rng = np.random.default_rng(4)
+        model = TayalHHMMLite(gate_mode="stan")
+        G, D, T, To = 3, 100, 96, 40
+        data = {
+            "x": jnp.asarray(rng.integers(0, 9, (G, T)), jnp.int32),
+            "sign": jnp.asarray(rng.integers(0, 2, (G, T)), jnp.int32),
+            "x_oos": jnp.asarray(rng.integers(0, 9, (G, To)), jnp.int32),
+            "sign_oos": jnp.asarray(rng.integers(0, 2, (G, To)), jnp.int32),
+        }
+        samples = np.stack(
+            [
+                np.stack(
+                    [
+                        np.asarray(
+                            model.init_unconstrained(
+                                k, {kk: v[g] for kk, v in data.items()}
+                            )
+                        )
+                        for k in jax.random.split(jax.random.PRNGKey(g), D)
+                    ]
+                )
+                for g in range(G)
+            ]
+        )
+        out = jax.vmap(model.generated)(jnp.asarray(samples), data)
+        dev = np.asarray(jnp.argmax(jnp.median(out["alpha"], axis=1), axis=-1))
+        host = np.stack(
+            [
+                np.argmax(np.median(np.asarray(out["alpha"])[g], axis=0), axis=-1)
+                for g in range(G)
+            ]
+        )
+        np.testing.assert_array_equal(dev, host)
