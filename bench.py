@@ -478,14 +478,19 @@ def main() -> None:
         # sized so the thinning itself contributes < 0.01 to the floors
 
         @jax.jit
-        def _pbull_series(thin, xb, sb):
-            """[D_TS, dim] draws -> smoothed bull-pair probability paths
-            [D_TS, T], entirely on device: the generated pass must run
-            jitted — eager vmap dispatches op-by-op through the device
-            tunnel (~10 s/call of pure latency at ~0 compute)."""
-            gen = hard.generated(thin, {"x": xb, "sign": sb})
-            gamma = gen["gamma"]
-            return gamma[..., 2] + gamma[..., 3]
+        def _pbull_batch(thin, xb, sb):
+            """[B_a, D_TS, dim] draws -> smoothed bull-pair probability
+            paths [B_a, D_TS, T], entirely on device and in ONE dispatch
+            for the whole series batch — the per-series call pattern
+            paid ~64 tunnel round-trips per agreement check at ~0
+            compute each."""
+
+            def one(t, xi, si):
+                gen = hard.generated(t, {"x": xi, "sign": si})
+                gamma = gen["gamma"]
+                return gamma[..., 2] + gamma[..., 3]
+
+            return jax.vmap(one)(thin, xb, sb)
 
         def top_state_mean(qs, anchors=None, chain_keep=None):
             """[B_a, chains, draws, dim] -> posterior-mean bull-pair
@@ -497,17 +502,21 @@ def main() -> None:
             [B_a, chains] pools only basin-selected chains (NUTS chains
             can sit in dominated basins; Gibbs hops freely). Returns
             (means, anchors) so two samplers can share anchors."""
-            out = []
-            made_anchors = []
+            thin = []
             for b in range(B_a):
                 qb = np.asarray(qs[b])
                 if chain_keep is not None:
                     qb = qb[chain_keep[b]]
                 flat = qb.reshape(-1, qb.shape[-1])
                 sel = np.linspace(0, len(flat) - 1, D_TS).astype(int)
-                p_bull = np.asarray(
-                    _pbull_series(jnp.asarray(flat[sel]), x[b], sign[b])
-                )  # [D_TS, T]
+                thin.append(flat[sel])
+            p_bull_all = np.asarray(
+                _pbull_batch(jnp.asarray(np.stack(thin)), x[:B_a], sign[:B_a])
+            )  # [B_a, D_TS, T]
+            out = []
+            made_anchors = []
+            for b in range(B_a):
+                p_bull = p_bull_all[b]
                 a = p_bull[0] if anchors is None else anchors[b]
                 made_anchors.append(a)
                 d_id = ((p_bull - a) ** 2).sum(axis=1)
@@ -602,20 +611,20 @@ def main() -> None:
             )
         )
 
+        ll_fn_b = jax.jit(jax.vmap(ll_fn, in_axes=(0, 0, 0)))
+
         def marginal_ll_per_chain(qs):
             """[B_a, C, draws, dim] -> per-chain mean marginal loglik
-            [B_a, C]. One jitted call per series (chains batched as a
-            flat draw axis) — per-call tunnel latency dominates the
-            actual compute at these sizes."""
+            [B_a, C], in one dispatch for the series batch (the
+            per-series call pattern paid a tunnel round-trip per
+            series per sampler)."""
             D_ML = 64
-            out = []
-            for b in range(B_a):
-                qb = np.asarray(qs[b])  # [C, draws, dim]
-                sel = np.linspace(0, qb.shape[1] - 1, D_ML).astype(int)
-                flat = qb[:, sel].reshape(-1, qb.shape[-1])
-                lls = np.asarray(ll_fn(jnp.asarray(flat), x[b], sign[b]))
-                out.append(lls.reshape(qb.shape[0], D_ML).mean(axis=1))
-            return np.array(out)
+            qs = np.asarray(qs)
+            B_q, C_q, D_q, dim = qs.shape
+            sel = np.linspace(0, D_q - 1, D_ML).astype(int)
+            flat = jnp.asarray(qs[:, :, sel].reshape(B_q, C_q * D_ML, dim))
+            lls = np.asarray(ll_fn_b(flat, x[:B_q], sign[:B_q]))
+            return lls.reshape(B_q, C_q, D_ML).mean(axis=2)
 
         print(f"#   nuts passes: {time.time() - t_:.1f}s", file=sys.stderr)
 
