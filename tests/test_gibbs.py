@@ -360,6 +360,64 @@ class TestStanGateConjugacy:
                 emp[a, b] = np.mean((paths[:, 2] == a) & (paths[:, 3] == b))
         np.testing.assert_allclose(emp, pair, atol=0.03)
 
+    def test_semisup_gibbs_matches_nuts_on_stan_gate(self, rng):
+        """Cross-sampler agreement for the semisup soft gate: the
+        consistency-weighted conjugate block must target the same
+        posterior NUTS integrates on the identical gated density —
+        including steps whose observed group contradicts every
+        high-emission state (the gate's unit-factor track)."""
+        from hhmm_tpu.models import SemisupMultinomialHMM
+
+        K, L, T = 4, 5, 300
+        groups = np.array([0, 1, 1, 0], np.int32)
+        A = np.array(
+            [[0.7, 0.1, 0.1, 0.1], [0.1, 0.7, 0.1, 0.1],
+             [0.1, 0.1, 0.7, 0.1], [0.1, 0.1, 0.1, 0.7]]
+        )
+        phi = np.array(
+            [[0.6, 0.2, 0.1, 0.05, 0.05], [0.05, 0.6, 0.2, 0.1, 0.05],
+             [0.05, 0.05, 0.6, 0.2, 0.1], [0.1, 0.05, 0.05, 0.6, 0.2]]
+        )
+        z, x = hmm_sim(
+            jax.random.PRNGKey(9), T, A, np.ones(K) / K,
+            obsmodel_categorical(phi), validate=False,
+        )
+        g = groups[np.asarray(z)].copy()
+        # corrupt ~15% of labels: group evidence that fights the
+        # emissions exercises the soft gate's unit-factor branch
+        flip = rng.random(T) < 0.15
+        g[flip] = 1 - g[flip]
+        model = SemisupMultinomialHMM(K=K, L=L, groups=groups, gate_mode="stan")
+        data = {"x": np.asarray(x, np.int32), "g": g.astype(np.int32)}
+
+        def canon(qs):
+            d = model.constrained_draws(qs.reshape(-1, qs.shape[-1]))
+            phid = np.asarray(d["phi_k"]).reshape(-1, K, L)
+            # canonicalize within each group's state pair by first-symbol
+            # ordering (label switching is within-group here: the gate
+            # pins group identity)
+            out = []
+            for pair in ([0, 3], [1, 2]):
+                sub = phid[:, pair, :]
+                o = np.argsort(sub[:, :, 0], axis=1)
+                i = np.arange(len(sub))[:, None]
+                out.append(sub[i, o].mean(0).ravel())
+            return np.concatenate(out)
+
+        qg, sg = sample_gibbs(
+            model, data, jax.random.PRNGKey(0),
+            GibbsConfig(num_warmup=300, num_samples=1200, num_chains=2),
+        )
+        qn, _ = sample_nuts(
+            model.make_logp({k: jnp.asarray(v) for k, v in data.items()}),
+            jax.random.PRNGKey(2),
+            init_chains(model, jax.random.PRNGKey(1), data, 2),
+            SamplerConfig(num_warmup=300, num_samples=500, num_chains=2,
+                          max_treedepth=6),
+        )
+        assert np.isfinite(np.asarray(sg["logp"])).all()
+        np.testing.assert_allclose(canon(qg), canon(qn), atol=0.06)
+
     def test_gibbs_matches_chees_on_stan_gate(self, rng):
         """Cross-sampler agreement on the soft-gate density with
         non-alternating data — the pair (z|θ exact FFBS, θ|z conjugate)
