@@ -1,0 +1,296 @@
+"""Chunked-T Pallas TPU kernel: fused forward+backward+gradients for
+LONG sequences.
+
+`kernels/pallas_forward.py` keeps the whole [T, K, 128] observation
+block and the alpha residual in VMEM, which caps it at T*K <= 4096 —
+real Tayal windows run to ~12k zig-zag legs (the walk-forward fit
+phase), where the dispatcher fell back to XLA scans. This kernel
+streams the time axis instead:
+
+- grid ``(batch_tile, t_chunk)`` with the time axis minor — on TPU the
+  minor grid dimension iterates sequentially, so VMEM scratch persists
+  across t-chunks of one batch tile (the standard accumulation
+  pattern): the filter state ``alpha`` [K, 128] carries forward across
+  chunks, the smoother state ``beta`` carries backward.
+- pass 1 (forward) writes the per-step filter to an HBM residual
+  (``alpha_all``) chunk by chunk; pass 2 (backward) re-reads it in
+  REVERSED chunk order (index_map ``nc-1-c``) plus a one-chunk lookback
+  block for the ``alpha[t-1]`` needed at chunk boundaries, and
+  accumulates ``d_A`` in its persistent output block.
+- semantics (masked-step carry-copy, optional per-destination gating
+  from a [T] key, clamped logsumexp) are identical to the resident
+  kernel and the lax.scan reference; parity is pinned in interpreter
+  mode by `tests/test_pallas.py::TestChunkedKernel` across chunk
+  boundaries, ragged masks, and gating.
+
+VMEM per grid step at the default ``t_chunk=512`` (K=4): ~1 MB per
+[Tc, K, 128] block x (obs + alpha + lookback + d_obs) + small blocks,
+double-buffered — comfortably inside the ~16 MB budget.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["pallas_forward_vg_chunked"]
+
+_LANES = 128
+_CLAMP = -1.0e30
+
+
+def _lse0(x):
+    m = jnp.maximum(jnp.max(x, axis=0), _CLAMP)
+    return m + jnp.log(jnp.sum(jnp.exp(x - m[None]), axis=0))
+
+
+def _lse1(x):
+    m = jnp.maximum(jnp.max(x, axis=1), _CLAMP)
+    return m + jnp.log(jnp.sum(jnp.exp(x - m[:, None, :]), axis=1))
+
+
+def _fwd_kernel(
+    gated,
+    pi_ref,  # [K, B]
+    A_ref,  # [K, K, B]
+    obs_ref,  # [Tc, K, B] (chunk c)
+    mask_ref,  # [Tc, B]
+    *refs,  # (+ gate_ref [Tc, B], sk_ref [K, B]), ll_ref, alpha_out, carry
+):
+    if gated:
+        gate_ref, sk_ref, ll_ref, aout_ref, carry = refs
+        sk = sk_ref[:]
+    else:
+        ll_ref, aout_ref, carry = refs
+    Tc, K, B = obs_ref.shape
+    A = A_ref[:]
+    c = pl.program_id(1)
+
+    def A_at(t):
+        if not gated:
+            return A
+        c_t = (gate_ref[t][None] == sk).astype(jnp.float32)
+        return A * c_t[None, :, :]
+
+    # chunk 0 initializes from pi; later chunks resume from the carry
+    m0 = mask_ref[0][None]
+    alpha0 = jnp.where(m0 > 0, pi_ref[:] + obs_ref[0], pi_ref[:])
+    alpha_init = jnp.where(c == 0, alpha0, carry[:])
+
+    @pl.when(c == 0)
+    def _():
+        aout_ref[0] = alpha_init
+
+    def body(t, alpha):
+        new = _lse0(alpha[:, None, :] + A_at(t)) + obs_ref[t]
+        alpha = jnp.where(mask_ref[t][None] > 0, new, alpha)
+        aout_ref[t] = alpha
+        return alpha
+
+    start = jnp.where(c == 0, 1, 0)
+    alpha = lax.fori_loop(start, Tc, body, alpha_init)
+    carry[:] = alpha
+    ll_ref[0] = _lse0(alpha)  # every chunk writes; the last one stands
+
+
+def _bwd_kernel(
+    gated,
+    A_ref,  # [K, K, B]
+    obs_ref,  # [Tc, K, B]   (reversed chunk order)
+    mask_ref,  # [Tc, B]
+    alpha_ref,  # [Tc, K, B]
+    aprev_ref,  # [Tc, K, B]  (chunk rc-1; clamped to 0 for rc==0, unused)
+    ll_ref,  # [1, B]
+    *refs,  # (+ gate_ref, sk_ref), dpi_ref, dA_ref, dobs_ref, beta_scr
+):
+    if gated:
+        gate_ref, sk_ref, dpi_ref, dA_ref, dobs_ref, beta_scr = refs
+        sk = sk_ref[:]
+    else:
+        dpi_ref, dA_ref, dobs_ref, beta_scr = refs
+    Tc, K, B = obs_ref.shape
+    A = A_ref[:]
+    ll = ll_ref[0]
+    c = pl.program_id(1)
+    nc = pl.num_programs(1)
+    rc = nc - 1 - c  # the time-chunk this grid step owns
+
+    def A_at(t):
+        if not gated:
+            return A, None
+        c_t = (gate_ref[t][None] == sk).astype(jnp.float32)
+        return A * c_t[None, :, :], c_t
+
+    @pl.when(c == 0)
+    def _():
+        beta_scr[:] = jnp.zeros((K, B), jnp.float32)
+        dA_ref[:] = jnp.zeros((K, K, B), jnp.float32)
+        dpi_ref[:] = jnp.zeros((K, B), jnp.float32)
+
+    beta0 = beta_scr[:]
+    dA0 = jnp.zeros((K, K, B), jnp.float32)
+
+    def body(i, carry):
+        beta, dA = carry
+        t = Tc - 1 - i  # local step, descending
+        m_t = mask_ref[t][None]
+        m01 = (m_t > 0).astype(jnp.float32)
+        gamma_t = jnp.exp(alpha_ref[t] + beta - ll[None]) * m01
+        dobs_ref[t] = gamma_t
+        e = obs_ref[t] + beta
+        # alpha entering step t: previous local row, or the lookback
+        # chunk's last row at the chunk boundary
+        a_in = jnp.where(
+            t == 0, aprev_ref[Tc - 1], alpha_ref[jnp.maximum(t - 1, 0)]
+        )
+        Ag, c_t = A_at(t)
+        xi = jnp.exp(a_in[:, None, :] + Ag + e[None, :, :] - ll[None, None, :])
+        if gated:
+            xi = xi * c_t[None]
+        dA = dA + xi * m01[None]
+        new_beta = _lse1(Ag + e[None, :, :])
+        beta = jnp.where(m_t > 0, new_beta, beta)
+        return beta, dA
+
+    # the earliest chunk stops before local t=0 (the pi step, handled
+    # below); every other chunk walks its whole block
+    n_steps = jnp.where(rc == 0, Tc - 1, Tc)
+    beta, dA = lax.fori_loop(0, n_steps, body, (beta0, dA0))
+    beta_scr[:] = beta
+    dA_ref[:] += dA
+
+    @pl.when(rc == 0)
+    def _():
+        gamma0 = jnp.exp(alpha_ref[0] + beta_scr[:] - ll[None])
+        dpi_ref[:] = gamma0
+        dobs_ref[0] = gamma0 * (mask_ref[0][None] > 0).astype(jnp.float32)
+
+
+def pallas_forward_vg_chunked(
+    log_pi: jnp.ndarray,  # [B, K]
+    log_A: jnp.ndarray,  # [B, K, K]
+    log_obs: jnp.ndarray,  # [B, T, K]
+    mask: jnp.ndarray,  # [B, T]
+    gate_key: Optional[jnp.ndarray] = None,  # [B, T]
+    state_key: Optional[jnp.ndarray] = None,  # [B, K]
+    *,
+    t_chunk: int = 512,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Batched fused (loglik, d_pi, d_A, d_obs) for long T. Pads the
+    batch to 128 lanes and T to a ``t_chunk`` multiple (mask-0 padding
+    steps carry alpha unchanged and contribute no gradient)."""
+    B, T, K = log_obs.shape
+    Bp = -(-B // _LANES) * _LANES
+    Tc = t_chunk
+    Tp = -(-T // Tc) * Tc
+    nc = Tp // Tc
+    gated = gate_key is not None
+
+    def pad_b(x):
+        return jnp.pad(x, [(0, Bp - B)] + [(0, 0)] * (x.ndim - 1))
+
+    pi_t = pad_b(log_pi).transpose(1, 0)  # [K, Bp]
+    A_t = pad_b(log_A).transpose(1, 2, 0)  # [K, K, Bp]
+    obs_t = jnp.pad(pad_b(log_obs), [(0, 0), (0, Tp - T), (0, 0)]).transpose(
+        1, 2, 0
+    )  # [Tp, K, Bp]
+    mask_t = jnp.pad(
+        jnp.pad(mask, [(0, Bp - B), (0, 0)], constant_values=1.0),
+        [(0, 0), (0, Tp - T)],  # time padding: mask 0 (carry-copy steps)
+    ).transpose(1, 0)  # [Tp, Bp]
+
+    grid = (Bp // _LANES, nc)
+
+    def fixed(*blk):
+        return pl.BlockSpec(
+            blk + (_LANES,),
+            index_map=lambda b, c: (0,) * len(blk) + (b,),
+            memory_space=pltpu.VMEM,
+        )
+
+    def t_fwd(*blk):
+        return pl.BlockSpec(
+            blk + (_LANES,),
+            index_map=lambda b, c: (c,) + (0,) * (len(blk) - 1) + (b,),
+            memory_space=pltpu.VMEM,
+        )
+
+    def t_rev(*blk):
+        return pl.BlockSpec(
+            blk + (_LANES,),
+            index_map=lambda b, c: (nc - 1 - c,) + (0,) * (len(blk) - 1) + (b,),
+            memory_space=pltpu.VMEM,
+        )
+
+    def t_rev_prev(*blk):
+        return pl.BlockSpec(
+            blk + (_LANES,),
+            index_map=lambda b, c: (jnp.maximum(nc - 2 - c, 0),)
+            + (0,) * (len(blk) - 1)
+            + (b,),
+            memory_space=pltpu.VMEM,
+        )
+
+    # ---- pass 1: forward filter, residual to HBM ----
+    fwd_in = [fixed(K), fixed(K, K), t_fwd(Tc, K), t_fwd(Tc)]
+    fwd_args = [pi_t, A_t, obs_t, mask_t]
+    if gated:
+        gate_t = jnp.pad(
+            pad_b(gate_key.astype(jnp.float32)), [(0, 0), (0, Tp - T)]
+        ).transpose(1, 0)
+        sk_t = pad_b(state_key.astype(jnp.float32)).transpose(1, 0)
+        fwd_in += [t_fwd(Tc), fixed(K)]
+        fwd_args += [gate_t, sk_t]
+    ll, alpha_all = pl.pallas_call(
+        partial(_fwd_kernel, gated),
+        grid=grid,
+        in_specs=fwd_in,
+        out_specs=(fixed(1), t_fwd(Tc, K)),
+        out_shape=(
+            jax.ShapeDtypeStruct((1, Bp), jnp.float32),
+            jax.ShapeDtypeStruct((Tp, K, Bp), jnp.float32),
+        ),
+        scratch_shapes=[pltpu.VMEM((K, _LANES), jnp.float32)],
+        interpret=interpret,
+    )(*fwd_args)
+
+    # ---- pass 2: backward smoother + gradients, reversed chunks ----
+    bwd_in = [
+        fixed(K, K),
+        t_rev(Tc, K),
+        t_rev(Tc),
+        t_rev(Tc, K),
+        t_rev_prev(Tc, K),
+        fixed(1),
+    ]
+    bwd_args = [A_t, obs_t, mask_t, alpha_all, alpha_all, ll]
+    if gated:
+        bwd_in += [t_rev(Tc), fixed(K)]
+        bwd_args += [gate_t, sk_t]
+    dpi, dA, dobs = pl.pallas_call(
+        partial(_bwd_kernel, gated),
+        grid=grid,
+        in_specs=bwd_in,
+        out_specs=(fixed(K), fixed(K, K), t_rev(Tc, K)),
+        out_shape=(
+            jax.ShapeDtypeStruct((K, Bp), jnp.float32),
+            jax.ShapeDtypeStruct((K, K, Bp), jnp.float32),
+            jax.ShapeDtypeStruct((Tp, K, Bp), jnp.float32),
+        ),
+        scratch_shapes=[pltpu.VMEM((K, _LANES), jnp.float32)],
+        interpret=interpret,
+    )(*bwd_args)
+
+    return (
+        ll[0, :B],
+        dpi.transpose(1, 0)[:B],
+        dA.transpose(2, 0, 1)[:B],
+        dobs.transpose(2, 0, 1)[:B, :T],
+    )

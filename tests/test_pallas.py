@@ -316,3 +316,66 @@ class TestIOHMMFold:
         v_vg, g_vg = model.make_vg(data)(theta)
         np.testing.assert_allclose(float(v_ref), float(v_vg), rtol=1e-5)
         np.testing.assert_allclose(np.asarray(g_ref), np.asarray(g_vg), rtol=1e-3, atol=1e-4)
+
+
+class TestChunkedKernel:
+    """Chunked-T streaming variant (kernels/pallas_forward_chunked.py):
+    exact parity with the lax.scan reference across chunk boundaries,
+    ragged masks, non-multiple T, and sign gating — the long-window
+    path the walk-forward fit uses."""
+
+    def _run(self, args, gate=None, t_chunk=16):
+        from hhmm_tpu.kernels.pallas_forward_chunked import (
+            pallas_forward_vg_chunked,
+        )
+
+        if gate is None:
+            return pallas_forward_vg_chunked(
+                *args, t_chunk=t_chunk, interpret=True
+            )
+        return pallas_forward_vg_chunked(
+            *args, *gate, t_chunk=t_chunk, interpret=True
+        )
+
+    @pytest.mark.parametrize("T", [16, 33, 48, 100])
+    def test_matches_reference_across_chunk_boundaries(self, rng, T):
+        args = _batch(rng, 5, T, 4)
+        out = self._run(args, t_chunk=16)
+        _assert_close(out, _ref(*args))
+
+    def test_single_chunk_degenerate(self, rng):
+        args = _batch(rng, 3, 12, 4)
+        out = self._run(args, t_chunk=16)
+        _assert_close(out, _ref(*args))
+
+    def test_ragged_masks(self, rng):
+        args = _batch(rng, 9, 70, 4, ragged=True)
+        out = self._run(args, t_chunk=16)
+        _assert_close(out, _ref(*args))
+        dobs = np.asarray(out[3])
+        m = np.asarray(args[3])
+        assert np.all(dobs[m == 0.0] == 0.0)
+
+    def test_gated_matches_reference(self, rng):
+        """Soft sign-gating via [T] keys (the Tayal stan-gate hot
+        loop) across chunk boundaries."""
+        from hhmm_tpu.kernels.vg import _vg_single_gated
+
+        B, T, K = 6, 53, 4
+        log_pi, log_A, log_obs, mask = _batch(rng, B, T, K)
+        gate_key = jnp.asarray(rng.integers(0, 2, (B, T)), jnp.float32)
+        state_key = jnp.asarray(rng.integers(0, 2, (B, K)), jnp.float32)
+        out = self._run(
+            (log_pi, log_A, log_obs, mask), gate=(gate_key, state_key),
+            t_chunk=16,
+        )
+        ref = jax.vmap(_vg_single_gated)(
+            log_pi, log_A, log_obs, mask, gate_key, state_key
+        )
+        _assert_close(out, ref)
+
+    def test_batch_padding(self, rng):
+        """B not a lane multiple and > one tile."""
+        args = _batch(rng, 130, 40, 4)
+        out = self._run(args, t_chunk=16)
+        _assert_close(out, _ref(*args))
