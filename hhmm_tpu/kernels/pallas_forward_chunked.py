@@ -39,20 +39,12 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# shared lane width, clamp, and clamped-logsumexp helpers: the two
+# kernels are dispatcher-interchangeable, so their numerics must come
+# from one definition
+from hhmm_tpu.kernels.pallas_forward import _CLAMP, _LANES, _lse0, _lse1
+
 __all__ = ["pallas_forward_vg_chunked"]
-
-_LANES = 128
-_CLAMP = -1.0e30
-
-
-def _lse0(x):
-    m = jnp.maximum(jnp.max(x, axis=0), _CLAMP)
-    return m + jnp.log(jnp.sum(jnp.exp(x - m[None]), axis=0))
-
-
-def _lse1(x):
-    m = jnp.maximum(jnp.max(x, axis=1), _CLAMP)
-    return m + jnp.log(jnp.sum(jnp.exp(x - m[:, None, :]), axis=1))
 
 
 def _fwd_kernel(

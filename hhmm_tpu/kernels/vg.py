@@ -117,7 +117,10 @@ def _pallas_chunked_eligible(log_A_b, log_obs_b) -> bool:
     T, K = log_obs_b.shape[1], log_obs_b.shape[2]
     if log_obs_b.dtype != jnp.float32:
         return False
-    return 4096 < T * K and T <= 65536
+    # K bound = the chunked kernel's own VMEM guard: its per-grid-step
+    # blocks are t_chunk*K*128*4 bytes x ~5, double-buffered — K <= 8
+    # keeps that inside the ~16 MB budget at the default t_chunk
+    return 4096 < T * K and T <= 65536 and K <= 8
 
 
 @custom_vmap

@@ -379,3 +379,20 @@ class TestChunkedKernel:
         args = _batch(rng, 130, 40, 4)
         out = self._run(args, t_chunk=16)
         _assert_close(out, _ref(*args))
+
+    def test_masked_sparse_tayal_A_across_chunks(self, rng):
+        """MASK_NEG hard-gated sparse transitions — the long-Tayal-
+        window production shape — must stay finite and match the
+        reference through the chunked kernel's per-chunk lse and
+        exp-accumulation (the -1e30/clamp interplay the resident
+        kernel's suite pins at small T)."""
+        B, T, K = 4, 53, 4
+        log_pi, log_A, log_obs, mask = _batch(rng, B, T, K)
+        gate = jnp.asarray(rng.random((B, K, K)) < 0.4)
+        log_A = jnp.where(gate, MASK_NEG, log_A)
+        pi_gate = jnp.asarray(rng.random((B, K)) < 0.3)
+        log_pi = jnp.where(pi_gate, safe_log(jnp.zeros(())), log_pi)
+        out = self._run((log_pi, log_A, log_obs, mask), t_chunk=16)
+        for o in out:
+            assert np.all(np.isfinite(np.asarray(o)))
+        _assert_close(out, _ref(log_pi, log_A, log_obs, mask))
