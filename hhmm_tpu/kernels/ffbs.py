@@ -130,10 +130,11 @@ def ffbs_invcdf_reference(
 
 @custom_vmap
 def _ffbs_batched(u, log_pi, log_A, log_obs, mask):
-    # same eligibility rules + batch-axis folding as the vg hot loop
+    # same eligibility rules + batch-axis folding as the vg hot loop;
+    # u must pass the same f32 gate (x64 mode promotes jax.random.uniform)
     from hhmm_tpu.kernels.vg import _pallas_eligible
 
-    if _pallas_eligible(log_A, log_obs):
+    if _pallas_eligible(log_pi, log_A, log_obs) and u.dtype == jnp.float32:
         from hhmm_tpu.kernels.pallas_ffbs import pallas_ffbs
 
         return pallas_ffbs(log_pi, log_A, log_obs, mask, u)

@@ -89,21 +89,35 @@ def _broadcast_unbatched(axis_size, in_batched, args):
     )
 
 
-def _pallas_eligible(log_A_b, log_obs_b) -> bool:
-    """Batched shapes: homogeneous A [B,K,K], f32, T*K small enough that
-    the fused kernel's per-tile VMEM blocks (obs, alpha scratch, d_obs,
-    each T*K*128*4 bytes, double-buffered) fit comfortably."""
+def _f32(*arrs) -> bool:
+    return all(a.dtype == jnp.float32 for a in arrs)
+
+
+def _pallas_eligible(log_pi_b, log_A_b, log_obs_b) -> bool:
+    """Batched shapes: homogeneous A [B,K,K], all-f32 inputs, T*K small
+    enough that the fused kernel's per-tile VMEM blocks (obs, alpha
+    scratch, d_obs, each T*K*128*4 bytes, double-buffered) fit
+    comfortably. Mixed dtypes (a bf16 or f64-promoted pi/A) fall back
+    to the scan path rather than reach the f32 BlockSpecs."""
     if jax.default_backend() != "tpu":
         return False
     if log_A_b.ndim != 3:  # [B, T-1, K, K] time-varying
         return False
     T, K = log_obs_b.shape[1], log_obs_b.shape[2]
-    if log_obs_b.dtype != jnp.float32:
+    if not _f32(log_pi_b, log_A_b, log_obs_b):
         return False
     return T * K <= 4096
 
 
-def _pallas_chunked_eligible(log_A_b, log_obs_b) -> bool:
+def chunk_for_k(K: int) -> int:
+    """t_chunk that keeps the chunked kernel's per-grid-step VMEM
+    (~5 blocks of t_chunk*K*128*4 bytes, double-buffered) at the same
+    ~1 MB/block footprint the measured K=4/t_chunk=512 point has,
+    for every K the eligibility bound admits."""
+    return max(128, 2048 // K)
+
+
+def _pallas_chunked_eligible(log_pi_b, log_A_b, log_obs_b) -> bool:
     """Long-T eligibility for the chunked streaming kernel
     (`kernels/pallas_forward_chunked.py`): same dtype/homogeneity
     requirements, T beyond the resident kernel's VMEM cap. The upper
@@ -115,27 +129,29 @@ def _pallas_chunked_eligible(log_A_b, log_obs_b) -> bool:
     if log_A_b.ndim != 3:
         return False
     T, K = log_obs_b.shape[1], log_obs_b.shape[2]
-    if log_obs_b.dtype != jnp.float32:
+    if not _f32(log_pi_b, log_A_b, log_obs_b):
         return False
-    # K bound = the chunked kernel's own VMEM guard: its per-grid-step
-    # blocks are t_chunk*K*128*4 bytes x ~5, double-buffered — K <= 8
-    # keeps that inside the ~16 MB budget at the default t_chunk
+    # K bound: dispatch passes t_chunk = chunk_for_k(K), which holds the
+    # per-grid-step VMEM footprint flat in K, so any K <= 8 fits the
+    # ~16 MB budget (K=4/512 is the measured point)
     return 4096 < T * K and T <= 65536 and K <= 8
 
 
 @custom_vmap
 def _vg_batched(log_pi, log_A, log_obs, mask):
     """One flat leading batch axis on every arg."""
-    if _pallas_eligible(log_A, log_obs):
+    if _pallas_eligible(log_pi, log_A, log_obs):
         from hhmm_tpu.kernels.pallas_forward import pallas_forward_vg
 
         return pallas_forward_vg(log_pi, log_A, log_obs, mask)
-    if _pallas_chunked_eligible(log_A, log_obs):
+    if _pallas_chunked_eligible(log_pi, log_A, log_obs):
         from hhmm_tpu.kernels.pallas_forward_chunked import (
             pallas_forward_vg_chunked,
         )
 
-        return pallas_forward_vg_chunked(log_pi, log_A, log_obs, mask)
+        return pallas_forward_vg_chunked(
+            log_pi, log_A, log_obs, mask, t_chunk=chunk_for_k(log_obs.shape[2])
+        )
     return jax.vmap(_vg_single)(log_pi, log_A, log_obs, mask)
 
 
@@ -151,19 +167,20 @@ def _vg_batched_rule(axis_size, in_batched, *args):
 
 @custom_vmap
 def _vg_batched_gated(log_pi, log_A, log_obs, mask, gate_key, state_key):
-    if _pallas_eligible(log_A, log_obs):
+    if _pallas_eligible(log_pi, log_A, log_obs):
         from hhmm_tpu.kernels.pallas_forward import pallas_forward_vg
 
         return pallas_forward_vg(
             log_pi, log_A, log_obs, mask, gate_key=gate_key, state_key=state_key
         )
-    if _pallas_chunked_eligible(log_A, log_obs):
+    if _pallas_chunked_eligible(log_pi, log_A, log_obs):
         from hhmm_tpu.kernels.pallas_forward_chunked import (
             pallas_forward_vg_chunked,
         )
 
         return pallas_forward_vg_chunked(
-            log_pi, log_A, log_obs, mask, gate_key, state_key
+            log_pi, log_A, log_obs, mask, gate_key, state_key,
+            t_chunk=chunk_for_k(log_obs.shape[2]),
         )
     return jax.vmap(_vg_single_gated)(log_pi, log_A, log_obs, mask, gate_key, state_key)
 
