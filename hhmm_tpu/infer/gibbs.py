@@ -132,20 +132,37 @@ def sample_gibbs(
 
     total = config.num_warmup + config.num_samples
 
+    # gate keys depend on data only — computed once, closed over by the
+    # scan body. A model that expresses its gate through keys (the
+    # build_vg/gate_keys contract of models/base.py, same as the HMC hot
+    # loop) keeps log_A homogeneous, so the soft sign gate runs the
+    # fused FFBS kernels instead of materializing Ã_t [T-1, K, K] into
+    # the scan path.
+    gk = model.gate_keys(data) if hasattr(model, "gate_keys") else None
+    # build_vg only when gate keys are in play: its contract guarantees
+    # the marginal loglik, not the per-step filtering potentials (e.g.
+    # IOHMM's build_vg folds the time-varying transition into effective
+    # emissions) — FFBS needs the true potentials, which ungated models
+    # expose through plain build
+    build = model.build_vg if gk is not None else model.build
+
     def chain(key, theta0):
         params0, _ = model.unpack(theta0)
 
         def step(params, k):
             # the whole transition is ONE fused FFBS (forward filter +
             # backward state draw + lp trace — a single Pallas kernel
-            # launch on TPU, kernels/pallas_ffbs.py) plus scan-free
-            # conjugate count matmuls. Time-varying kernels (the soft
-            # sign gate materializes Ã_t [T-1, K, K]) take the
+            # launch on TPU: kernels/pallas_ffbs.py at T*K <= 4096,
+            # kernels/pallas_ffbs_chunked.py beyond) plus scan-free
+            # conjugate count matmuls. Models with genuinely
+            # time-varying kernels (no gate-key form) take the
             # scan-based FFBS instead — same draws-distribution, no
             # Pallas eligibility.
             k_z, k_par = jax.random.split(k)
-            log_pi, log_A, log_obs, mask = model.build(params, data)
-            if log_A.ndim == 3:
+            log_pi, log_A, log_obs, mask = build(params, data)
+            if gk is not None:
+                z, ll = ffbs_fused(k_z, log_pi, log_A, log_obs, mask, *gk)
+            elif log_A.ndim == 3:
                 log_alpha, ll = forward_filter(log_pi, log_A, log_obs, mask)
                 z = backward_sample(k_z, log_alpha, log_A, mask)
             else:

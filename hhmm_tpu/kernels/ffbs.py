@@ -104,57 +104,121 @@ def ffbs_invcdf_reference(
     log_obs: jnp.ndarray,
     mask: jnp.ndarray,
     u: jnp.ndarray,
+    gate_key: Optional[jnp.ndarray] = None,
+    state_key: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Single-series FFBS with inverse-CDF draws from pre-drawn uniforms
-    ``u [T]`` — the exact semantics of the Pallas kernel
-    (`kernels/pallas_ffbs.py`), as composable JAX. Homogeneous ``log_A``
-    only. Returns ``(z [T] int32, loglik)``."""
+    ``u [T]`` — the exact semantics of the Pallas kernels
+    (`kernels/pallas_ffbs.py`, `pallas_ffbs_chunked.py`), as composable
+    JAX. Homogeneous ``log_A`` only; ``gate_key [T]`` / ``state_key
+    [K]`` select the gated-transition semantics of `kernels/vg.py` (a
+    gate-inconsistent successor contributes a unit pairwise factor —
+    the backward draw falls back to the filter alone, like a masked
+    successor). Returns ``(z [T] int32, loglik)``."""
     T, K = log_obs.shape
-    log_alpha, ll = forward_filter(log_pi, log_A, log_obs, mask)
+    if gate_key is None:
+        log_alpha, ll = forward_filter(log_pi, log_A, log_obs, mask)
+    else:
+        # forward: per-destination gate on log_A — materialized [T-1,K,K]
+        # here (this is the scan fallback / parity reference; the Pallas
+        # kernels compute the same gate in-VMEM from the keys)
+        c = gate_key[:, None] == state_key[None, :]  # [T, K]
+        log_A_t = jnp.where(c[1:, None, :], log_A[None], 0.0)
+        log_alpha, ll = forward_filter(log_pi, log_A_t, log_obs, mask)
     z_last = _invcdf(log_alpha[T - 1], u[T - 1])
 
     def step(z_next, xs):
-        alpha_t, m_next, u_t = xs
-        logits = jnp.where(m_next > 0, alpha_t + log_A[:, z_next], alpha_t)
+        if gate_key is None:
+            alpha_t, m_next, u_t = xs
+            g = m_next > 0
+        else:
+            alpha_t, m_next, u_t, gk_next = xs
+            g = jnp.logical_and(m_next > 0, gk_next == state_key[z_next])
+        logits = jnp.where(g, alpha_t + log_A[:, z_next], alpha_t)
         z = _invcdf(logits, u_t)
         return z, z
 
-    _, z_rest = lax.scan(
-        step, z_last, (log_alpha[:-1], mask[1:], u[:-1]), reverse=True
-    )
+    if gate_key is None:
+        xs = (log_alpha[:-1], mask[1:], u[:-1])
+    else:
+        xs = (log_alpha[:-1], mask[1:], u[:-1], gate_key[1:])
+    _, z_rest = lax.scan(step, z_last, xs, reverse=True)
     z = jnp.concatenate([z_rest, z_last[None]]).astype(jnp.int32)
     T_last = jnp.sum(mask).astype(jnp.int32) - 1
     z = jnp.where(jnp.arange(T) <= T_last, z, z[T_last])
     return z, ll
 
 
+def _dispatch_ffbs(u, log_pi, log_A, log_obs, mask, gate=()):
+    """Flat-batch dispatch shared by the gated/ungated custom_vmap ops:
+    resident Pallas kernel for short T, chunked streaming kernel for
+    long T, vmapped scan reference otherwise — identical draws on every
+    path (same uniforms, same inverse-CDF math)."""
+    from hhmm_tpu.kernels.vg import (
+        _pallas_chunked_eligible,
+        _pallas_eligible,
+        chunk_for_k,
+    )
+
+    if u.dtype == jnp.float32:
+        # u joins the f32 gate (x64 mode promotes jax.random.uniform)
+        if _pallas_eligible(log_pi, log_A, log_obs):
+            from hhmm_tpu.kernels.pallas_ffbs import pallas_ffbs
+
+            return pallas_ffbs(log_pi, log_A, log_obs, mask, u, *gate)
+        if _pallas_chunked_eligible(log_pi, log_A, log_obs):
+            from hhmm_tpu.kernels.pallas_ffbs_chunked import pallas_ffbs_chunked
+
+            return pallas_ffbs_chunked(
+                log_pi, log_A, log_obs, mask, u, *gate,
+                t_chunk=chunk_for_k(log_obs.shape[2]),
+            )
+    return jax.vmap(
+        lambda ui, pi, A, obs, m, *g: ffbs_invcdf_reference(pi, A, obs, m, ui, *g)
+    )(u, log_pi, log_A, log_obs, mask, *gate)
+
+
+def _flatten_rule(op):
+    """vmap rule for a flat-batch op: fold the new axis into the flat
+    batch, run ``op`` once, unfold the outputs."""
+
+    def rule(axis_size, in_batched, *args):
+        from hhmm_tpu.kernels.vg import _broadcast_unbatched
+
+        args = _broadcast_unbatched(axis_size, in_batched, args)
+        flat = tuple(a.reshape((-1,) + a.shape[2:]) for a in args)
+        z, ll = op(*flat)
+        return (
+            z.reshape((axis_size, -1) + z.shape[1:]),
+            ll.reshape((axis_size, -1) + ll.shape[1:]),
+        ), (True, True)
+
+    return rule
+
+
+def _promote_rule(batched_op):
+    """vmap rule for a single-series op: the first vmap promotes it to
+    the flat-batch op (whose own rule handles deeper nesting)."""
+
+    def rule(axis_size, in_batched, *args):
+        from hhmm_tpu.kernels.vg import _broadcast_unbatched
+
+        args = _broadcast_unbatched(axis_size, in_batched, args)
+        return batched_op(*args), (True, True)
+
+    return rule
+
+
 @custom_vmap
 def _ffbs_batched(u, log_pi, log_A, log_obs, mask):
-    # same eligibility rules + batch-axis folding as the vg hot loop;
-    # u must pass the same f32 gate (x64 mode promotes jax.random.uniform)
-    from hhmm_tpu.kernels.vg import _pallas_eligible
-
-    if _pallas_eligible(log_pi, log_A, log_obs) and u.dtype == jnp.float32:
-        from hhmm_tpu.kernels.pallas_ffbs import pallas_ffbs
-
-        return pallas_ffbs(log_pi, log_A, log_obs, mask, u)
-    z, ll = jax.vmap(
-        lambda ui, pi, A, obs, m: ffbs_invcdf_reference(pi, A, obs, m, ui)
-    )(u, log_pi, log_A, log_obs, mask)
-    return z, ll
+    return _dispatch_ffbs(u, log_pi, log_A, log_obs, mask)
 
 
-@_ffbs_batched.def_vmap
-def _ffbs_batched_rule(axis_size, in_batched, *args):
-    from hhmm_tpu.kernels.vg import _broadcast_unbatched
-
-    args = _broadcast_unbatched(axis_size, in_batched, args)
-    flat = tuple(a.reshape((-1,) + a.shape[2:]) for a in args)
-    z, ll = _ffbs_batched(*flat)
-    return (
-        z.reshape((axis_size, -1) + z.shape[1:]),
-        ll.reshape((axis_size, -1) + ll.shape[1:]),
-    ), (True, True)
+@custom_vmap
+def _ffbs_batched_gated(u, log_pi, log_A, log_obs, mask, gate_key, state_key):
+    return _dispatch_ffbs(
+        u, log_pi, log_A, log_obs, mask, gate=(gate_key, state_key)
+    )
 
 
 @custom_vmap
@@ -162,12 +226,17 @@ def _ffbs_fused_single(u, log_pi, log_A, log_obs, mask):
     return ffbs_invcdf_reference(log_pi, log_A, log_obs, mask, u)
 
 
-@_ffbs_fused_single.def_vmap
-def _ffbs_fused_single_rule(axis_size, in_batched, *args):
-    from hhmm_tpu.kernels.vg import _broadcast_unbatched
+@custom_vmap
+def _ffbs_fused_single_gated(u, log_pi, log_A, log_obs, mask, gate_key, state_key):
+    return ffbs_invcdf_reference(
+        log_pi, log_A, log_obs, mask, u, gate_key, state_key
+    )
 
-    args = _broadcast_unbatched(axis_size, in_batched, args)
-    return _ffbs_batched(*args), (True, True)
+
+_ffbs_batched.def_vmap(_flatten_rule(_ffbs_batched))
+_ffbs_batched_gated.def_vmap(_flatten_rule(_ffbs_batched_gated))
+_ffbs_fused_single.def_vmap(_promote_rule(_ffbs_batched))
+_ffbs_fused_single_gated.def_vmap(_promote_rule(_ffbs_batched_gated))
 
 
 def ffbs_fused(
@@ -176,12 +245,22 @@ def ffbs_fused(
     log_A: jnp.ndarray,
     log_obs: jnp.ndarray,
     mask: Optional[jnp.ndarray] = None,
+    gate_key: Optional[jnp.ndarray] = None,
+    state_key: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """FFBS draw + marginal loglik in (at most) one fused kernel:
     ``(z [T] int32, loglik)`` for one series; under any ``vmap`` nesting
-    the batch collapses and dispatches to the Pallas TPU kernel when
-    eligible (homogeneous f32 ``log_A``, T*K <= 4096), else to the
-    scan-based inverse-CDF reference — identical draws either way.
+    the batch collapses and dispatches to a Pallas TPU kernel when
+    eligible (homogeneous f32 ``log_A``: the resident kernel at
+    T*K <= 4096, the chunked streaming kernel beyond), else to the
+    scan-based inverse-CDF reference — identical draws on every path.
+
+    ``gate_key [T]`` / ``state_key [K]`` (together or not at all) select
+    the gated-transition semantics of `kernels/vg.py` — ``log_A`` stays
+    homogeneous and the per-(step, destination) gate is computed from
+    the keys, so the soft sign gate (`hhmm-tayal2009.stan:46-70`) runs
+    the fused kernels instead of materializing a [T-1, K, K] kernel
+    into the scan path.
 
     Uses inverse-CDF sampling from ``T`` pre-drawn uniforms, so draws
     differ from :func:`ffbs_sample` (Gumbel-based) in randomness but
@@ -193,8 +272,14 @@ def ffbs_fused(
             f"ffbs_fused needs homogeneous log_A [K, K], got shape "
             f"{log_A.shape}; use ffbs_sample for time-varying transitions"
         )
+    if (gate_key is None) != (state_key is None):
+        raise ValueError("gate_key and state_key must be given together")
     T = log_obs.shape[0]
     if mask is None:
         mask = jnp.ones((T,), log_obs.dtype)
     u = jax.random.uniform(key, (T,), log_obs.dtype)
-    return _ffbs_fused_single(u, log_pi, log_A, log_obs, mask)
+    if gate_key is None:
+        return _ffbs_fused_single(u, log_pi, log_A, log_obs, mask)
+    return _ffbs_fused_single_gated(
+        u, log_pi, log_A, log_obs, mask, gate_key, state_key
+    )

@@ -14,6 +14,14 @@ the HMC gradient path. This kernel does the same for FFBS:
   ``jax.random`` OUTSIDE the kernel — no in-kernel PRNG), with the
   transition column ``A[:, z_{t+1}]`` selected by an unrolled masked
   sum over the (static, small) K destinations;
+- optionally gated transitions (same mechanism as the vg kernels,
+  `kernels/vg.py` module docstring): the per-(step, destination) gate
+  ``c[t, j] = (gate_key[t] == state_key[j])`` multiplies ``log_A`` in
+  the forward filter, and the backward draw at step t applies the
+  ``A[:, z_{t+1}]`` factor only when ``z_{t+1}`` is gate-consistent at
+  step t+1 (`hhmm-tayal2009.stan:46-70` — an inconsistent successor
+  contributes a unit pairwise factor, so the draw falls back to the
+  filter alone, exactly like a masked successor);
 - outputs: ``z [T] (f32 lanes, cast to int32 outside)`` and the
   marginal ``loglik [B]`` — the two things a Gibbs step needs.
 
@@ -31,7 +39,8 @@ the SAME uniforms is exact and pinned in interpreter mode
 
 from __future__ import annotations
 
-from typing import Tuple
+from functools import partial
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -64,18 +73,46 @@ def _sample_invcdf(logits, u):
     return z
 
 
+def _select_col(A, z_next):
+    """``A[:, z_next, :]`` per lane — unrolled masked sum over the
+    static K destinations. ``A [K, K, B]``, ``z_next [B] f32``."""
+    K = A.shape[0]
+    col = jnp.zeros((K, A.shape[2]), jnp.float32)
+    for j in range(K):
+        col = col + A[:, j, :] * (z_next[None] == float(j)).astype(jnp.float32)
+    return col
+
+
+def _select_row(sk, z_next):
+    """``sk[z_next]`` per lane over the static K axis. ``sk [K, B]``."""
+    out = jnp.zeros(z_next.shape, jnp.float32)
+    for j in range(sk.shape[0]):
+        out = out + sk[j] * (z_next == float(j)).astype(jnp.float32)
+    return out
+
+
 def _ffbs_kernel(
+    gated,
     pi_ref,  # [K, B]
     A_ref,  # [K, K, B]
     obs_ref,  # [T, K, B]
     mask_ref,  # [T, B]
     u_ref,  # [T, B]
-    ll_ref,  # out [1, B]
-    z_ref,  # out [T, B] f32
-    alpha_scr,  # scratch [T, K, B]
+    *refs,  # (+ gate_ref [T, B], sk_ref [K, B]), ll_ref, z_ref, alpha_scr
 ):
+    if gated:
+        gate_ref, sk_ref, ll_ref, z_ref, alpha_scr = refs
+        sk = sk_ref[:]
+    else:
+        ll_ref, z_ref, alpha_scr = refs
     T, K, B = obs_ref.shape
     A = A_ref[:]
+
+    def A_at(t):
+        if not gated:
+            return A
+        c_t = (gate_ref[t][None] == sk).astype(jnp.float32)  # [K(j), B]
+        return A * c_t[None, :, :]
 
     # ---- forward filter (identical to pallas_forward.py) ----
     m0 = mask_ref[0][None]
@@ -83,7 +120,7 @@ def _ffbs_kernel(
     alpha_scr[0] = alpha
 
     def fwd_body(t, alpha):
-        new = _lse0(alpha[:, None, :] + A) + obs_ref[t]
+        new = _lse0(alpha[:, None, :] + A_at(t)) + obs_ref[t]
         alpha = jnp.where(mask_ref[t][None] > 0, new, alpha)
         alpha_scr[t] = alpha
         return alpha
@@ -97,13 +134,16 @@ def _ffbs_kernel(
 
     def bwd_body(i, z_next):
         t = T - 2 - i  # T-2 .. 0
-        # A[:, z_{t+1}] per lane: unrolled masked sum over destinations
-        Acol = jnp.zeros((K, B), jnp.float32)
-        for j in range(K):
-            Acol = Acol + A[:, j, :] * (z_next[None] == float(j)).astype(jnp.float32)
-        alpha_t = alpha_scr[t]
-        # successor step padded -> draw from the filter alone
-        logits = jnp.where(mask_ref[t + 1][None] > 0, alpha_t + Acol, alpha_t)
+        Acol = _select_col(A, z_next)
+        # transition factor applies only when step t+1 is unmasked AND
+        # (if gated) z_{t+1} is gate-consistent at t+1; else the draw
+        # falls back to the filter alone (unit pairwise factor)
+        g = (mask_ref[t + 1] > 0).astype(jnp.float32)  # [B]
+        if gated:
+            g = g * (gate_ref[t + 1] == _select_row(sk, z_next)).astype(
+                jnp.float32
+            )
+        logits = alpha_scr[t] + g[None] * Acol
         z_t = _sample_invcdf(logits, u_ref[t])
         z_ref[t] = z_t
         return z_t
@@ -117,6 +157,8 @@ def pallas_ffbs(
     log_obs: jnp.ndarray,  # [B, T, K]
     mask: jnp.ndarray,  # [B, T]
     u: jnp.ndarray,  # [B, T] uniforms in [0, 1)
+    gate_key: Optional[jnp.ndarray] = None,  # [B, T]
+    state_key: Optional[jnp.ndarray] = None,  # [B, K]
     *,
     interpret: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -124,6 +166,7 @@ def pallas_ffbs(
     Pads the batch to a multiple of 128 lanes; one grid step per tile."""
     B, T, K = log_obs.shape
     Bp = -(-B // _LANES) * _LANES
+    gated = gate_key is not None
 
     def pad(x):
         return jnp.pad(x, [(0, Bp - B)] + [(0, 0)] * (x.ndim - 1))
@@ -143,10 +186,19 @@ def pallas_ffbs(
             memory_space=pltpu.VMEM,
         )
 
+    in_specs = [lanes(K), lanes(K, K), lanes(T, K), lanes(T), lanes(T)]
+    args = [pi_t, A_t, obs_t, mask_t, u_t]
+    if gated:
+        in_specs += [lanes(T), lanes(K)]
+        args += [
+            pad(gate_key.astype(jnp.float32)).transpose(1, 0),
+            pad(state_key.astype(jnp.float32)).transpose(1, 0),
+        ]
+
     ll, z = pl.pallas_call(
-        _ffbs_kernel,
+        partial(_ffbs_kernel, gated),
         grid=grid,
-        in_specs=[lanes(K), lanes(K, K), lanes(T, K), lanes(T), lanes(T)],
+        in_specs=in_specs,
         out_specs=(lanes(1), lanes(T)),
         out_shape=(
             jax.ShapeDtypeStruct((1, Bp), jnp.float32),
@@ -154,7 +206,7 @@ def pallas_ffbs(
         ),
         scratch_shapes=[pltpu.VMEM((T, K, _LANES), jnp.float32)],
         interpret=interpret,
-    )(pi_t, A_t, obs_t, mask_t, u_t)
+    )(*args)
 
     z = z.transpose(1, 0)[:B].astype(jnp.int32)  # [B, T]
     # padded tail: repeat the last valid state (scan-kernel convention)

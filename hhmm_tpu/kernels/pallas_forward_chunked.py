@@ -47,6 +47,107 @@ from hhmm_tpu.kernels.pallas_forward import _CLAMP, _LANES, _lse0, _lse1
 __all__ = ["pallas_forward_vg_chunked"]
 
 
+# ---- shared chunked-grid plumbing (also used by pallas_ffbs_chunked) ----
+
+
+def _fixed(*blk):
+    """Chunk-invariant block: same tile for every t-chunk of a batch tile."""
+    return pl.BlockSpec(
+        blk + (_LANES,),
+        index_map=lambda b, c: (0,) * len(blk) + (b,),
+        memory_space=pltpu.VMEM,
+    )
+
+
+def _t_fwd(*blk):
+    """Time-chunked block in forward chunk order."""
+    return pl.BlockSpec(
+        blk + (_LANES,),
+        index_map=lambda b, c: (c,) + (0,) * (len(blk) - 1) + (b,),
+        memory_space=pltpu.VMEM,
+    )
+
+
+def _t_rev(nc, *blk):
+    """Time-chunked block in reversed chunk order (backward passes)."""
+    return pl.BlockSpec(
+        blk + (_LANES,),
+        index_map=lambda b, c: (nc - 1 - c,) + (0,) * (len(blk) - 1) + (b,),
+        memory_space=pltpu.VMEM,
+    )
+
+
+def _t_rev_prev(nc, *blk):
+    """One-chunk lookback alongside `_t_rev` (clamped at the first chunk,
+    where the lookback block is unused)."""
+    return pl.BlockSpec(
+        blk + (_LANES,),
+        index_map=lambda b, c: (jnp.maximum(nc - 2 - c, 0),)
+        + (0,) * (len(blk) - 1)
+        + (b,),
+        memory_space=pltpu.VMEM,
+    )
+
+
+def _pad_chunked(log_pi, log_A, log_obs, mask, gate_key, state_key, t_chunk):
+    """Lane-pad the batch, chunk-pad the time axis (mask-0 carry-copy
+    steps), and transpose everything batch-minor. Returns the transposed
+    operands plus ``(Bp, Tp, nc)``."""
+    B, T, K = log_obs.shape
+    Bp = -(-B // _LANES) * _LANES
+    Tp = -(-T // t_chunk) * t_chunk
+    nc = Tp // t_chunk
+
+    def pad_b(x):
+        return jnp.pad(x, [(0, Bp - B)] + [(0, 0)] * (x.ndim - 1))
+
+    pi_t = pad_b(log_pi).transpose(1, 0)  # [K, Bp]
+    A_t = pad_b(log_A).transpose(1, 2, 0)  # [K, K, Bp]
+    obs_t = jnp.pad(pad_b(log_obs), [(0, 0), (0, Tp - T), (0, 0)]).transpose(
+        1, 2, 0
+    )  # [Tp, K, Bp]
+    mask_t = jnp.pad(
+        jnp.pad(mask.astype(jnp.float32), [(0, Bp - B), (0, 0)], constant_values=1.0),
+        [(0, 0), (0, Tp - T)],  # time padding: mask 0 (carry-copy steps)
+    ).transpose(1, 0)  # [Tp, Bp]  (f32: the FFBS kernel stores a mask
+    # row into its f32 carry scratch, so an int/bool mask must not
+    # reach the kernel)
+    gate_t = sk_t = None
+    if gate_key is not None:
+        gate_t = jnp.pad(
+            pad_b(gate_key.astype(jnp.float32)), [(0, 0), (0, Tp - T)]
+        ).transpose(1, 0)
+        sk_t = pad_b(state_key.astype(jnp.float32)).transpose(1, 0)
+    return pi_t, A_t, obs_t, mask_t, gate_t, sk_t, Bp, Tp, nc
+
+
+def _run_chunked_forward(
+    pi_t, A_t, obs_t, mask_t, gate_t, sk_t, grid, Tc, interpret
+):
+    """Pass 1 shared by the vg and FFBS chunked kernels: forward filter
+    with the per-step alpha written chunk-by-chunk to an HBM residual.
+    Returns ``(ll [1, Bp], alpha_all [Tp, K, Bp])``."""
+    Tp, K, Bp = obs_t.shape
+    gated = gate_t is not None
+    fwd_in = [_fixed(K), _fixed(K, K), _t_fwd(Tc, K), _t_fwd(Tc)]
+    fwd_args = [pi_t, A_t, obs_t, mask_t]
+    if gated:
+        fwd_in += [_t_fwd(Tc), _fixed(K)]
+        fwd_args += [gate_t, sk_t]
+    return pl.pallas_call(
+        partial(_fwd_kernel, gated),
+        grid=grid,
+        in_specs=fwd_in,
+        out_specs=(_fixed(1), _t_fwd(Tc, K)),
+        out_shape=(
+            jax.ShapeDtypeStruct((1, Bp), jnp.float32),
+            jax.ShapeDtypeStruct((Tp, K, Bp), jnp.float32),
+        ),
+        scratch_shapes=[pltpu.VMEM((K, _LANES), jnp.float32)],
+        interpret=interpret,
+    )(*fwd_args)
+
+
 def _fwd_kernel(
     gated,
     pi_ref,  # [K, B]
@@ -179,98 +280,36 @@ def pallas_forward_vg_chunked(
     batch to 128 lanes and T to a ``t_chunk`` multiple (mask-0 padding
     steps carry alpha unchanged and contribute no gradient)."""
     B, T, K = log_obs.shape
-    Bp = -(-B // _LANES) * _LANES
     Tc = t_chunk
-    Tp = -(-T // Tc) * Tc
-    nc = Tp // Tc
     gated = gate_key is not None
-
-    def pad_b(x):
-        return jnp.pad(x, [(0, Bp - B)] + [(0, 0)] * (x.ndim - 1))
-
-    pi_t = pad_b(log_pi).transpose(1, 0)  # [K, Bp]
-    A_t = pad_b(log_A).transpose(1, 2, 0)  # [K, K, Bp]
-    obs_t = jnp.pad(pad_b(log_obs), [(0, 0), (0, Tp - T), (0, 0)]).transpose(
-        1, 2, 0
-    )  # [Tp, K, Bp]
-    mask_t = jnp.pad(
-        jnp.pad(mask, [(0, Bp - B), (0, 0)], constant_values=1.0),
-        [(0, 0), (0, Tp - T)],  # time padding: mask 0 (carry-copy steps)
-    ).transpose(1, 0)  # [Tp, Bp]
-
+    pi_t, A_t, obs_t, mask_t, gate_t, sk_t, Bp, Tp, nc = _pad_chunked(
+        log_pi, log_A, log_obs, mask, gate_key, state_key, Tc
+    )
     grid = (Bp // _LANES, nc)
 
-    def fixed(*blk):
-        return pl.BlockSpec(
-            blk + (_LANES,),
-            index_map=lambda b, c: (0,) * len(blk) + (b,),
-            memory_space=pltpu.VMEM,
-        )
-
-    def t_fwd(*blk):
-        return pl.BlockSpec(
-            blk + (_LANES,),
-            index_map=lambda b, c: (c,) + (0,) * (len(blk) - 1) + (b,),
-            memory_space=pltpu.VMEM,
-        )
-
-    def t_rev(*blk):
-        return pl.BlockSpec(
-            blk + (_LANES,),
-            index_map=lambda b, c: (nc - 1 - c,) + (0,) * (len(blk) - 1) + (b,),
-            memory_space=pltpu.VMEM,
-        )
-
-    def t_rev_prev(*blk):
-        return pl.BlockSpec(
-            blk + (_LANES,),
-            index_map=lambda b, c: (jnp.maximum(nc - 2 - c, 0),)
-            + (0,) * (len(blk) - 1)
-            + (b,),
-            memory_space=pltpu.VMEM,
-        )
-
     # ---- pass 1: forward filter, residual to HBM ----
-    fwd_in = [fixed(K), fixed(K, K), t_fwd(Tc, K), t_fwd(Tc)]
-    fwd_args = [pi_t, A_t, obs_t, mask_t]
-    if gated:
-        gate_t = jnp.pad(
-            pad_b(gate_key.astype(jnp.float32)), [(0, 0), (0, Tp - T)]
-        ).transpose(1, 0)
-        sk_t = pad_b(state_key.astype(jnp.float32)).transpose(1, 0)
-        fwd_in += [t_fwd(Tc), fixed(K)]
-        fwd_args += [gate_t, sk_t]
-    ll, alpha_all = pl.pallas_call(
-        partial(_fwd_kernel, gated),
-        grid=grid,
-        in_specs=fwd_in,
-        out_specs=(fixed(1), t_fwd(Tc, K)),
-        out_shape=(
-            jax.ShapeDtypeStruct((1, Bp), jnp.float32),
-            jax.ShapeDtypeStruct((Tp, K, Bp), jnp.float32),
-        ),
-        scratch_shapes=[pltpu.VMEM((K, _LANES), jnp.float32)],
-        interpret=interpret,
-    )(*fwd_args)
+    ll, alpha_all = _run_chunked_forward(
+        pi_t, A_t, obs_t, mask_t, gate_t, sk_t, grid, Tc, interpret
+    )
 
     # ---- pass 2: backward smoother + gradients, reversed chunks ----
     bwd_in = [
-        fixed(K, K),
-        t_rev(Tc, K),
-        t_rev(Tc),
-        t_rev(Tc, K),
-        t_rev_prev(Tc, K),
-        fixed(1),
+        _fixed(K, K),
+        _t_rev(nc, Tc, K),
+        _t_rev(nc, Tc),
+        _t_rev(nc, Tc, K),
+        _t_rev_prev(nc, Tc, K),
+        _fixed(1),
     ]
     bwd_args = [A_t, obs_t, mask_t, alpha_all, alpha_all, ll]
     if gated:
-        bwd_in += [t_rev(Tc), fixed(K)]
+        bwd_in += [_t_rev(nc, Tc), _fixed(K)]
         bwd_args += [gate_t, sk_t]
     dpi, dA, dobs = pl.pallas_call(
         partial(_bwd_kernel, gated),
         grid=grid,
         in_specs=bwd_in,
-        out_specs=(fixed(K), fixed(K, K), t_rev(Tc, K)),
+        out_specs=(_fixed(K), _fixed(K, K), _t_rev(nc, Tc, K)),
         out_shape=(
             jax.ShapeDtypeStruct((K, Bp), jnp.float32),
             jax.ShapeDtypeStruct((K, K, Bp), jnp.float32),

@@ -15,6 +15,7 @@ import pytest
 from hhmm_tpu.kernels import forward_backward, forward_filter
 from hhmm_tpu.kernels.ffbs import ffbs_fused, ffbs_invcdf_reference
 from hhmm_tpu.kernels.pallas_ffbs import pallas_ffbs
+from hhmm_tpu.kernels.pallas_ffbs_chunked import pallas_ffbs_chunked
 
 
 def _random_hmm(rng, T, K, masked_tail=0):
@@ -59,6 +60,126 @@ class TestKernelParity:
         )
         _, ll_ref = forward_filter(log_pi, log_A, log_obs, mask)
         np.testing.assert_allclose(float(ll[0]), float(ll_ref), rtol=1e-5)
+
+
+def _stack_hmms(rng, B, T, K, masked_tail=0):
+    hmms = [_random_hmm(rng, T, K, masked_tail) for _ in range(B)]
+    return tuple(jnp.stack([h[i] for h in hmms]) for i in range(4))
+
+
+def _random_gate(rng, B, T, K):
+    """Tayal-style sign gate: binary per-step key, half the states in
+    each sign group."""
+    gate_key = jnp.asarray(rng.integers(0, 2, size=(B, T)), jnp.float32)
+    state_key = jnp.asarray(
+        np.tile((np.arange(K) % 2).astype(np.float32), (B, 1))
+    )
+    return gate_key, state_key
+
+
+def _materialized_reference(log_pi, log_A, log_obs, mask, u, gate_key, state_key):
+    """Gated FFBS via the MATERIALIZED time-varying kernel Ã_t =
+    where(c, A, unit) — the `models/tayal.py::build` form — with
+    inverse-CDF draws. Pins that the gate-key path is the same
+    distribution computation as the materialized path."""
+    T, K = log_obs.shape
+    c = gate_key[:, None] == state_key[None, :]  # [T, K]
+    log_A_t = jnp.where(c[1:, None, :], log_A[None], 0.0)
+    log_alpha, ll = forward_filter(log_pi, log_A_t, log_obs, mask)
+
+    def _invcdf(logits, u_t):
+        p = jax.nn.softmax(logits)
+        return jnp.sum(u_t >= jnp.cumsum(p[:-1])).astype(jnp.int32)
+
+    z_last = _invcdf(log_alpha[T - 1], u[T - 1])
+
+    def step(z_next, xs):
+        alpha_t, m_next, u_t, lA = xs
+        logits = jnp.where(m_next > 0, alpha_t + lA[:, z_next], alpha_t)
+        z = _invcdf(logits, u_t)
+        return z, z
+
+    _, z_rest = jax.lax.scan(
+        step, z_last, (log_alpha[:-1], mask[1:], u[:-1], log_A_t), reverse=True
+    )
+    z = jnp.concatenate([z_rest, z_last[None]]).astype(jnp.int32)
+    T_last = jnp.sum(mask).astype(jnp.int32) - 1
+    z = jnp.where(jnp.arange(T) <= T_last, z, z[T_last])
+    return z, ll
+
+
+class TestGatedParity:
+    """The gate-key FFBS paths (scan reference, resident kernel, chunked
+    kernel) against the materialized time-varying form — the semantics
+    `models/tayal.py` fits to real ticks (`hhmm-tayal2009.stan:46-70`)."""
+
+    @pytest.mark.parametrize("masked_tail", [0, 7])
+    def test_reference_matches_materialized(self, rng, masked_tail):
+        B, T, K = 4, 37, 4
+        log_pi, log_A, log_obs, mask = _stack_hmms(rng, B, T, K, masked_tail)
+        gk, sk = _random_gate(rng, B, T, K)
+        u = jnp.asarray(rng.uniform(size=(B, T)), jnp.float32)
+        z_r, ll_r = jax.vmap(ffbs_invcdf_reference)(
+            log_pi, log_A, log_obs, mask, u, gk, sk
+        )
+        z_m, ll_m = jax.vmap(_materialized_reference)(
+            log_pi, log_A, log_obs, mask, u, gk, sk
+        )
+        np.testing.assert_array_equal(np.asarray(z_r), np.asarray(z_m))
+        np.testing.assert_allclose(np.asarray(ll_r), np.asarray(ll_m), rtol=1e-5)
+
+    @pytest.mark.parametrize("masked_tail", [0, 7])
+    def test_resident_kernel_interpret(self, rng, masked_tail):
+        B, T, K = 5, 33, 4
+        log_pi, log_A, log_obs, mask = _stack_hmms(rng, B, T, K, masked_tail)
+        gk, sk = _random_gate(rng, B, T, K)
+        u = jnp.asarray(rng.uniform(size=(B, T)), jnp.float32)
+        z_k, ll_k = pallas_ffbs(
+            log_pi, log_A, log_obs, mask, u, gk, sk, interpret=True
+        )
+        z_r, ll_r = jax.vmap(ffbs_invcdf_reference)(
+            log_pi, log_A, log_obs, mask, u, gk, sk
+        )
+        np.testing.assert_array_equal(np.asarray(z_k), np.asarray(z_r))
+        np.testing.assert_allclose(np.asarray(ll_k), np.asarray(ll_r), rtol=1e-5)
+
+
+class TestChunkedKernel:
+    """Chunked-T FFBS kernel (interpreter mode) vs the scan reference:
+    draws must be identical across chunk boundaries, T padding, ragged
+    masks, and gating."""
+
+    def _check(self, rng, B, T, K, masked_tail=0, gated=False, t_chunk=16):
+        log_pi, log_A, log_obs, mask = _stack_hmms(rng, B, T, K, masked_tail)
+        u = jnp.asarray(rng.uniform(size=(B, T)), jnp.float32)
+        gate = _random_gate(rng, B, T, K) if gated else ()
+        z_k, ll_k = pallas_ffbs_chunked(
+            log_pi, log_A, log_obs, mask, u, *gate, t_chunk=t_chunk, interpret=True
+        )
+        z_r, ll_r = jax.vmap(ffbs_invcdf_reference)(
+            log_pi, log_A, log_obs, mask, u, *gate
+        )
+        np.testing.assert_array_equal(np.asarray(z_k), np.asarray(z_r))
+        np.testing.assert_allclose(np.asarray(ll_k), np.asarray(ll_r), rtol=1e-5)
+
+    def test_exact_chunk_multiple(self, rng):
+        self._check(rng, B=4, T=48, K=3)
+
+    def test_padded_final_chunk(self, rng):
+        self._check(rng, B=4, T=50, K=3)
+
+    def test_single_chunk(self, rng):
+        self._check(rng, B=3, T=11, K=2)
+
+    def test_masked_tail_crossing_chunks(self, rng):
+        # tail spans the padded region AND the last full chunk
+        self._check(rng, B=4, T=40, K=3, masked_tail=12)
+
+    def test_gated(self, rng):
+        self._check(rng, B=4, T=50, K=4, gated=True)
+
+    def test_gated_masked(self, rng):
+        self._check(rng, B=4, T=47, K=4, masked_tail=9, gated=True)
 
 
 class TestDrawDistribution:
