@@ -249,7 +249,8 @@ def main() -> None:
         default="nuts",
         help="nuts (default; Stan semantics); chees — per-posterior "
         "cross-chain adaptation (infer/chees.py), --chains >= 2; gibbs — "
-        "blocked conjugate FFBS (discrete-emission configs only: tayal)",
+        "blocked conjugate FFBS (conjugate configs: tayal, hmm, and "
+        "jangmin via the route-augmented tree sampler, hhmm/routes.py)",
     )
     ap.add_argument("--chains", type=int, default=None)
     ap.add_argument("--max-leapfrogs", type=int, default=32)
@@ -292,11 +293,12 @@ def main() -> None:
             max_treedepth=args.max_treedepth,
         )
     if args.sampler == "gibbs":
-        bad = [c for c in args.configs if c not in ("tayal", "hmm")]
+        bad = [c for c in args.configs if c not in ("tayal", "hmm", "jangmin")]
         if bad:
             raise SystemExit(
                 f"--sampler gibbs supports only the conjugate configs "
-                f"(tayal, hmm); drop {bad} or use --configs tayal hmm"
+                f"(tayal, hmm, jangmin); drop {bad} or use "
+                f"--configs tayal hmm jangmin"
             )
     from dataclasses import replace as _replace
 

@@ -1,0 +1,283 @@
+"""Route decomposition of the flat HHMM transition law — the data
+augmentation that makes tree models conjugate.
+
+:func:`hhmm_tpu.hhmm.compile.compile_params` expands the hierarchy into
+a flat ``A[i, j]`` by SUMMING over routes: from leaf i, exit 0+ levels
+(End mass at each ancestor), take one horizontal sibling step at a
+common ancestor of i and j (or fall off the root and restart), then
+enter vertically down to j (pi mass at each node on j's path)
+(`hhmm/R/hhmm-sim.R:73-99`). Each route's probability is a product of
+per-node (pi, A) ENTRIES — so conditioned on which route every step
+took, the augmented likelihood factorizes into independent multinomials
+per node row, and flat-prior tree models (MaskedSimplex slots,
+`models/tree.py`) get closed-form Dirichlet conditionals: the blocked
+Gibbs sampler the reference's abandoned Jangmin replication needed
+(`hhmm/sim-jangmin2004.R:1963-2010` calls a Stan model that does not
+exist; NUTS/ChEES mix poorly on the 63-leaf tree — bench_zoo r4).
+
+:class:`RouteTable` precomputes, once per tree (all numpy, structural —
+zero traced branching):
+
+- a global index space over every (node, pi/A-row, column) entry with
+  structural support, with a value plan mapping free-slot parameters
+  (``models/tree.py::TreeHMM._slots``) and deterministic spec constants
+  into one flat value vector;
+- ``ev_idx [K, K, R, M]``: for each ordered leaf pair and route, the
+  (padded) list of entry indices whose product is that route's
+  probability — shared by route SAMPLING (route log-prob = sum of log
+  values, gathered) and route COUNTING (scatter-add of the chosen
+  route's events);
+- ``init_idx [K, M0]``: the t=0 vertical-entry events (the flat pi is a
+  pure product — no route choice).
+
+Identity pinned by ``tests/test_routes.py``: for any admissible values,
+``logsumexp_r(route_logprob[i, j, :]) == log A_flat[i, j]`` and
+``sum(init events) == log pi_flat`` against ``compile_params`` — route
+decomposition IS the compile algebra, per-route.
+
+Limitation: a node row may route exit mass through at most one End
+child (every tree in the repo does); multiple supported End columns in
+one row would make the exit event ambiguous and raise at construction.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from hhmm_tpu.hhmm.structure import End, Internal, Production, iter_leaves
+
+__all__ = ["RouteTable"]
+
+
+class RouteTable:
+    """Static route/event tables for one finalized tree.
+
+    ``slots`` is ``TreeHMM._slots`` — (name, kind, node_idx, row_idx,
+    support) over ``inodes`` (the DFS internal-node list) — so the value
+    plan can address free parameters by name.
+    """
+
+    def __init__(self, root: Internal, inodes: List[Internal], slots):
+        leaves = iter_leaves(root)
+        K = len(leaves)
+        node_idx = {id(n): d for d, n in enumerate(inodes)}
+
+        # ancestor chains: chain[i] = [(node, child-index-on-path), ...]
+        # from parent up to root
+        chains = []
+        for p in leaves:
+            chain = []
+            cur = p
+            while cur.parent is not None:
+                chain.append((cur.parent, cur.index))
+                cur = cur.parent
+            chains.append(chain)
+        Dmax = max(len(c) for c in chains)
+        R = Dmax + 1  # horizontal move at height 0..Dmax-1, or root restart
+
+        # ---- entry index space + value plan ----
+        self._entries: List[Tuple[int, str, int, int]] = []  # (node_d, kind, row, col)
+        index: Dict[Tuple[int, str, int, int], int] = {}
+        free_of = {}  # (node_d, kind, row) -> slot name
+        for name, kind, d, i, _support in slots:
+            free_of[(d, kind, i)] = name
+
+        def eidx(node, kind, row, col) -> int:
+            d = node_idx[id(node)]
+            key = (d, kind, row, col)
+            if key not in index:
+                index[key] = len(self._entries)
+                self._entries.append(key)
+            return index[key]
+
+        def end_col(node, row) -> int:
+            """The single supported End column of this row (or -1)."""
+            cols = [
+                j
+                for j, sib in enumerate(node.children)
+                if isinstance(sib, End) and node.A[row][j] > 0.0
+            ]
+            if len(cols) > 1:
+                raise NotImplementedError(
+                    f"node {node.name!r} row {row} routes exit mass through "
+                    f"{len(cols)} End children; route augmentation needs at "
+                    "most one"
+                )
+            return cols[0] if cols else -1
+
+        def entry_events(j: int, h: int):
+            """Vertical-entry events for leaf j from its ancestor at
+            height h down (pi picks at heights h-1 .. 0); None if some
+            pi entry lacks structural support."""
+            ev = []
+            for l in range(h - 1, -1, -1):
+                node, col = chains[j][l]
+                if node.pi[col] <= 0.0:
+                    return None
+                ev.append(eidx(node, "pi", -1, col))
+            return ev
+
+        ev_lists = [[[None] * R for _ in range(K)] for _ in range(K)]
+        for i in range(K):
+            exits: List[int] = []  # accumulated End events below height h
+            exits_ok = True
+            for h in range(len(chains[i]) + 1):
+                if not exits_ok:
+                    break
+                if h == len(chains[i]):  # root restart: all levels exited
+                    for j in range(K):
+                        ent = entry_events(j, len(chains[j]))
+                        if ent is None:
+                            continue
+                        ev_lists[i][j][Dmax] = exits + ent
+                    break
+                node, ci = chains[i][h]
+                row = np.asarray(node.A[ci])
+                for j in range(K):
+                    # is node a common ancestor of j, and at what height?
+                    hj = next(
+                        (
+                            l
+                            for l in range(len(chains[j]))
+                            if chains[j][l][0] is node
+                        ),
+                        None,
+                    )
+                    if hj is None:
+                        continue
+                    cj = chains[j][hj][1]
+                    if row[cj] <= 0.0:
+                        continue
+                    ent = entry_events(j, hj)
+                    if ent is None:
+                        continue
+                    ev_lists[i][j][h] = (
+                        exits + [eidx(node, "A", ci, cj)] + ent
+                    )
+                ec = end_col(node, ci)
+                if ec < 0:
+                    exits_ok = False  # cannot exit this level: no higher routes
+                else:
+                    exits.append(eidx(node, "A", ci, ec))
+
+        # leaves with zero vertical-entry mass (e.g. a string leaf only
+        # reachable by horizontal advance) have flat pi[j] = 0 — their
+        # init row stays all-padding and init_valid masks the logprob
+        init_lists = [entry_events(j, len(chains[j])) for j in range(K)]
+        init_valid = np.asarray([e is not None for e in init_lists])
+
+        # every free-slot support column gets a position even if no route
+        # ever references it (its count is then always zero) — so the
+        # Dirichlet gather below covers the whole support
+        self.slot_count_pos: Dict[str, np.ndarray] = {}
+        self.slot_cols: Dict[str, np.ndarray] = {}
+        for name, kind, d, i, support in slots:
+            cols = np.flatnonzero(np.asarray(support))
+            pos = []
+            for col in cols:
+                key = (d, kind, i if kind == "A" else -1, int(col))
+                if key not in index:
+                    index[key] = len(self._entries)
+                    self._entries.append(key)
+                pos.append(index[key])
+            self.slot_count_pos[name] = np.asarray(pos, np.int32)
+            self.slot_cols[name] = cols
+
+        S = len(self._entries)  # final: padding index = S
+        M = max(
+            [len(e) for row in ev_lists for cell in row for e in cell if e]
+            + [1]
+        )
+        M0 = max([len(e) for e in init_lists if e is not None] + [1])
+        ev_idx = np.full((K, K, R, M), S, np.int32)  # S = padding (log 1)
+        valid = np.zeros((K, K, R), bool)
+        for i in range(K):
+            for j in range(K):
+                for r in range(R):
+                    e = ev_lists[i][j][r]
+                    if e is None:
+                        continue
+                    valid[i, j, r] = True
+                    ev_idx[i, j, r, : len(e)] = e
+        init_idx = np.full((K, M0), S, np.int32)
+        for j, e in enumerate(init_lists):
+            if e is not None:
+                init_idx[j, : len(e)] = e
+
+        # ---- value plan: entry -> (free param gather) or constant ----
+        # free entries grouped per slot: one vectorized gather/scatter
+        # pair per slot instead of one scalar op per entry
+        const = np.zeros(S)
+        by_slot: Dict[str, List[Tuple[int, int]]] = {}
+        for s, (d, kind, row, col) in enumerate(self._entries):
+            node = inodes[d]
+            name = free_of.get((d, kind, row if kind == "A" else -1))
+            if name is not None:
+                by_slot.setdefault(name, []).append((s, col))
+            else:
+                const[s] = (node.pi if kind == "pi" else node.A[row])[col]
+                assert const[s] > 0.0, (node.name, kind, row, col)
+        self.free_plan = [
+            (
+                name,
+                np.asarray([p for p, _ in pairs], np.int32),
+                np.asarray([c for _, c in pairs], np.int32),
+            )
+            for name, pairs in by_slot.items()
+        ]
+
+        self.K, self.R, self.S, self.M = K, R, S, M
+        self.ev_idx = ev_idx
+        self.valid = valid
+        self.init_idx = init_idx
+        self.init_valid = init_valid
+        self.const = const
+
+    # ---- per-draw value assembly (jnp) ----
+
+    def values(self, params):
+        """Flat value vector [S] of every route entry under the current
+        free-slot parameters (constants filled from the spec)."""
+        import jax.numpy as jnp
+
+        vals = jnp.asarray(self.const)
+        for name, pos, cols in self.free_plan:
+            vals = vals.at[jnp.asarray(pos)].set(params[name][jnp.asarray(cols)])
+        return vals
+
+    def route_logprobs(self, params, mask_neg: float = -1.0e30):
+        """[K, K, R] route log-probabilities under ``params`` (invalid
+        routes at ``mask_neg``). ``logsumexp`` over R equals the log of
+        the compiled flat A (pinned by tests/test_routes.py)."""
+        import jax.numpy as jnp
+
+        vals = self.values(params)
+        logv = jnp.log(jnp.maximum(vals, 1e-300))
+        logv_ext = jnp.concatenate([logv, jnp.zeros((1,))])  # padding = log 1
+        lr = logv_ext[jnp.asarray(self.ev_idx)].sum(axis=-1)
+        return jnp.where(jnp.asarray(self.valid), lr, mask_neg)
+
+    def init_logprobs(self, params, mask_neg: float = -1.0e30):
+        """[K] log of the compiled flat pi (pure product — no routes;
+        leaves with zero vertical-entry mass at ``mask_neg``)."""
+        import jax.numpy as jnp
+
+        vals = self.values(params)
+        logv = jnp.log(jnp.maximum(vals, 1e-300))
+        logv_ext = jnp.concatenate([logv, jnp.zeros((1,))])
+        lp = logv_ext[jnp.asarray(self.init_idx)].sum(axis=-1)
+        return jnp.where(jnp.asarray(self.init_valid), lp, mask_neg)
+
+    def counts(self, z, routes, w, z0_w=1.0):
+        """Entry-count vector [S] for a state path ``z [T]`` with
+        chosen ``routes [T-1]`` and per-step weights ``w [T-1]`` (soft
+        gate / mask), plus the t=0 entry events weighted ``z0_w``."""
+        import jax.numpy as jnp
+
+        ev = jnp.asarray(self.ev_idx)[z[:-1], z[1:], routes]  # [T-1, M]
+        c = jnp.zeros((self.S + 1,))
+        c = c.at[ev].add(jnp.broadcast_to(w[:, None], ev.shape))
+        c = c.at[jnp.asarray(self.init_idx)[z[0]]].add(z0_w)
+        return c[:-1]

@@ -290,6 +290,159 @@ class TreeHMM(BaseHMMModel):
         g = jnp.asarray(data["g"], jnp.float32)
         return g, jnp.asarray(self.groups, jnp.float32)
 
+    # ---- blocked Gibbs (route-augmented conjugacy) ----
+
+    @property
+    def routes(self):
+        """Lazily-built static route table (`hhmm/routes.py`) — the data
+        augmentation that factorizes the flat transition likelihood into
+        per-node multinomials."""
+        if getattr(self, "_routes", None) is None:
+            from hhmm_tpu.hhmm.routes import RouteTable
+
+            self._routes = RouteTable(self.root, self._inodes, self._slots)
+            # flat gather plan for one vectorized Dirichlet draw across
+            # every free slot: gamma(1 + counts) / segment-sum
+            pos, seg, plan = [], [], []
+            for si, (name, _k, _d, _i, _s) in enumerate(self._slots):
+                p = self._routes.slot_count_pos[name]
+                pos.append(p)
+                seg.append(np.full(len(p), si, np.int32))
+                plan.append((name, self._routes.slot_cols[name], len(p)))
+            self._dir_pos = np.concatenate(pos) if pos else np.zeros(0, np.int32)
+            self._dir_seg = np.concatenate(seg) if seg else np.zeros(0, np.int32)
+            self._dir_plan = plan
+        return self._routes
+
+    @property
+    def gibbs_gate_modes(self):
+        # hard semisup gating only masks emissions (transitions stay the
+        # exact compiled HMM); the stan soft gate is conjugate through
+        # destination-consistency count weights, exactly as in
+        # models/tayal.py (an inconsistent step's pairwise factor is a
+        # unit — no information about any transition slot)
+        return ("hard", "stan")
+
+    def gibbs_update(self, key, z, data, params):
+        """Conjugate parameter block for blocked Gibbs (`infer/gibbs.py`)
+        on the tree's own parameters — the sampler the reference's
+        abandoned Jangmin replication needed (`hhmm/sim-jangmin2004.R:
+        1963-2010`; the Stan model it calls does not exist).
+
+        Augments each flat step with its ROUTE through the hierarchy
+        (drawn from the exact conditional — the per-route factors of
+        `hhmm/routes.py`, whose sum is pinned to the compiled flat A).
+        Given routes, every free MaskedSimplex slot's conditional under
+        its flat prior is Dirichlet(1 + event counts): exit events
+        (child→End), horizontal sibling moves, and vertical pi picks
+        each increment exactly one entry of one node row. Gaussian
+        leaves: mu | sigma is conjugate normal under the N(0, s_mu)
+        prior; sigma takes 2 Metropolis-within-Gibbs steps in log-space
+        targeting the half-normal-prior conditional (valid MCMC; the
+        conditional is parameter-separable per leaf). Categorical
+        leaves: Dirichlet on emission counts. Requires
+        ``order_mu="none"`` for Gaussian leaves (the ordered-cone
+        constraint breaks per-leaf separability)."""
+        import jax.ops
+
+        if self.family == "gaussian":
+            if self.order_mu != "none":
+                raise ValueError(
+                    "TreeHMM.gibbs_update needs order_mu='none' (the "
+                    "ordered-mean constraint breaks per-leaf conjugacy); "
+                    "use an HMC sampler for ordered models"
+                )
+            if self.prior_mu_scale is None:
+                raise ValueError(
+                    "TreeHMM.gibbs_update needs a proper mu prior "
+                    "(prior_mu_scale); a flat prior is improper for "
+                    "leaves with no assigned observations"
+                )
+        rt = self.routes
+        x = jnp.asarray(data["x"])
+        mask = data.get("mask")
+        T = z.shape[0]
+        k_r, k_dir, k_mu, k_sig = jax.random.split(key, 4)
+
+        # 1) route per step from its exact conditional
+        lr = rt.route_logprobs(params)  # [K, K, R]
+        step_lr = lr[z[:-1], z[1:]]  # [T-1, R]
+        routes = jax.random.categorical(k_r, step_lr, axis=-1)
+
+        # 2) transition-event counts (soft gate: steps whose destination
+        # is label-inconsistent carry a unit pairwise factor — zero
+        # weight, exactly the Tayal consistency weighting)
+        w = jnp.ones((T - 1,)) if mask is None else jnp.asarray(mask)[1:]
+        if self.semisup and self.gate_mode == "stan":
+            g = jnp.asarray(data["g"], jnp.int32)
+            cons = g[:, None] == jnp.asarray(self.groups)[None, :]  # [T, K]
+            w = w * cons[jnp.arange(1, T), z[1:]].astype(w.dtype)
+        counts = rt.counts(z, routes, w)
+
+        # 3) one vectorized Dirichlet draw across all free slots
+        new_params = dict(params)
+        if len(self._dir_pos):
+            c_free = counts[jnp.asarray(self._dir_pos)]
+            gam = jax.random.gamma(k_dir, 1.0 + c_free)
+            seg = jnp.asarray(self._dir_seg)
+            denom = jax.ops.segment_sum(gam, seg, num_segments=len(self._slots))
+            vals = gam / denom[seg]
+            off = 0
+            for (name, cols, ln), (_n, _k, _d, _i, support) in zip(
+                self._dir_plan, self._slots
+            ):
+                new_params[name] = (
+                    jnp.zeros((len(support),)).at[jnp.asarray(cols)].set(
+                        vals[off : off + ln]
+                    )
+                )
+                off += ln
+
+        # 4) emissions
+        m = jnp.ones((T,)) if mask is None else jnp.asarray(mask)
+        if self.family == "categorical":
+            from hhmm_tpu.infer.gibbs import emission_counts
+
+            c_emis = emission_counts(z, x.astype(jnp.int32), self.K, self.L, m)
+            new_params["phi_k"] = jax.random.dirichlet(k_mu, 1.0 + c_emis)
+            return new_params
+
+        oh = jax.nn.one_hot(z, self.K, dtype=x.dtype) * m[:, None]
+        n_k = oh.sum(axis=0)  # [K]
+        s1 = oh.T @ x
+        s2 = oh.T @ (x * x)
+        sigma = params["sigma"]
+        prec = n_k / sigma**2 + 1.0 / self.prior_mu_scale**2
+        var = 1.0 / prec
+        mu = (s1 / sigma**2) * var + jnp.sqrt(var) * jax.random.normal(
+            k_mu, (self.K,)
+        )
+        new_params["mu"] = mu
+
+        rss = s2 - 2.0 * mu * s1 + n_k * mu**2  # Σ (x - mu_z)² per leaf
+
+        def log_target(sig):
+            ll = -n_k * jnp.log(sig) - 0.5 * rss / sig**2
+            if self.prior_sigma_scale is not None:
+                ll = ll - 0.5 * (sig / self.prior_sigma_scale) ** 2
+            return ll
+
+        lower = 1e-4  # Positive bijector support floor (specs())
+        for step_key in jax.random.split(k_sig, 2):
+            kp, ka = jax.random.split(step_key)
+            prop = sigma * jnp.exp(0.3 * jax.random.normal(kp, (self.K,)))
+            log_acc = (
+                log_target(prop)
+                - log_target(sigma)
+                + jnp.log(prop)
+                - jnp.log(sigma)  # log-space proposal Jacobian
+            )
+            log_acc = jnp.where(prop > lower, log_acc, -jnp.inf)
+            accept = jnp.log(jax.random.uniform(ka, (self.K,))) < log_acc
+            sigma = jnp.where(accept, prop, sigma)
+        new_params["sigma"] = sigma
+        return new_params
+
     # ---- init ----
 
     def init_unconstrained(self, key, data):
