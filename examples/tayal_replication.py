@@ -367,7 +367,12 @@ def run_registered(args) -> Dict:
         ml_weighted_pool,
         per_draw_relabel_stats,
     )
-    from hhmm_tpu.infer import ChEESConfig, GibbsConfig, sample_gibbs
+    from hhmm_tpu.infer import (
+        ChEESConfig,
+        GibbsConfig,
+        SamplerConfig,
+        sample_gibbs,
+    )
     from hhmm_tpu.models import TayalHHMMLite
 
     from hhmm_tpu.batch import ResultCache, digest_key
@@ -515,6 +520,53 @@ def run_registered(args) -> Dict:
         model, q_informed, data_ins, jax.random.PRNGKey(78)
     )
 
+    # ---- provenance: reference-mimic run (VERDICT r4 ask 7) ----
+    # ONE chain at the reference's own budget and init discipline
+    # (`tayal2009/main.R:34-39`: single Stan chain, 250 warmup + 250
+    # iter; the informed init_unconstrained is the k-means-analog chain
+    # start) — turns "this is what a single shallowly-converged chain
+    # reports from the intended basin" from an inference into a
+    # measurement. Round-3 upper-band chains spanned phi_45 0.85-0.94.
+    ck = digest_key({"stage": "registered-provenance-v1", "window": span})
+    hit = cache.get(ck)
+    if hit is None:
+        cfg_m = SamplerConfig(
+            num_warmup=250, num_samples=250, num_chains=1, max_treedepth=10
+        )
+        res_m = run_window(
+            price, size, t, ins_end, config=cfg_m,
+            key=jax.random.PRNGKey(9400),
+        )
+        _, pc_m, _ = _relabeled_phis(model, res_m, price, res_m.zig)
+        hit = {
+            "phi_45": np.array([pc_m[0]["phi_45"]]),
+            "phi_25": np.array([pc_m[0]["phi_25"]]),
+            "mean_logp": np.array([pc_m[0]["mean_logp"]]),
+            "divergence_rate": np.array(
+                [float(np.mean(res_m.stats.get("diverging", np.zeros(1))))]
+            ),
+        }
+        cache.put(ck, hit)
+    provenance = {
+        "description": (
+            "reference-mimic: 1 NUTS chain, 250 warmup + 250 draws, "
+            "informed (k-means-analog) init, ex-post relabel — the "
+            "published number's own sampler discipline "
+            "(`tayal2009/main.R:34-39`, `main.Rmd:560`)"
+        ),
+        "phi_45": round(float(hit["phi_45"][0]), 4),
+        "phi_25": round(float(hit["phi_25"][0]), 4),
+        "chain_mean_logp": round(float(hit["mean_logp"][0]), 1),
+        "divergence_rate": round(float(hit["divergence_rate"][0]), 4),
+        "seed": 9400,
+        "context": (
+            "expected in the intended-basin upper band (r3 chains: "
+            "0.85-0.94) if the defect-#8 narrative is right — a "
+            "single budget-limited chain from the informed init stays "
+            "in the basin and reports a published-like value"
+        ),
+    }
+
     # ---- fixed decision rule (`docs/phi_protocol.md`) ----
     agree = {
         k: abs(primary[k] - gibbs[k]) for k in ("phi_45", "phi_25")
@@ -543,6 +595,7 @@ def run_registered(args) -> Dict:
             "point_match_le_0p05": point_match,
         },
         "gibbs_crosscheck": gibbs,
+        "provenance": provenance,
         "corroboration": {
             "abs_gap_primary_vs_gibbs": {k: round(v, 4) for k, v in agree.items()},
             "corroborated_le_0p05": corroborated,
@@ -900,10 +953,14 @@ def main():
         with open(path) as f:
             merged = json.load(f)
     # the warm-started wf is recorded BESIDE the cold protocol run,
-    # never over it (the replication record is cold-start)
+    # never over it (the replication record is cold-start); likewise the
+    # conjugate-Gibbs arm of the backtest records beside the ChEES
+    # protocol arm, never over it
     record_key = (
         "wf_warm" if (args.stage == "wf" and args.warm_start) else args.stage
     )
+    if args.stage == "wf" and args.sampler == "gibbs":
+        record_key = "wf_gibbs_warm" if args.warm_start else "wf_gibbs"
     merged[record_key] = out
     with open(path, "w") as f:
         json.dump(merged, f, indent=1)

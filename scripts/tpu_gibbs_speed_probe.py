@@ -1,0 +1,98 @@
+"""Registered-stage Gibbs timing: materialized-scan path (round 4) vs
+the fused gated FFBS kernels (round 5).
+
+VERDICT r4 ask 1's done criterion: the soft-gate conjugate Gibbs arm at
+the registered-stage shape (16 chains, T = 8,386-leg window — budgets
+were sized on a synthetic window of the real shape, per
+`docs/phi_protocol.md` provenance notes) must run >= 5x faster than the
+round-4 scan path (~40 ms/draw). The old path is reproduced exactly by
+a subclass whose ``gate_keys`` returns None: ``sample_gibbs`` then
+takes ``build`` (materialized time-varying kernel) into scan-FFBS —
+the round-4 dispatch.
+
+Writes `results/gibbs_fused_timing.json`. Tunnel discipline: fresh PRNG
+keys per timed call (byte-identical requests are memoized), timing via
+block_until_ready + host reduction. Wall target < 5 min.
+"""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+OUT = os.path.join(
+    os.path.dirname(__file__), "..", "results", "gibbs_fused_timing.json"
+)
+
+
+def synth_window(T, seed=0):
+    """Tick-like (x, sign) at the registered window's shape: symbols
+    0..8, ~1/3 same-sign adjacent legs (the real-data rate)."""
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, 9, size=T).astype(np.int32)
+    sign = np.zeros(T, np.int32)
+    for t in range(1, T):
+        sign[t] = sign[t - 1] ^ (rng.random() < 2 / 3)
+    return jnp.asarray(x), jnp.asarray(sign)
+
+
+def time_path(model, data, chains, draws, seed):
+    from hhmm_tpu.infer.gibbs import GibbsConfig, sample_gibbs
+
+    cfg = GibbsConfig(num_warmup=1, num_samples=draws, num_chains=chains)
+
+    def run(key):
+        qs, st = sample_gibbs(model, data, key, cfg)
+        return st["logp"]
+
+    lp = run(jax.random.PRNGKey(seed))  # compile + run
+    float(np.asarray(lp.sum()))
+    t0 = time.time()
+    lp = run(jax.random.PRNGKey(seed + 1))  # fresh key: defeats memoization
+    float(np.asarray(lp.sum()))
+    dt = time.time() - t0
+    return dt, dt / (draws + 1) * 1e3  # ms per sweep (all chains)
+
+
+def main():
+    assert jax.default_backend() == "tpu", jax.default_backend()
+    from hhmm_tpu.models import TayalHHMMLite
+
+    T, chains = 8386, 16
+    x, sign = synth_window(T)
+    data = {"x": x, "sign": sign}
+
+    class ScanPathTayal(TayalHHMMLite):
+        """Round-4 dispatch: no gate keys -> materialized kernel + scan
+        FFBS (`infer/gibbs.py` pre-round-5 behavior)."""
+
+        def gate_keys(self, data):
+            return None
+
+    new = TayalHHMMLite()  # stan gate, gate keys -> fused chunked FFBS
+    old = ScanPathTayal()
+
+    rec = {"device": str(jax.devices()[0]), "ts": time.strftime("%F %T"),
+           "shape": {"T": T, "chains": chains, "gate": "stan"}}
+    dt_new, ms_new = time_path(new, data, chains, draws=400, seed=11)
+    print(f"fused gated FFBS: {dt_new:.2f}s for 401 sweeps = {ms_new:.2f} ms/sweep",
+          flush=True)
+    dt_old, ms_old = time_path(old, data, chains, draws=50, seed=21)
+    print(f"materialized scan: {dt_old:.2f}s for 51 sweeps = {ms_old:.2f} ms/sweep",
+          flush=True)
+    rec["fused"] = {"draws": 400, "wall_s": round(dt_new, 3),
+                    "ms_per_sweep": round(ms_new, 3)}
+    rec["scan_r4"] = {"draws": 50, "wall_s": round(dt_old, 3),
+                      "ms_per_sweep": round(ms_old, 3)}
+    rec["speedup"] = round(ms_old / ms_new, 2)
+    print("speedup:", rec["speedup"], flush=True)
+    with open(OUT, "w") as f:
+        json.dump(rec, f, indent=1)
+    print("wrote", OUT)
+
+
+if __name__ == "__main__":
+    main()
