@@ -559,13 +559,50 @@ def run_registered(args) -> Dict:
         "chain_mean_logp": round(float(hit["mean_logp"][0]), 1),
         "divergence_rate": round(float(hit["divergence_rate"][0]), 4),
         "seed": 9400,
-        "context": (
-            "expected in the intended-basin upper band (r3 chains: "
-            "0.85-0.94) if the defect-#8 narrative is right — a "
+        "expectation_preregistered": (
+            "intended-basin upper band (r3 chains: 0.85-0.94) if a "
             "single budget-limited chain from the informed init stays "
-            "in the basin and reports a published-like value"
+            "in the basin"
+        ),
+        "outcome": (
+            "the registered-seed chain reported the UNCONDITIONAL "
+            "(degenerate-mode) values — its logp matches the "
+            "degenerate mode's loglik minus the ~160-nat bijector "
+            "Jacobian. The seed-sensitivity arm (all seeds recorded, "
+            "run before any was inspected) spans 0.45-0.88: one of "
+            "five budget-limited chains stays in the intended basin "
+            "and reports 0.878 — within 0.002 of the published 0.88 "
+            "— while the others wander, at chain mean logp separated "
+            "by < 2.5 nats. This is the defect-#8 provenance claim as "
+            "a measurement: a single 250/250 chain's spot-check is a "
+            "draw from a seed lottery whose upper-band ticket "
+            "reproduces the published value."
         ),
     }
+    # seed-sensitivity context (extra mimic seeds, all recorded —
+    # cached by scripts; absent entries are skipped, never re-run here)
+    seeds_ctx = []
+    for s in (9401, 9402, 9403, 9404):
+        h = cache.get(
+            digest_key(
+                {
+                    "stage": "registered-provenance-v1-seed",
+                    "window": span,
+                    "seed": s,
+                }
+            )
+        )
+        if h is not None:
+            seeds_ctx.append(
+                {
+                    "seed": s,
+                    "phi_45": round(float(h["phi_45"][0]), 4),
+                    "phi_25": round(float(h["phi_25"][0]), 4),
+                    "chain_mean_logp": round(float(h["mean_logp"][0]), 1),
+                }
+            )
+    if seeds_ctx:
+        provenance["seed_sensitivity"] = seeds_ctx
 
     # ---- fixed decision rule (`docs/phi_protocol.md`) ----
     agree = {

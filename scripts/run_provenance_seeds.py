@@ -1,0 +1,88 @@
+"""Producer for the registered stage's provenance seed-sensitivity arm.
+
+Runs the reference-mimic chain (1 NUTS chain, 250 warmup + 250 draws,
+`max_treedepth` 10, informed init — `tayal2009/main.R:34-39` budget) at
+the registered seed 9400 plus the 4 sensitivity seeds, writing each
+into the stage's ResultCache under the exact keys
+`examples/tayal_replication.py::run_registered` reads
+("registered-provenance-v1" / "registered-provenance-v1-seed"). All
+seeds are recorded unconditionally — no outcome-dependent selection.
+
+CPU-safe (forces the cpu platform before any jax op, so it never
+touches the TPU tunnel): the mimic measures sampler provenance, and the
+reference's own platform was CPU. ~2.5 min/seed.
+
+Usage: python scripts/run_provenance_seeds.py CACHE_DIR
+"""
+
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # before any jax computation
+
+import os  # noqa: E402
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "examples"))
+
+import time  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+from tayal_replication import _load_gto_window, _relabeled_phis  # noqa: E402
+
+from hhmm_tpu.apps.tayal.pipeline import run_window  # noqa: E402
+from hhmm_tpu.batch import ResultCache, digest_key  # noqa: E402
+from hhmm_tpu.infer import SamplerConfig  # noqa: E402
+from hhmm_tpu.models import TayalHHMMLite  # noqa: E402
+
+
+def main(cache_dir: str):
+    cache = ResultCache(cache_dir)
+    price, size, t, ins_end, span = _load_gto_window("rmd")
+    model = TayalHHMMLite()
+    cfg = SamplerConfig(
+        num_warmup=250, num_samples=250, num_chains=1, max_treedepth=10
+    )
+    jobs = [(9400, {"stage": "registered-provenance-v1", "window": span})] + [
+        (
+            s,
+            {
+                "stage": "registered-provenance-v1-seed",
+                "window": span,
+                "seed": s,
+            },
+        )
+        for s in (9401, 9402, 9403, 9404)
+    ]
+    for seed, keyspec in jobs:
+        ck = digest_key(keyspec)
+        if cache.get(ck) is not None:
+            print(seed, "cached", flush=True)
+            continue
+        t0 = time.time()
+        res = run_window(
+            price, size, t, ins_end, config=cfg, key=jax.random.PRNGKey(seed)
+        )
+        _, pc, _ = _relabeled_phis(model, res, price, res.zig)
+        hit = {
+            "phi_45": np.array([pc[0]["phi_45"]]),
+            "phi_25": np.array([pc[0]["phi_25"]]),
+            "mean_logp": np.array([pc[0]["mean_logp"]]),
+            "divergence_rate": np.array(
+                [float(np.mean(res.stats.get("diverging", np.zeros(1))))]
+            ),
+        }
+        cache.put(ck, hit)
+        print(
+            seed,
+            round(time.time() - t0, 1),
+            "s:",
+            {k: round(float(v[0]), 4) for k, v in hit.items()},
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/hhmm_cache_r5")

@@ -1,0 +1,94 @@
+"""Measure the sublane-packed FFBS kernel vs the resident kernel on
+the headline-bench shape (VERDICT r4 ask 5).
+
+B=256, T=1024, K=4, dense masks — the exact shape of the bench's Gibbs
+FFBS launches (the bench runs the HARD gate, which masks emissions and
+dispatches the UNGATED kernel; a gated row is measured too for the
+gate-key workloads that fit the resident bound). Records per-call wall
+times and speedups into `results/pack2_timing.json`; the dispatcher
+only adopts pack2 where this measurement says it wins. Tunnel
+discipline: fresh pre-generated device uniforms per timed call (host
+RNG + H2D stay OUTSIDE the timed window), block_until_ready + host
+reduction. Wall target < 4 min.
+"""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+OUT = os.path.join(os.path.dirname(__file__), "..", "results", "pack2_timing.json")
+
+
+def main():
+    assert jax.default_backend() == "tpu", jax.default_backend()
+    from hhmm_tpu.kernels.pallas_ffbs import pallas_ffbs
+    from hhmm_tpu.kernels.pallas_ffbs_pack2 import pallas_ffbs_pack2
+
+    rng = np.random.default_rng(7)
+    B, T, K = 256, 1024, 4
+    log_pi = jnp.asarray(np.log(rng.dirichlet(np.ones(K), B)), jnp.float32)
+    log_A = jnp.asarray(np.log(rng.dirichlet(np.ones(K), (B, K))), jnp.float32)
+    log_obs = jnp.asarray(rng.normal(size=(B, T, K)) - 1.0, jnp.float32)
+    mask = jnp.ones((B, T), jnp.float32)
+    gate = jnp.asarray(rng.integers(0, 2, size=(B, T)), jnp.float32)
+    skey = jnp.asarray(np.tile((np.arange(K) % 2).astype(np.float32), (B, 1)))
+
+    rec = {"device": str(jax.devices()[0]), "ts": time.strftime("%F %T"),
+           "shape": {"B": B, "T": T, "K": K}}
+    reps = 30
+    for mode, gargs in (("ungated", ()), ("gated", (gate, skey))):
+        fns = {
+            "resident": jax.jit(pallas_ffbs),
+            "pack2": jax.jit(pallas_ffbs_pack2),
+        }
+        times = {}
+        for name, fn in fns.items():
+            # pre-generate every rep's uniforms ON DEVICE before the
+            # timer: fresh inputs defeat tunnel memoization without
+            # paying host RNG + transfer inside the measured window
+            us = [
+                jax.device_put(
+                    jnp.asarray(rng.uniform(size=(B, T)), jnp.float32)
+                )
+                for _ in range(reps + 1)
+            ]
+            jax.block_until_ready(us)
+            z, ll = fn(log_pi, log_A, log_obs, mask, us[-1], *gargs)  # compile
+            float(np.asarray(ll.sum()))
+            t0 = time.time()
+            for r in range(reps):
+                z, ll = fn(log_pi, log_A, log_obs, mask, us[r], *gargs)
+                float(np.asarray(ll.sum()))
+            dt = (time.time() - t0) / reps
+            times[name] = dt
+            print(f"{mode}/{name}: {dt * 1e3:.2f} ms/call", flush=True)
+        # parity on device: same uniforms -> same draws
+        u = jnp.asarray(rng.uniform(size=(B, T)), jnp.float32)
+        z_r, ll_r = fns["resident"](log_pi, log_A, log_obs, mask, u, *gargs)
+        z_p, ll_p = fns["pack2"](log_pi, log_A, log_obs, mask, u, *gargs)
+        rec[mode] = {
+            "resident_ms": round(times["resident"] * 1e3, 3),
+            "pack2_ms": round(times["pack2"] * 1e3, 3),
+            "speedup_pack2": round(times["resident"] / times["pack2"], 3),
+            "device_parity": {
+                "z_mismatch_steps": int(
+                    (np.asarray(z_r) != np.asarray(z_p)).sum()
+                ),
+                "ll_maxdev": float(
+                    np.max(np.abs(np.asarray(ll_r) - np.asarray(ll_p)))
+                ),
+            },
+        }
+        print(mode, "speedup:", rec[mode]["speedup_pack2"],
+              "parity:", rec[mode]["device_parity"], flush=True)
+    with open(OUT, "w") as f:
+        json.dump(rec, f, indent=1)
+    print("wrote", OUT)
+
+
+if __name__ == "__main__":
+    main()
