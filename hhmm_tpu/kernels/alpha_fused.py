@@ -1,0 +1,146 @@
+"""Fused batched forward filter that RETURNS the per-step alpha.
+
+The decode phase of the walk-forward backtest (`apps/tayal/wf.py`)
+classifies legs by the median filtered probability over thinned draws:
+``model.generated`` runs a forward filter per (task, draw) and keeps
+the whole ``log_alpha [T, K]``. Under the stan sign gate the scan path
+materializes a [T-1, K, K] time-varying kernel PER DRAW — at the
+backtest's decode dispatches (8 tasks x 100 draws x T up to 16k) that
+is ~0.8 GB of HBM traffic per dispatch before any compute.
+
+This op keeps ``log_A`` homogeneous (gate expressed by the
+`kernels/vg.py` gate keys) and, when the chunked Pallas forward is
+eligible, reuses its pass 1 (`pallas_forward_chunked._run_chunked_
+forward`) — the filter runs fused in VMEM and the per-step alpha comes
+back as the kernel's HBM residual, which is exactly the tensor the
+decode needs. Ineligible shapes fall back to the vmapped scan with the
+materialized gate — identical semantics (pinned by
+`tests/test_pallas.py::TestAlphaFused`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.custom_batching import custom_vmap
+
+from hhmm_tpu.kernels.filtering import forward_filter
+
+__all__ = ["forward_alpha"]
+
+
+def _alpha_single(log_pi, log_A, log_obs, mask, gate_key=None, state_key=None):
+    if gate_key is None:
+        return forward_filter(log_pi, log_A, log_obs, mask)
+    c = gate_key[:, None] == state_key[None, :]  # [T, K]
+    log_A_t = jnp.where(c[1:, None, :], log_A[None], 0.0)
+    return forward_filter(log_pi, log_A_t, log_obs, mask)
+
+
+def _dispatch(log_pi, log_A, log_obs, mask, gate=()):
+    from hhmm_tpu.kernels.vg import _pallas_chunked_eligible, chunk_for_k
+
+    if _pallas_chunked_eligible(log_pi, log_A, log_obs):
+        from hhmm_tpu.kernels.pallas_forward_chunked import (
+            _LANES,
+            _pad_chunked,
+            _run_chunked_forward,
+        )
+
+        B, T, K = log_obs.shape
+        Tc = chunk_for_k(K)
+        gk = gate[0] if gate else None
+        sk = gate[1] if gate else None
+        pi_t, A_t, obs_t, mask_t, gate_t, sk_t, Bp, Tp, nc = _pad_chunked(
+            log_pi, log_A, log_obs, mask, gk, sk, Tc
+        )
+        ll, alpha_all = _run_chunked_forward(
+            pi_t, A_t, obs_t, mask_t, gate_t, sk_t,
+            (Bp // _LANES, nc), Tc, False,
+        )
+        return alpha_all.transpose(2, 0, 1)[:B, :T], ll[0, :B]
+    z, ll = jax.vmap(
+        lambda pi, A, obs, m, *g: _alpha_single(pi, A, obs, m, *g)
+    )(log_pi, log_A, log_obs, mask, *gate)
+    return z, ll
+
+
+@custom_vmap
+def _alpha_batched(log_pi, log_A, log_obs, mask):
+    return _dispatch(log_pi, log_A, log_obs, mask)
+
+
+@custom_vmap
+def _alpha_batched_gated(log_pi, log_A, log_obs, mask, gate_key, state_key):
+    return _dispatch(log_pi, log_A, log_obs, mask, gate=(gate_key, state_key))
+
+
+@custom_vmap
+def _alpha_one(log_pi, log_A, log_obs, mask):
+    return _alpha_single(log_pi, log_A, log_obs, mask)
+
+
+@custom_vmap
+def _alpha_one_gated(log_pi, log_A, log_obs, mask, gate_key, state_key):
+    return _alpha_single(log_pi, log_A, log_obs, mask, gate_key, state_key)
+
+
+def _flatten_rule(op):
+    def rule(axis_size, in_batched, *args):
+        from hhmm_tpu.kernels.vg import _broadcast_unbatched
+
+        args = _broadcast_unbatched(axis_size, in_batched, args)
+        flat = tuple(a.reshape((-1,) + a.shape[2:]) for a in args)
+        la, ll = op(*flat)
+        return (
+            la.reshape((axis_size, -1) + la.shape[1:]),
+            ll.reshape((axis_size, -1) + ll.shape[1:]),
+        ), (True, True)
+
+    return rule
+
+
+def _promote_rule(batched_op):
+    def rule(axis_size, in_batched, *args):
+        from hhmm_tpu.kernels.vg import _broadcast_unbatched
+
+        args = _broadcast_unbatched(axis_size, in_batched, args)
+        return batched_op(*args), (True, True)
+
+    return rule
+
+
+_alpha_batched.def_vmap(_flatten_rule(_alpha_batched))
+_alpha_batched_gated.def_vmap(_flatten_rule(_alpha_batched_gated))
+_alpha_one.def_vmap(_promote_rule(_alpha_batched))
+_alpha_one_gated.def_vmap(_promote_rule(_alpha_batched_gated))
+
+
+def forward_alpha(
+    log_pi: jnp.ndarray,
+    log_A: jnp.ndarray,
+    log_obs: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+    gate_key: Optional[jnp.ndarray] = None,
+    state_key: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """``(log_alpha [T, K], loglik)`` for one series; under any ``vmap``
+    nesting the flat batch dispatches to the chunked Pallas forward
+    (alpha comes back as the kernel's HBM residual) when eligible, else
+    to the scan filter. ``gate_key``/``state_key`` select the
+    `kernels/vg.py` gated-transition semantics with ``log_A`` kept
+    homogeneous — no [T-1, K, K] materialization on the fused path."""
+    if (gate_key is None) != (state_key is None):
+        raise ValueError("gate_key and state_key must be given together")
+    if log_A.ndim != 2:
+        raise ValueError(
+            f"forward_alpha needs homogeneous log_A [K, K], got "
+            f"{log_A.shape}; use forward_filter for time-varying kernels"
+        )
+    if mask is None:
+        mask = jnp.ones(log_obs.shape[:1], log_obs.dtype)
+    if gate_key is None:
+        return _alpha_one(log_pi, log_A, log_obs, mask)
+    return _alpha_one_gated(log_pi, log_A, log_obs, mask, gate_key, state_key)

@@ -244,20 +244,45 @@ class TayalHHMMLite(TayalHHMM):
     """Same training posterior; generated quantities run filtering +
     Viterbi on a held-out OOS segment restarted from π
     (`hhmm-tayal2009-lite.stan:94-158`). ``data`` additionally carries
-    ``x_oos``, ``sign_oos`` (and optionally ``mask_oos``)."""
+    ``x_oos``, ``sign_oos`` (and optionally ``mask_oos``).
+
+    The filtered-probability passes run through
+    :func:`hhmm_tpu.kernels.alpha_fused.forward_alpha` — under vmapped
+    draws the stan gate stays in gate-key form (homogeneous ``log_A``)
+    and long windows take the chunked Pallas forward, whose HBM alpha
+    residual is exactly the tensor the walk-forward decode consumes; the
+    round-4 scan path materialized a [T-1, K, K] kernel per draw here,
+    the decode phase's dominant HBM cost. Viterbi keeps the
+    materialized scan (its consumer reads only the short OOS segment,
+    and XLA dead-code-eliminates it from the decode's median-α jit)."""
+
+    def _seg_alpha(self, params, x, sign, mask):
+        """Filtered log-alpha for one segment through the canonical
+        hot-loop contract (build_vg + gate_keys — the same pair the
+        training path uses, so the decode cannot drift from it)."""
+        from hhmm_tpu.kernels.alpha_fused import forward_alpha
+
+        seg = {"x": x, "sign": sign}
+        log_pi, log_A, log_obs, _ = self.build_vg(params, seg)
+        gk = self.gate_keys(seg)
+        la, _ = forward_alpha(
+            log_pi, log_A, log_obs, mask, *(gk if gk is not None else ())
+        )
+        return la
 
     def generated(self, theta_draws, data):
+        mask, mask_o = data.get("mask"), data.get("mask_oos")
+
         def one(theta):
             params, _ = self.unpack(theta)
-            # in-sample filtered probabilities
-            log_pi, log_A_t, log_obs = self._gated(params, data["x"], data["sign"])
-            log_alpha, _ = forward_filter(log_pi, log_A_t, log_obs, data.get("mask"))
-            # OOS: restart from pi on the held-out suffix
+            # in-sample + OOS filtered probabilities (OOS restarts from pi)
+            log_alpha = self._seg_alpha(params, data["x"], data["sign"], mask)
+            log_alpha_o = self._seg_alpha(
+                params, data["x_oos"], data["sign_oos"], mask_o
+            )
             log_pi_o, log_A_o, log_obs_o = self._gated(
                 params, data["x_oos"], data["sign_oos"]
             )
-            mask_o = data.get("mask_oos")
-            log_alpha_o, _ = forward_filter(log_pi_o, log_A_o, log_obs_o, mask_o)
             zstar_o, _ = viterbi(log_pi_o, log_A_o, log_obs_o, mask_o)
             return {
                 "alpha": jax.nn.softmax(log_alpha, axis=-1),

@@ -396,3 +396,101 @@ class TestChunkedKernel:
         for o in out:
             assert np.all(np.isfinite(np.asarray(o)))
         _assert_close(out, _ref(log_pi, log_A, log_obs, mask))
+
+
+class TestAlphaFused:
+    """`kernels/alpha_fused.py`: the decode-phase filter op. The chunked
+    forward's HBM alpha residual (interpreter mode) must equal the scan
+    filter's per-step alpha, gated and ungated; and the CPU dispatch of
+    forward_alpha must reproduce the materialized-kernel filter that
+    `TayalHHMMLite.generated` previously ran."""
+
+    def _residual(self, args, gate=None, t_chunk=16):
+        from hhmm_tpu.kernels.pallas_forward_chunked import (
+            _LANES,
+            _pad_chunked,
+            _run_chunked_forward,
+        )
+
+        log_pi, log_A, log_obs, mask = args
+        B, T, K = log_obs.shape
+        gk, sk = gate if gate else (None, None)
+        pi_t, A_t, obs_t, mask_t, gate_t, sk_t, Bp, Tp, nc = _pad_chunked(
+            log_pi, log_A, log_obs, mask, gk, sk, t_chunk
+        )
+        ll, alpha_all = _run_chunked_forward(
+            pi_t, A_t, obs_t, mask_t, gate_t, sk_t,
+            (Bp // _LANES, nc), t_chunk, True,
+        )
+        return alpha_all.transpose(2, 0, 1)[:B, :T], ll[0, :B]
+
+    def _scan_ref(self, args, gate=None):
+        from hhmm_tpu.kernels.alpha_fused import _alpha_single
+
+        g = gate if gate else ()
+        return jax.vmap(lambda *a: _alpha_single(*a))(*args, *g)
+
+    def test_residual_matches_scan(self, rng):
+        args = _batch(rng, 5, 50, 4, ragged=True)
+        la_k, ll_k = self._residual(args)
+        la_r, ll_r = self._scan_ref(args)
+        # padded (mask-0) steps carry alpha in both implementations
+        np.testing.assert_allclose(
+            np.asarray(la_k), np.asarray(la_r), rtol=1e-5, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(ll_k), np.asarray(ll_r), rtol=1e-5
+        )
+
+    def test_residual_matches_scan_gated(self, rng):
+        B, T, K = 4, 37, 4
+        args = _batch(rng, B, T, K, ragged=True)
+        gate = (
+            jnp.asarray(rng.integers(0, 2, size=(B, T)), jnp.float32),
+            jnp.asarray(
+                np.tile((np.arange(K) % 2).astype(np.float32), (B, 1))
+            ),
+        )
+        la_k, ll_k = self._residual(args, gate=gate)
+        la_r, ll_r = self._scan_ref(args, gate=gate)
+        np.testing.assert_allclose(
+            np.asarray(la_k), np.asarray(la_r), rtol=1e-5, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(ll_k), np.asarray(ll_r), rtol=1e-5
+        )
+
+    def test_generated_unchanged_on_cpu(self, rng):
+        """TayalHHMMLite.generated (now routed through forward_alpha)
+        must reproduce the materialized-kernel filter output exactly on
+        the CPU dispatch path, both gate modes."""
+        from hhmm_tpu.kernels import forward_filter
+        from hhmm_tpu.models import TayalHHMMLite
+
+        T, To = 60, 20
+        x = jnp.asarray(rng.integers(0, 9, size=T + To), jnp.int32)
+        sign = jnp.asarray(rng.integers(0, 2, size=T + To), jnp.int32)
+        data = {
+            "x": x[:T], "sign": sign[:T],
+            "x_oos": x[T:], "sign_oos": sign[T:],
+        }
+        for mode in ("stan", "hard"):
+            model = TayalHHMMLite(gate_mode=mode)
+            theta = model.init_unconstrained(
+                jax.random.PRNGKey(0),
+                {k: np.asarray(v) for k, v in data.items()},
+            )[None]
+            out = model.generated(jnp.asarray(theta), data)
+
+            params, _ = model.unpack(jnp.asarray(theta[0]))
+            log_pi, log_A_t, log_obs = model._gated(
+                params, data["x"], data["sign"]
+            )
+            la_ref, _ = forward_filter(log_pi, log_A_t, log_obs, None)
+            np.testing.assert_allclose(
+                np.asarray(out["alpha"][0]),
+                np.asarray(jax.nn.softmax(la_ref, axis=-1)),
+                rtol=1e-5,
+                atol=1e-6,
+                err_msg=mode,
+            )
