@@ -145,6 +145,40 @@ class TestTreeGibbs:
         assert float(ess(lp)) >= 50.0
         assert float(split_rhat(lp)) < 1.05  # within-chain stationarity
 
+    def test_categorical_tree_recovers(self):
+        """Categorical-leaf branch of the tree Gibbs (Dirichlet emission
+        rows): free transition slots of the Tayal 2x2 tree recovered
+        from simulated symbols with well-separated emission rows."""
+        from hhmm_tpu.hhmm.examples import tayal_tree
+
+        L = 6
+        phi = np.full((4, L), 0.04)
+        for k in range(4):  # distinct dominant symbol per leaf
+            phi[k, k] = 1.0 - 0.04 * (L - 1)
+        tree = tayal_tree(p_bear=0.6, a_bear=0.3, a_bull=0.7, phi=phi)
+        _, x = hhmm_sim(tree, T=2000, rng=np.random.default_rng(8))
+        model = TreeHMM(tayal_tree(0.6, 0.3, 0.7, phi))
+        assert model.family == "categorical"
+        qs, stats = sample_gibbs(
+            model,
+            {"x": jnp.asarray(np.asarray(x, np.int32))},
+            jax.random.PRNGKey(4),
+            GibbsConfig(num_warmup=200, num_samples=600, num_chains=2),
+        )
+        assert np.isfinite(np.asarray(stats["logp"])).all()
+        flat = np.asarray(qs).reshape(-1, qs.shape[-1])
+        ps = [model.unpack(jnp.asarray(t))[0] for t in flat[::10]]
+        # bear row 0: [0, a_bear, 1-a_bear]; bull row 0: [0, a_bull, ...]
+        a_bear = np.mean([np.asarray(p["A_n1_r0"])[1] for p in ps])
+        a_bull = np.mean([np.asarray(p["A_n2_r0"])[1] for p in ps])
+        assert abs(a_bear - 0.3) < 0.12, a_bear
+        assert abs(a_bull - 0.7) < 0.12, a_bull
+        phis = np.mean([np.asarray(p["phi_k"]) for p in ps], axis=0)
+        # posterior-mean rows align with the true dominant symbols
+        # (0.15 covers posterior spread at T=2000 on the softest leaf)
+        assert np.abs(phis - phi).max() < 0.15
+        assert (np.argmax(phis, axis=1) == np.arange(4)).all()
+
     def test_soft_gate_weights_drop_inconsistent(self):
         """Stan-gate semisup: a label-inconsistent destination carries a
         unit pairwise factor — its step must contribute no transition
