@@ -157,7 +157,10 @@ class TestTreeGibbs:
             phi[k, k] = 1.0 - 0.04 * (L - 1)
         tree = tayal_tree(p_bear=0.6, a_bear=0.3, a_bull=0.7, phi=phi)
         _, x = hhmm_sim(tree, T=2000, rng=np.random.default_rng(8))
-        model = TreeHMM(tayal_tree(0.6, 0.3, 0.7, phi))
+        # fit model built at NEUTRAL values (same support masks): the
+        # chain init is far from truth, so passing means the sampler
+        # actually moved — not that a no-op update kept the init
+        model = TreeHMM(tayal_tree(0.5, 0.5, 0.5, np.full((4, L), 1.0 / L)))
         assert model.family == "categorical"
         qs, stats = sample_gibbs(
             model,
@@ -166,8 +169,13 @@ class TestTreeGibbs:
             GibbsConfig(num_warmup=200, num_samples=600, num_chains=2),
         )
         assert np.isfinite(np.asarray(stats["logp"])).all()
-        flat = np.asarray(qs).reshape(-1, qs.shape[-1])
-        ps = [model.unpack(jnp.asarray(t))[0] for t in flat[::10]]
+        # neutral init -> chains can land in leaf-role-swapped modes
+        # (standard label switching); the recovery claim is about the
+        # max-density mode, so check the best chain by mean logp — the
+        # repo's dominant-basin discipline (apps/tayal/replication.py)
+        best = int(np.argmax(np.asarray(stats["logp"]).mean(axis=1)))
+        draws = np.asarray(qs)[best]
+        ps = [model.unpack(jnp.asarray(t))[0] for t in draws[::10]]
         # bear row 0: [0, a_bear, 1-a_bear]; bull row 0: [0, a_bull, ...]
         a_bear = np.mean([np.asarray(p["A_n1_r0"])[1] for p in ps])
         a_bull = np.mean([np.asarray(p["A_n2_r0"])[1] for p in ps])
