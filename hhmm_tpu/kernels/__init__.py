@@ -13,11 +13,13 @@ from hhmm_tpu.kernels.ffbs import (
 )
 from hhmm_tpu.kernels.grad import forward_loglik
 from hhmm_tpu.kernels.assoc import forward_filter_assoc, forward_filter_seqshard
+from hhmm_tpu.kernels.alpha_fused import forward_alpha
 
 __all__ = [
     "forward_filter_assoc",
     "forward_filter_seqshard",
     "forward_filter",
+    "forward_alpha",
     "backward_pass",
     "smooth",
     "forward_backward",
