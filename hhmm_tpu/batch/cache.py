@@ -11,6 +11,14 @@ Stored as ``.npz`` of posterior/stat arrays under a content-addressed
 filename; the digest covers raw data bytes, so any change to inputs,
 budget, or model config is a cache miss (same semantics as the
 reference's ``digest()`` of its inputs).
+
+Crash safety (`docs/robustness.md`): writes are atomic — the archive is
+written to a unique temp name in the same directory and ``os.replace``d
+into place, so a reader can never observe a half-written entry — and
+``get`` treats an unreadable/corrupt entry (torn by a crash predating
+atomic writes, damaged storage, a partial copy) as a cache miss: the
+broken file is quarantined aside and the entry recomputed, instead of
+an exception wedging every future resume of the sweep.
 """
 
 from __future__ import annotations
@@ -18,6 +26,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import sys
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -62,14 +71,44 @@ class ResultCache:
         return os.path.join(self.cache_dir, f"{key}.npz")
 
     def get(self, key: str) -> Optional[Dict[str, np.ndarray]]:
-        if not self.cache_dir or not os.path.exists(self._path(key)):
+        if not self.cache_dir:
             return None
-        with np.load(self._path(key), allow_pickle=False) as z:
-            return {k: z[k] for k in z.files}
+        path = self._path(key)
+        if not os.path.exists(path):
+            return None
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                # force full materialization inside the try: a torn
+                # archive can pass the header check and fail mid-member
+                return {k: np.asarray(z[k]) for k in z.files}
+        except Exception as e:
+            # corrupt/unreadable entry == cache miss; move it aside so
+            # the recompute can re-put under the same key
+            print(
+                f"# ResultCache: dropping corrupt entry {os.path.basename(path)} "
+                f"({type(e).__name__}: {e})",
+                file=sys.stderr,
+                flush=True,
+            )
+            try:
+                os.replace(path, path + ".corrupt")
+            except OSError:
+                pass
+            return None
 
     def put(self, key: str, value: Dict[str, np.ndarray]) -> None:
         if not self.cache_dir:
             return
-        tmp = self._path(key) + ".tmp.npz"
-        np.savez(tmp, **{k: np.asarray(v) for k, v in value.items()})
-        os.replace(tmp, self._path(key))
+        # unique temp name (same dir => same filesystem for os.replace):
+        # two concurrent writers of the same key must not tear each
+        # other's temp file; last replace wins with an intact archive
+        tmp = self._path(key) + f".tmp.{os.getpid()}.npz"
+        try:
+            with open(tmp, "wb") as f:
+                np.savez(f, **{k: np.asarray(v) for k, v in value.items()})
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._path(key))
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)

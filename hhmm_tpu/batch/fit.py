@@ -34,6 +34,8 @@ from hhmm_tpu.infer.api import sample
 from hhmm_tpu.infer.chees import ChEESConfig, make_lp_bc, sample_chees_batched
 from hhmm_tpu.infer.gibbs import GibbsConfig, sample_gibbs
 from hhmm_tpu.infer.run import SamplerConfig
+from hhmm_tpu.robust import faults
+from hhmm_tpu.robust.retry import RetryPolicy, escalate, rejitter
 
 __all__ = ["default_init", "fit_batched"]
 
@@ -55,6 +57,29 @@ def _model_fingerprint(model) -> Dict[str, Any]:
     return {"class": type(model).__name__, **attrs}
 
 
+def _init_one_series(model, per_series, n_chains, key):
+    """[n_chains, dim] ``model.init_unconstrained`` draws for one series
+    (padding already dropped) — shared by :func:`default_init` and the
+    self-healing fresh-init remedy."""
+    # data-driven inits (k-means etc.) must not see padding: drop the
+    # masked tail from every time-axis array before calling the model
+    per_series = dict(per_series)
+    mask = per_series.pop("mask", None)
+    if mask is not None:
+        T = mask.shape[0]
+        valid = int(mask.sum())
+        per_series = {
+            k: v[:valid] if (np.ndim(v) >= 1 and np.shape(v)[0] == T) else v
+            for k, v in per_series.items()
+        }
+    return jnp.stack(
+        [
+            model.init_unconstrained(k, per_series)
+            for k in jax.random.split(key, n_chains)
+        ]
+    )
+
+
 def default_init(model, data_b, n_series, n_chains, key):
     """Stack per-series × per-chain ``model.init_unconstrained`` draws
     into [n_series, n_chains, dim]. ``data_b`` is a dict of arrays with
@@ -65,21 +90,9 @@ def default_init(model, data_b, n_series, n_chains, key):
     init = []
     for i in range(n_series):
         per_series = {k: np.asarray(v[i]) for k, v in data_b.items() if v is not None}
-        # data-driven inits (k-means etc.) must not see padding: drop the
-        # masked tail from every time-axis array before calling the model
-        mask = per_series.pop("mask", None)
-        if mask is not None:
-            T = mask.shape[0]
-            valid = int(mask.sum())
-            per_series = {
-                k: v[:valid] if (np.ndim(v) >= 1 and np.shape(v)[0] == T) else v
-                for k, v in per_series.items()
-            }
-        chains = [
-            model.init_unconstrained(k, per_series)
-            for k in jax.random.split(jax.random.fold_in(key, i), n_chains)
-        ]
-        init.append(jnp.stack(chains))
+        init.append(
+            _init_one_series(model, per_series, n_chains, jax.random.fold_in(key, i))
+        )
     return jnp.stack(init)  # [B, C, dim]
 
 
@@ -92,6 +105,7 @@ def fit_batched(
     chunk_size: int = 64,
     mesh: Optional[jax.sharding.Mesh] = None,
     cache_dir: Optional[str] = None,
+    retry: Optional[RetryPolicy] = None,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """Fit ``model`` independently to every series in ``data``.
 
@@ -106,6 +120,19 @@ def fit_batched(
     per-series, so its adaptation reductions stay within each series),
     and a :class:`GibbsConfig` runs blocked conjugate Gibbs
     (`infer/gibbs.py` — the model must implement ``gibbs_update``).
+
+    Self-healing dispatch (`docs/robustness.md`): every sampler routes
+    transitions through the chain-health guard, so a chunk's
+    ``stats["chain_healthy"]`` flags series whose chains went non-finite
+    and were quarantined. Those series are re-dispatched (within the
+    same chunk, healthy series' results kept bitwise) up to
+    ``retry.max_heal_attempts`` times with deterministically re-jittered
+    keys, fresh inits, and the escalating remedy ladder of
+    :func:`hhmm_tpu.robust.retry.escalate`; series still unhealthy after
+    the ladder are returned as-is with their mask down — degraded, not
+    fatal. Device-level UNAVAILABLE faults get ``retry.device_retries``
+    attempts with backoff, and completed chunks are crash-safe via the
+    digest cache.
     """
     data = {k: jnp.asarray(v) for k, v in data.items() if v is not None}
     sizes = {v.shape[0] for v in data.values()}
@@ -152,47 +179,52 @@ def fit_batched(
     data_keys = list(data.keys())
 
     chees = isinstance(config, ChEESConfig)
+    policy = retry if retry is not None else RetryPolicy()
 
-    def run_chunk(chunk_data, chunk_init, chunk_keys, chunk_w):
-        # fused value-and-grad hot loop (kernels/vg.py): the nested
-        # series x chains vmap collapses into one flat batch and runs
-        # the Pallas TPU kernel when eligible
-        if chees and config.shared_adaptation:
-            # one program over the whole chunk: ε and trajectory length
-            # are shared, so every chain takes the identical leapfrog
-            # count per transition — no lockstep waste (infer/chees.py).
-            # chunk_w zeroes padding series out of the pooled adaptation
-            # statistics (the repeated tail of a ragged final chunk must
-            # not skew the shared tuning).
-            return sample_chees_batched(
-                make_lp_bc(model, chunk_data),
-                chunk_keys[0],
-                chunk_init,
-                config,
-                jit=False,
-                series_weight=chunk_w,
-                probe_vg=model.make_vg({k: v[0] for k, v in chunk_data.items()}),
+    def make_runner(cfg):
+        """Compile the chunk runner for ``cfg`` — the primary config up
+        front, escalated remedy configs lazily on the healing path."""
+
+        def run_chunk(chunk_data, chunk_init, chunk_keys, chunk_w):
+            # fused value-and-grad hot loop (kernels/vg.py): the nested
+            # series x chains vmap collapses into one flat batch and runs
+            # the Pallas TPU kernel when eligible
+            if chees and cfg.shared_adaptation:
+                # one program over the whole chunk: ε and trajectory length
+                # are shared, so every chain takes the identical leapfrog
+                # count per transition — no lockstep waste (infer/chees.py).
+                # chunk_w zeroes padding series out of the pooled adaptation
+                # statistics (the repeated tail of a ragged final chunk must
+                # not skew the shared tuning).
+                return sample_chees_batched(
+                    make_lp_bc(model, chunk_data),
+                    chunk_keys[0],
+                    chunk_init,
+                    cfg,
+                    jit=False,
+                    series_weight=chunk_w,
+                    probe_vg=model.make_vg({k: v[0] for k, v in chunk_data.items()}),
+                )
+
+            if isinstance(cfg, GibbsConfig):
+
+                def one(args):
+                    per_series, qi, ki = args
+                    return sample_gibbs(model, per_series, ki, cfg, init_q=qi, jit=False)
+
+            else:
+
+                def one(args):
+                    per_series, qi, ki = args
+                    vg = model.make_vg(per_series)
+                    return sample(None, ki, qi, cfg, jit=False, vg_fn=vg)
+
+            return jax.vmap(lambda *xs: one((dict(zip(data_keys, xs[:-2])), xs[-2], xs[-1])))(
+                *[chunk_data[k] for k in data_keys], chunk_init, chunk_keys
             )
 
-        if isinstance(config, GibbsConfig):
-
-            def one(args):
-                per_series, qi, ki = args
-                return sample_gibbs(model, per_series, ki, config, init_q=qi, jit=False)
-
-        else:
-
-            def one(args):
-                per_series, qi, ki = args
-                vg = model.make_vg(per_series)
-                return sample(None, ki, qi, config, jit=False, vg_fn=vg)
-
-        return jax.vmap(lambda *xs: one((dict(zip(data_keys, xs[:-2])), xs[-2], xs[-1])))(
-            *[chunk_data[k] for k in data_keys], chunk_init, chunk_keys
-        )
-
-    run = jax.jit(run_chunk)
-    if mesh is not None:
+        if mesh is None:
+            return jax.jit(run_chunk)
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         def shard(x):
@@ -204,7 +236,42 @@ def fit_batched(
             shard(keys[:chunk]),
             NamedSharding(mesh, P("series")),  # chunk_w [chunk]
         )
-        run = jax.jit(run_chunk, in_shardings=in_shardings)
+        return jax.jit(run_chunk, in_shardings=in_shardings)
+
+    runners = {config: make_runner(config)}
+
+    def runner_for(cfg):
+        if cfg not in runners:
+            runners[cfg] = make_runner(cfg)
+        return runners[cfg]
+
+    def run_with_device_retry(run_fn, *args):
+        # bounded retry on device faults: the tunnel occasionally drops
+        # an execution mid-sweep (UNAVAILABLE); together with the digest
+        # cache this gives the reference's crash-recovery semantics
+        # (`wf-trade.R:86-109`) without losing the sweep
+        attempts = max(1, policy.device_retries)
+        for attempt in range(attempts):
+            try:
+                return jax.block_until_ready(run_fn(*args))
+            except (jax.errors.JaxRuntimeError, ValueError) as e:
+                # device faults surface as JaxRuntimeError OR a
+                # ValueError wrapper depending on where in the
+                # dispatch the fault lands; match the canonical
+                # XLA status prefix so a deterministic error that
+                # merely mentions the token is not retried
+                if "UNAVAILABLE:" not in str(e) or attempt == attempts - 1:
+                    raise
+                import time as _time
+
+                # an explicitly-passed policy owns the backoff schedule;
+                # the default path keeps the module-level knob that
+                # tests zero out
+                _time.sleep(
+                    policy.backoff(attempt)
+                    if retry is not None
+                    else _RETRY_SLEEP_S * (attempt + 1)
+                )
 
     qs_parts, stats_parts = [], []
     for s in range(0, B, chunk):
@@ -230,46 +297,111 @@ def fit_batched(
             # inits determine the draws: without them in the key, two
             # warm starts over the same data alias to one cache entry
             np.asarray(chunk_init),
-            # v2: the _da_init log_eps_bar fix (infer/run.py) changed
-            # short-warmup draws for both HMC samplers
+            # v3/v2: the chain-health guards added chain_healthy /
+            # quarantine_step to every sampler's stats (and self-healing
+            # can replace a quarantined series' draws), so pre-guard
+            # entries have an incompatible schema
             (
-                "sampler=gibbs-v1"
+                "sampler=gibbs-v2"
                 if isinstance(config, GibbsConfig)
-                else "sampler=chees-vg-v2" if chees else "sampler=vg-v2"
+                else "sampler=chees-vg-v3" if chees else "sampler=vg-v3"
             ),  # sampling-path identity: bump when the
             # draw-producing path changes so stale cache entries from a
             # numerically different (if statistically equivalent) path
             # are never mixed into a resumed sweep
         )
+        chunk_label = f"chunk {s//chunk + 1}/{-(-B//chunk)}"
         hit = cache.get(ck)
         if hit is not None:
             qs = jnp.asarray(hit.pop("samples"))
             stats = {k: jnp.asarray(v) for k, v in hit.items()}
-            print(f"# fit_batched chunk {s//chunk + 1}/{-(-B//chunk)}: cache hit", flush=True)
+            print(f"# fit_batched {chunk_label}: cache hit", flush=True)
         else:
-            # bounded retry on device faults: the tunnel occasionally
-            # drops an execution mid-sweep (UNAVAILABLE); together with
-            # the digest cache this gives the reference's crash-recovery
-            # semantics (`wf-trade.R:86-109`) without losing the sweep
-            for attempt in range(4):
-                try:
-                    qs, stats = jax.block_until_ready(
-                        run(chunk_data, chunk_init, chunk_keys, chunk_w)
-                    )
-                    break
-                except (jax.errors.JaxRuntimeError, ValueError) as e:
-                    # device faults surface as JaxRuntimeError OR a
-                    # ValueError wrapper depending on where in the
-                    # dispatch the fault lands; match the canonical
-                    # XLA status prefix so a deterministic error that
-                    # merely mentions the token is not retried
-                    if "UNAVAILABLE:" not in str(e) or attempt == 3:
-                        raise
-                    import time as _time
+            qs, stats = run_with_device_retry(
+                runner_for(config), chunk_data, chunk_init, chunk_keys, chunk_w
+            )
+            qs, stats = faults.corrupt_chunk_result(qs, stats, s, n, attempt=0)
 
-                    _time.sleep(_RETRY_SLEEP_S * (attempt + 1))
+            # ---- self-healing: re-dispatch series whose chains were
+            # quarantined by the in-scan guard, with deterministically
+            # re-jittered keys, fresh inits, and the escalation ladder
+            # (robust/retry.py); healthy series' results are kept bitwise
+            def sick_series(stats_d):
+                ch = stats_d.get("chain_healthy")
+                if ch is None:  # sampler without guard stats
+                    return np.zeros(chunk, bool)
+                ch = np.asarray(ch)
+                bad = ~ch.reshape(ch.shape[0], -1).all(axis=1)
+                return bad & (np.asarray(chunk_w) > 0)
+
+            sick = sick_series(stats)
+            for heal_attempt in range(1, policy.max_heal_attempts + 1):
+                if not sick.any():
+                    break
+                cfg_r = escalate(config, heal_attempt, policy)
+                init_r = np.array(chunk_init)
+                keys_r = np.array(chunk_keys)
+                for i in np.flatnonzero(sick):
+                    k_i = rejitter(chunk_keys[i], heal_attempt)
+                    keys_r[i] = np.asarray(k_i)
+                    per_series = {k: np.asarray(v[i]) for k, v in chunk_data.items()}
+                    init_r[i] = np.asarray(
+                        _init_one_series(
+                            model, per_series, C, jax.random.fold_in(k_i, 1)
+                        )
+                    )
+                if chees and config.shared_adaptation:
+                    # the shared-adaptation runner draws its entire PRNG
+                    # stream from chunk_keys[0]; without re-jittering it,
+                    # a sick series i != 0 would replay the identical
+                    # momenta/accepts. Healthy series' retried draws are
+                    # discarded by the merge, so this costs them nothing.
+                    keys_r[0] = np.asarray(rejitter(chunk_keys[0], heal_attempt))
+                print(
+                    f"# fit_batched {chunk_label}: healing attempt "
+                    f"{heal_attempt}/{policy.max_heal_attempts} for "
+                    f"{int(sick.sum())} quarantined series"
+                    + ("" if cfg_r == config else " (escalated config)"),
+                    flush=True,
+                )
+                qs2, stats2 = run_with_device_retry(
+                    runner_for(cfg_r),
+                    chunk_data,
+                    jnp.asarray(init_r),
+                    jnp.asarray(keys_r),
+                    chunk_w,
+                )
+                qs2, stats2 = faults.corrupt_chunk_result(
+                    qs2, stats2, s, n, attempt=heal_attempt
+                )
+                healed = sick & ~sick_series(stats2)
+                if healed.any():
+                    hm = jnp.asarray(healed)
+
+                    def mrg(a, b):
+                        a, b = jnp.asarray(a), jnp.asarray(b)
+                        return jnp.where(
+                            hm.reshape((-1,) + (1,) * (a.ndim - 1)), b, a
+                        )
+
+                    qs = mrg(qs, qs2)
+                    stats = {k: mrg(v, stats2[k]) for k, v in stats.items()}
+                    sick = sick & ~healed
+            if sick.any():
+                # graceful degradation: the quarantine mask stays down
+                # in the returned stats instead of the sweep dying
+                print(
+                    f"# fit_batched {chunk_label}: {int(sick.sum())} series "
+                    f"still quarantined after {policy.max_heal_attempts} "
+                    "healing attempts (returned with chain_healthy=False)",
+                    flush=True,
+                )
+
             cache.put(ck, {"samples": np.asarray(qs), **{k: np.asarray(v) for k, v in stats.items()}})
-            print(f"# fit_batched chunk {s//chunk + 1}/{-(-B//chunk)}: computed + cached", flush=True)
+            print(f"# fit_batched {chunk_label}: computed + cached", flush=True)
+            # fault-injection hook: simulated process death between
+            # chunks (the cached chunks above make the rerun resume)
+            faults.note_chunk_complete()
         qs_parts.append(qs[:n])
         stats_parts.append({k: v[:n] for k, v in stats.items()})
 

@@ -21,6 +21,8 @@ __all__ = [
     "log_matvec",
     "log_vecmat",
     "safe_log",
+    "safe_logsumexp",
+    "safe_log_normalize",
     "MASK_NEG",
 ]
 
@@ -43,6 +45,40 @@ def safe_log(x: jnp.ndarray) -> jnp.ndarray:
 def log_normalize(log_x: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
     """Normalize a log-space vector so that ``exp`` of it sums to one."""
     return log_x - logsumexp(log_x, axis=axis, keepdims=True)
+
+
+def safe_logsumexp(
+    log_x: jnp.ndarray, axis: int = -1, keepdims: bool = False, floor: float = -jnp.inf
+):
+    """``logsumexp`` guarded against the all-masked edge case.
+
+    A reduction over a row that is entirely ``-inf`` (every path masked
+    or gated away — impossible evidence, a fully-gated transition
+    column) has **NaN cotangents** (the VJP is the softmax of an
+    all-``-inf`` row, 0/0). This variant gives such rows exactly-zero
+    gradients and the ``floor`` value — default ``-inf``, which keeps
+    likelihood *ordering* honest (an impossible outcome ranks below any
+    possible one; a finite floor would overtake genuinely low
+    log-likelihoods). Pass ``floor=MASK_NEG`` where downstream
+    arithmetic needs a finite result (e.g. a normalizer denominator).
+
+    On every row with at least one non-``-inf`` entry this is bitwise
+    identical — value and gradient — to plain ``logsumexp``: the
+    stand-in substitution below only rewrites all-masked rows, and
+    ``jnp.where`` both selects and routes cotangents exactly.
+    """
+    all_masked = jnp.all(log_x == -jnp.inf, axis=axis, keepdims=True)
+    out = logsumexp(jnp.where(all_masked, 0.0, log_x), axis=axis, keepdims=keepdims)
+    am = all_masked if keepdims else jnp.squeeze(all_masked, axis=axis)
+    return jnp.where(am, jnp.asarray(floor, out.dtype), out)
+
+
+def safe_log_normalize(log_x: jnp.ndarray, axis: int = -1) -> jnp.ndarray:
+    """:func:`log_normalize` with a guarded denominator: an all-masked
+    row normalizes to ``log_x - MASK_NEG`` (the entries stay ``-inf``,
+    the arithmetic and gradients stay NaN-free) instead of
+    ``-inf - -inf = NaN``."""
+    return log_x - safe_logsumexp(log_x, axis=axis, keepdims=True, floor=MASK_NEG)
 
 
 def log_vecmat(log_x: jnp.ndarray, log_A: jnp.ndarray) -> jnp.ndarray:

@@ -59,11 +59,13 @@ from hhmm_tpu.infer.nuts import find_reasonable_step_size
 from hhmm_tpu.infer.run import (
     _da_init,
     _da_update,
-    _welford_init,
+    _Welford,
     _welford_update,
     _welford_variance,
     warmup_schedule,
 )
+from hhmm_tpu.robust import faults
+from hhmm_tpu.robust.guards import finite_mask, guard_update
 
 __all__ = ["ChEESConfig", "make_lp_bc", "sample_chees", "sample_chees_batched"]
 
@@ -220,7 +222,7 @@ def sample_chees_batched(
         )
         return q, p, logp, grad
 
-    def transition(key, qs, logps, grads, eps, inv_mass, traj, u):
+    def transition(key, qs, logps, grads, eps, inv_mass, traj, u, healthy):
         key, key_mom, key_acc = jax.random.split(key, 3)
         p0 = jax.random.normal(key_mom, (B, C, dim), dtype) / jnp.sqrt(inv_mass)[
             :, None, :
@@ -248,10 +250,15 @@ def sample_chees_batched(
         proj = jnp.sum((q1 - m1) * v1, axis=-1)
         per_chain = accept_prob * dsq * proj * u
         finite = jnp.isfinite(per_chain)
-        w = jnp.where(finite, accept_prob, 0.0) * w_bc
-        g = jnp.where(finite, per_chain, 0.0) * w_bc
+        # quarantined chains (robust/guards.py) are zombies frozen at
+        # their last finite state: excluded from the pooled adaptation
+        # statistics so a bad chain cannot skew the shared ε/trajectory.
+        # All-healthy runs are bit-identical (×1.0 is exact).
+        w_h = w_bc * healthy.astype(w_bc.dtype)
+        w = jnp.where(finite, accept_prob, 0.0) * w_h
+        g = jnp.where(finite, per_chain, 0.0) * w_h
         chees_grad = jnp.sum(g) / jnp.maximum(jnp.sum(w), 1e-6)
-        mean_accept = jnp.sum(accept_prob * w_bc) / jnp.maximum(jnp.sum(w_bc), 1e-6)
+        mean_accept = jnp.sum(accept_prob * w_h) / jnp.maximum(jnp.sum(w_h), 1e-6)
         return (
             key,
             q_new,
@@ -264,10 +271,25 @@ def sample_chees_batched(
             n_steps,
         )
 
-    def run(key, init_q):
+    fault = faults.batch_fault_arrays(B, C)
+
+    def welford_init_bc():
+        # per-SERIES sample counts [B, 1] (not the scalar count of
+        # infer/run.py): quarantined chains are excluded from the mass
+        # update per series, so series can accumulate different counts
+        return _Welford(
+            jnp.zeros((B, 1), dtype),
+            jnp.zeros((B, dim), dtype),
+            jnp.zeros((B, dim), dtype),
+        )
+
+    def run(key, init_q, fault_step=None, fault_kind=None):
         logps0, grads0 = lp_bc(init_q)
         key, key_eps = jax.random.split(key)
         inv_mass0 = jnp.ones((B, dim), dtype)
+        # chain-health guard state: [B, C] mask + quarantine index
+        healthy0 = finite_mask((init_q, logps0, grads0), batch_ndim=2)
+        qstep0 = jnp.where(healthy0, -1, 0).astype(jnp.int32)
 
         # shared ε₀ from one representative chain (cheap heuristic; DA
         # converges within the first warmup window regardless)
@@ -299,25 +321,36 @@ def sample_chees_batched(
             jnp.log(jnp.asarray(config.init_traj_length, dtype)),
             adam0,
             inv_mass0,
-            _welford_init((B, dim), dtype),
+            welford_init_bc(),
+            healthy0,
+            qstep0,
         )
 
         def warm_step(carry, xs):
-            key, qs, logps, grads, da, log_traj, adam, inv_mass, wf = carry
-            u, upd_mass, win_end = xs
+            key, qs, logps, grads, da, log_traj, adam, inv_mass, wf, healthy, q_step = carry
+            u, upd_mass, win_end, t = xs
             eps = jnp.exp(da.log_eps)
             traj = jnp.exp(log_traj)
             (
                 key,
-                qs,
-                logps,
-                grads,
+                q1,
+                logp1,
+                grad1,
                 _,
                 mean_accept,
                 chees_grad,
                 diverging,
                 n_steps,
-            ) = transition(key, qs, logps, grads, eps, inv_mass, traj, u)
+            ) = transition(key, qs, logps, grads, eps, inv_mass, traj, u, healthy)
+            if fault_step is not None:
+                logp1, grad1, q1 = faults.corrupt(
+                    t, fault_step, fault_kind, logp1, grad1, q1
+                )
+            (qs, logps, grads), ok = guard_update(
+                healthy, (q1, logp1, grad1), (qs, logps, grads), batch_ndim=2
+            )
+            q_step = jnp.where(healthy & ~ok, t, q_step)
+            healthy = ok
             da = _da_update(da, mean_accept, config.target_accept)
 
             m, v, t = adam
@@ -333,10 +366,16 @@ def sample_chees_batched(
             )
             adam = (m, v, t)
 
-            # per-series mass: one Welford update per chain per step
+            # per-series mass: one Welford update per chain per step;
+            # quarantined (zombie) chains are skipped so their frozen
+            # positions cannot deflate the healthy chains' mass estimate
             def upd(wf_state):
                 def body(c, s):
-                    return _welford_update(s, qs[:, c, :])
+                    new = _welford_update(s, qs[:, c, :])
+                    h = healthy[:, c][:, None]  # [B, 1]
+                    return jax.tree_util.tree_map(
+                        lambda nn, oo: jnp.where(h, nn, oo), new, s
+                    )
 
                 return lax.fori_loop(0, C, body, wf_state)
 
@@ -350,41 +389,70 @@ def sample_chees_batched(
                 lambda f, o: jnp.where(win_end, f, o), fresh_da, da
             )
             wf = jax.tree_util.tree_map(
-                lambda f, o: jnp.where(win_end, f, o), _welford_init((B, dim), dtype), wf
+                lambda f, o: jnp.where(win_end, f, o), welford_init_bc(), wf
             )
-            return (key, qs, logps, grads, da, log_traj, adam, inv_mass, wf), (
+            return (key, qs, logps, grads, da, log_traj, adam, inv_mass, wf, healthy, q_step), (
                 diverging,
                 n_steps,
             )
 
-        (key, qs, logps, grads, da, log_traj, _, inv_mass, _), (warm_div, warm_steps) = (
-            lax.scan(
-                warm_step,
-                warm_init,
-                (halton[: config.num_warmup], update_mass, window_end),
-            )
+        (
+            (key, qs, logps, grads, da, log_traj, _, inv_mass, _, healthy, q_step),
+            (warm_div, warm_steps),
+        ) = lax.scan(
+            warm_step,
+            warm_init,
+            (
+                halton[: config.num_warmup],
+                update_mass,
+                window_end,
+                jnp.arange(config.num_warmup),
+            ),
         )
 
         eps_final = jnp.exp(da.log_eps_bar)
         traj_final = jnp.exp(log_traj)
 
-        def samp_step(carry, u):
-            key, qs, logps, grads = carry
+        def samp_step(carry, xs):
+            key, qs, logps, grads, healthy, q_step = carry
+            u, t = xs
             (
                 key,
-                qs,
-                logps,
-                grads,
+                q1,
+                logp1,
+                grad1,
                 accept_prob,
                 _,
                 _,
                 diverging,
                 n_steps,
-            ) = transition(key, qs, logps, grads, eps_final, inv_mass, traj_final, u)
-            return (key, qs, logps, grads), (qs, logps, accept_prob, diverging, n_steps)
+            ) = transition(
+                key, qs, logps, grads, eps_final, inv_mass, traj_final, u, healthy
+            )
+            if fault_step is not None:
+                logp1, grad1, q1 = faults.corrupt(
+                    t, fault_step, fault_kind, logp1, grad1, q1
+                )
+            (qs, logps, grads), ok = guard_update(
+                healthy, (q1, logp1, grad1), (qs, logps, grads), batch_ndim=2
+            )
+            q_step = jnp.where(healthy & ~ok, t, q_step)
+            healthy = ok
+            return (key, qs, logps, grads, healthy, q_step), (
+                qs,
+                logps,
+                accept_prob,
+                diverging,
+                n_steps,
+            )
 
-        _, (qs_out, logps_out, acc, div, n_steps) = lax.scan(
-            samp_step, (key, qs, logps, grads), halton[config.num_warmup :]
+        (_, _, _, _, healthy, q_step), (qs_out, logps_out, acc, div, n_steps) = lax.scan(
+            samp_step,
+            (key, qs, logps, grads, healthy, q_step),
+            (
+                halton[config.num_warmup :],
+                jnp.arange(config.num_samples) + config.num_warmup,
+            ),
         )
 
         # [S, B, C, ...] -> [B, C, S, ...]; every entry gets a leading
@@ -406,13 +474,17 @@ def sample_chees_batched(
             "warmup_num_leaves": jnp.broadcast_to(
                 warm_steps[None, :], (B, warm_steps.shape[0])
             ),
+            "chain_healthy": healthy,
+            "quarantine_step": q_step,
         }
         return jnp.moveaxis(qs_out, 0, 2), stats
 
     fn = run
     if jit:
         fn = jax.jit(run)
-    return fn(key, init_q)
+    if fault is None:
+        return fn(key, init_q)
+    return fn(key, init_q, *fault)
 
 
 def sample_chees(

@@ -25,8 +25,16 @@ def _split_chains(x: np.ndarray) -> np.ndarray:
 
 
 def split_rhat(x: np.ndarray) -> float:
-    """Potential scale reduction on split chains. ``x`` is [chains, draws]."""
+    """Potential scale reduction on split chains. ``x`` is [chains, draws].
+
+    Robustness contract (`docs/robustness.md`): non-finite draws (a
+    quarantined chain's NaN tail, an overflowed parameter) yield
+    ``inf`` — "definitely not converged" — never NaN or an exception;
+    zero-variance chains yield 1.0 (a constant is trivially converged).
+    """
     x = _split_chains(np.asarray(x, dtype=np.float64))
+    if not np.isfinite(x).all():
+        return float("inf")
     m, n = x.shape
     chain_means = x.mean(axis=1)
     chain_vars = x.var(axis=1, ddof=1)
@@ -49,8 +57,12 @@ def _split_chains_batched(x: np.ndarray) -> np.ndarray:
 
 def split_rhat_many(x: np.ndarray) -> np.ndarray:
     """Vectorized :func:`split_rhat` over a leading batch axis:
-    ``x`` [N, chains, draws] → [N], identical to the scalar per row."""
+    ``x`` [N, chains, draws] → [N], identical to the scalar per row
+    (including the robustness contract: non-finite rows → ``inf``,
+    zero-variance rows → 1.0)."""
     xs = _split_chains_batched(np.asarray(x, dtype=np.float64))
+    bad = ~np.isfinite(xs).all(axis=(1, 2))  # [N]
+    xs = np.where(bad[:, None, None], 0.0, xs)
     n = xs.shape[-1]
     chain_means = xs.mean(axis=-1)  # [N, m]  (m = 2*chains >= 2)
     chain_vars = xs.var(axis=-1, ddof=1)
@@ -58,7 +70,8 @@ def split_rhat_many(x: np.ndarray) -> np.ndarray:
     B = n * chain_means.var(axis=-1, ddof=1)
     var_plus = (n - 1) / n * W + B / n
     safe_W = np.where(W > 0, W, 1.0)
-    return np.where(W <= 0, 1.0, np.sqrt(var_plus / safe_W))
+    out = np.where(W <= 0, 1.0, np.sqrt(var_plus / safe_W))
+    return np.where(bad, np.inf, out)
 
 
 def _autocovariance_fft(x: np.ndarray) -> np.ndarray:
@@ -72,8 +85,15 @@ def _autocovariance_fft(x: np.ndarray) -> np.ndarray:
 
 
 def ess(x: np.ndarray) -> float:
-    """Bulk effective sample size (Stan's estimator, Geyer truncation)."""
+    """Bulk effective sample size (Stan's estimator, Geyer truncation).
+
+    Robustness contract (`docs/robustness.md`): non-finite draws yield
+    0.0 — "no usable information" — never NaN or an exception;
+    zero-variance chains yield the nominal draw count.
+    """
     x = np.asarray(x, dtype=np.float64)
+    if not np.isfinite(x).all():
+        return 0.0
     x = _split_chains(x)
     m, n = x.shape
     if n < 4:
@@ -121,11 +141,14 @@ def ess_many(x: np.ndarray, chunk: int = 512) -> np.ndarray:
     N, c, n0 = x.shape
     half = n0 // 2
     m, n = 2 * c, half
+    bad_rows = ~np.isfinite(x).all(axis=(1, 2))  # robustness: see ess()
     if n < 4:
-        return np.full(N, float(m * n))
+        return np.where(bad_rows, 0.0, float(m * n))
     out = np.empty(N)
     for s in range(0, N, chunk):
-        split = _split_chains_batched(x[s : s + chunk])
+        split = _split_chains_batched(
+            np.where(bad_rows[s : s + chunk, None, None], 0.0, x[s : s + chunk])
+        )
         xc = split - split.mean(axis=-1, keepdims=True)
         pad = int(2 ** np.ceil(np.log2(2 * n)))
         f = np.fft.rfft(xc, pad, axis=-1)
@@ -153,22 +176,44 @@ def ess_many(x: np.ndarray, chunk: int = 512) -> np.ndarray:
         tau = np.maximum(tau, 1.0 / np.log10(m * n + 10))
         vals = np.minimum(m * n / tau, m * n * np.log10(m * n))
         out[s : s + chunk] = np.where(var_plus <= 0, float(m * n), vals)
-    return out
+    return np.where(bad_rows, 0.0, out)
 
 
 def summary(
     samples: Dict[str, np.ndarray],
     probs=(0.025, 0.25, 0.5, 0.75, 0.975),
+    health: Optional[np.ndarray] = None,
 ) -> Dict[str, Dict[str, np.ndarray]]:
     """Per-parameter posterior summary table.
 
     ``samples[name]`` is [chains, draws, ...]; returns mean/sd/quantiles/
     n_eff/Rhat per scalar component — the equivalent of the reference's
     ``summary(stan.fit)`` block in every driver (`hmm/main.R:59-62`).
+
+    ``health``: optional [chains] bool mask (the samplers'
+    ``stats["chain_healthy"]`` — see `robust/guards.py`). Quarantined
+    chains are excluded from every statistic, and each parameter's entry
+    reports ``chains_used`` / ``chains_quarantined``. If *every* chain
+    is quarantined nothing is dropped (``chains_used = 0`` flags that the
+    numbers are computed from quarantined chains and are not trustworthy).
     """
+    keep = None
+    n_bad = 0
+    if health is not None:
+        health = np.asarray(health, dtype=bool).reshape(-1)
+        n_bad = int((~health).sum())
+        if health.any() and n_bad:
+            keep = health
     out = {}
     for name, arr in samples.items():
         arr = np.asarray(arr)
+        if health is not None and arr.shape[0] != health.shape[0]:
+            raise ValueError(
+                f"health mask has {health.shape[0]} chains, "
+                f"samples[{name!r}] has {arr.shape[0]}"
+            )
+        if keep is not None:
+            arr = arr[keep]
         c, n = arr.shape[:2]
         flatdim = int(np.prod(arr.shape[2:], dtype=np.int64)) if arr.ndim > 2 else 1
         flat = arr.reshape(c, n, flatdim)
@@ -183,5 +228,8 @@ def summary(
                 np.quantile(flat, p, axis=(0, 1))
             )
         stats["shape"] = arr.shape[2:]
+        if health is not None:
+            stats["chains_used"] = c if keep is not None or n_bad == 0 else 0
+            stats["chains_quarantined"] = n_bad
         out[name] = stats
     return out

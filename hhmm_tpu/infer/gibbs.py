@@ -50,6 +50,8 @@ from jax import lax
 
 from hhmm_tpu.kernels.ffbs import backward_sample, ffbs_fused
 from hhmm_tpu.kernels.filtering import forward_filter
+from hhmm_tpu.robust import faults
+from hhmm_tpu.robust.guards import all_finite, guard_where
 
 __all__ = ["GibbsConfig", "sample_gibbs", "transition_counts", "emission_counts"]
 
@@ -146,10 +148,17 @@ def sample_gibbs(
     # expose through plain build
     build = model.build_vg if gk is not None else model.build
 
-    def chain(key, theta0):
+    def chain(key, theta0, fault_step=None, fault_kind=None):
         params0, _ = model.unpack(theta0)
+        # chain-health guard (robust/guards.py): carry a healthy flag +
+        # quarantine index; a non-finite log-density or parameter draw
+        # freezes the chain at its last finite parameter block
+        healthy0 = all_finite(params0)
+        qstep0 = jnp.where(healthy0, -1, 0).astype(jnp.int32)
 
-        def step(params, k):
+        def step(carry, xs):
+            params, healthy, q_step, ll_prev = carry
+            k, t = xs
             # the whole transition is ONE fused FFBS (forward filter +
             # backward state draw + lp trace — a single Pallas kernel
             # launch on TPU: kernels/pallas_ffbs.py at T*K <= 4096,
@@ -168,21 +177,45 @@ def sample_gibbs(
             else:
                 z, ll = ffbs_fused(k_z, log_pi, log_A, log_obs, mask)
             new = model.gibbs_update(k_par, z, data, params)
+            if fault_step is not None:
+                ll, _, _ = faults.corrupt(t, fault_step, fault_kind, logp=ll)
+                new = faults.corrupt_tree(t, fault_step, fault_kind, new)
+            # quarantine: a non-finite density or parameter draw freezes
+            # the chain (permanently) at the current finite params
+            ok = healthy & all_finite((new, ll))
+            new = guard_where(ok, new, params)
+            q_step = jnp.where(healthy & ~ok, t, q_step)
             # record the params that produced ll (the pre-update state
             # of this transition — the first recorded pair is the init,
-            # absorbed by warmup)
-            return new, (model.pack(params), ll)
+            # absorbed by warmup). Like the HMC samplers, the recorded
+            # log-density is the guarded one: a non-finite ll records
+            # the last finite value, so a quarantined chain's logp trace
+            # stays finite (the event itself lives in quarantine_step).
+            ll_rec = jnp.where(jnp.isfinite(ll), ll, ll_prev)
+            return (new, ok, q_step, ll_rec), (model.pack(params), ll_rec)
 
         keys = jax.random.split(key, total)
-        _, (thetas, lls) = lax.scan(step, params0, keys)
-        return thetas[config.num_warmup :], lls[config.num_warmup :]
+        (_, healthy, q_step, _), (thetas, lls) = lax.scan(
+            step,
+            (params0, healthy0, qstep0, jnp.asarray(jnp.nan, init_q.dtype)),
+            (keys, jnp.arange(total)),
+        )
+        return thetas[config.num_warmup :], lls[config.num_warmup :], healthy, q_step
 
-    fn = jax.vmap(chain)
+    fault = faults.chain_fault_arrays(C)
+    if fault is None:
+        fn = jax.vmap(chain)
+        args = (jax.random.split(key, C), init_q)
+    else:
+        fn = jax.vmap(lambda k, q, fs, fk: chain(k, q, fault_step=fs, fault_kind=fk))
+        args = (jax.random.split(key, C), init_q, *fault)
     if jit:
         fn = jax.jit(fn)
-    qs, lls = fn(jax.random.split(key, C), init_q)
+    qs, lls, healthy, q_step = fn(*args)
     stats = {
         "logp": lls,
         "diverging": jnp.zeros_like(lls, bool),
+        "chain_healthy": healthy,
+        "quarantine_step": q_step,
     }
     return qs, stats

@@ -29,6 +29,8 @@ import numpy as np
 from jax import lax
 
 from hhmm_tpu.infer.nuts import nuts_step, find_reasonable_step_size, NUTSInfo
+from hhmm_tpu.robust import faults
+from hhmm_tpu.robust.guards import finite_mask, guard_update, guard_where
 
 __all__ = ["SamplerConfig", "sample_nuts", "warmup_schedule"]
 
@@ -138,6 +140,8 @@ def _single_chain(
     max_treedepth,
     target_accept,
     init_step_size,
+    fault_step=None,
+    fault_kind=None,
 ):
     dim = q0.shape[0]
     dtype = q0.dtype
@@ -152,6 +156,13 @@ def _single_chain(
         lp, inv_mass0, q0, logp0, grad0, key_eps, init_step_size
     )
 
+    # chain-health guard (robust/guards.py): a chain whose state goes
+    # non-finite is frozen at its last finite state — adaptation state
+    # included — with the quarantine transition index recorded. A chain
+    # whose *init* is already non-finite starts quarantined at step 0.
+    healthy0 = finite_mask((q0, logp0, grad0))
+    qstep0 = jnp.where(healthy0, jnp.asarray(-1, jnp.int32), jnp.asarray(0, jnp.int32))
+
     warm_init = (
         q0,
         logp0,
@@ -160,49 +171,68 @@ def _single_chain(
         inv_mass0,
         _welford_init(dim, dtype),
         key,
+        healthy0,
+        qstep0,
     )
 
     def warm_step(carry, xs):
-        q, logp, grad, da, inv_mass, wf, key = carry
-        upd_mass, win_end = xs
-        key, sub = jax.random.split(key)
+        q, logp, grad, da, inv_mass, wf, key, healthy, q_step = carry
+        upd_mass, win_end, t = xs
+        key_new, sub = jax.random.split(key)
         eps = jnp.exp(da.log_eps)
-        q, logp, grad, info = nuts_step(
+        q1, logp1, grad1, info = nuts_step(
             lp, sub, q, logp, grad, eps, inv_mass, max_treedepth
         )
-        da = _da_update(da, info.accept_prob, target_accept)
-        wf = jax.tree_util.tree_map(
-            lambda new, old: jnp.where(upd_mass, new, old), _welford_update(wf, q), wf
+        if fault_step is not None:
+            logp1, grad1, q1 = faults.corrupt(t, fault_step, fault_kind, logp1, grad1, q1)
+        (q1, logp1, grad1), ok = guard_update(healthy, (q1, logp1, grad1), (q, logp, grad))
+        q_step = jnp.where(healthy & ~ok, t, q_step)
+
+        da1 = _da_update(da, info.accept_prob, target_accept)
+        wf1 = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(upd_mass, new, old), _welford_update(wf, q1), wf
         )
 
         # at a window end: adopt new mass matrix, reset welford + DA
-        new_inv_mass = _welford_variance(wf)
-        inv_mass = jnp.where(win_end, new_inv_mass, inv_mass)
-        fresh_da = _da_init(jnp.exp(da.log_eps))
-        da = jax.tree_util.tree_map(
-            lambda f, o: jnp.where(win_end, f, o), fresh_da, da
+        new_inv_mass = _welford_variance(wf1)
+        inv_mass1 = jnp.where(win_end, new_inv_mass, inv_mass)
+        fresh_da = _da_init(jnp.exp(da1.log_eps))
+        da1 = jax.tree_util.tree_map(
+            lambda f, o: jnp.where(win_end, f, o), fresh_da, da1
         )
-        wf = jax.tree_util.tree_map(
-            lambda f, o: jnp.where(win_end, f, o), _welford_init(dim, dtype), wf
+        wf1 = jax.tree_util.tree_map(
+            lambda f, o: jnp.where(win_end, f, o), _welford_init(dim, dtype), wf1
         )
-        return (q, logp, grad, da, inv_mass, wf, key), info.diverging
+        # quarantined chains freeze their adaptation state too (the
+        # poisoned transition's accept stats must not leak into DA)
+        da1, inv_mass1, wf1, key1 = guard_where(
+            ok, (da1, inv_mass1, wf1, key_new), (da, inv_mass, wf, key)
+        )
+        return (q1, logp1, grad1, da1, inv_mass1, wf1, key1, ok, q_step), info.diverging
 
-    (q, logp, grad, da, inv_mass, _, key), warm_div = lax.scan(
-        warm_step, warm_init, (update_mass, window_end)
+    (q, logp, grad, da, inv_mass, _, key, healthy, q_step), warm_div = lax.scan(
+        warm_step, warm_init, (update_mass, window_end, jnp.arange(num_warmup))
     )
 
     eps_final = jnp.exp(da.log_eps_bar)
 
-    def samp_step(carry, _):
-        q, logp, grad, key = carry
-        key, sub = jax.random.split(key)
-        q, logp, grad, info = nuts_step(
+    def samp_step(carry, t):
+        q, logp, grad, key, healthy, q_step = carry
+        key_new, sub = jax.random.split(key)
+        q1, logp1, grad1, info = nuts_step(
             lp, sub, q, logp, grad, eps_final, inv_mass, max_treedepth
         )
-        return (q, logp, grad, key), (q, logp, info)
+        if fault_step is not None:
+            logp1, grad1, q1 = faults.corrupt(t, fault_step, fault_kind, logp1, grad1, q1)
+        (q1, logp1, grad1), ok = guard_update(healthy, (q1, logp1, grad1), (q, logp, grad))
+        q_step = jnp.where(healthy & ~ok, t, q_step)
+        key1 = jnp.where(ok, key_new, key)
+        return (q1, logp1, grad1, key1, ok, q_step), (q1, logp1, info)
 
-    _, (qs, logps, infos) = lax.scan(
-        samp_step, (q, logp, grad, key), None, length=num_samples
+    (_, _, _, _, healthy, q_step), (qs, logps, infos) = lax.scan(
+        samp_step,
+        (q, logp, grad, key, healthy, q_step),
+        jnp.arange(num_samples) + num_warmup,
     )
     stats = {
         "accept_prob": infos.accept_prob,
@@ -214,6 +244,8 @@ def _single_chain(
         "step_size": eps_final,
         "inv_mass": inv_mass,
         "warmup_diverging": warm_div,
+        "chain_healthy": healthy,
+        "quarantine_step": q_step,
     }
     return qs, stats
 
@@ -232,7 +264,9 @@ def sample_nuts(
     ``model.make_vg(data)`` — the Pallas-accelerated hot loop) and takes
     precedence over ``logp_fn``.
 
-    Returns ``(samples [chains, num_samples, dim], stats dict)``.
+    Returns ``(samples [chains, num_samples, dim], stats dict)``; the
+    stats carry the chain-health mask (``chain_healthy`` /
+    ``quarantine_step`` — see `robust/guards.py`).
     """
     if logp_fn is None and vg_fn is None:
         raise ValueError("need logp_fn or vg_fn")
@@ -254,7 +288,16 @@ def sample_nuts(
         target_accept=config.target_accept,
         init_step_size=config.init_step_size,
     )
-    fn = jax.vmap(run)
+    # fault-injection arrays (robust/faults.py) are traced runtime
+    # inputs, so an injected run and its never-firing control compile to
+    # the identical program; with no active plan nothing extra is traced
+    fault = faults.chain_fault_arrays(C)
+    if fault is None:
+        fn = jax.vmap(run)
+        args = (keys, init_q)
+    else:
+        fn = jax.vmap(lambda k, q, fs, fk: run(k, q, fault_step=fs, fault_kind=fk))
+        args = (keys, init_q, *fault)
     if jit:
         fn = jax.jit(fn)
-    return fn(keys, init_q)
+    return fn(*args)

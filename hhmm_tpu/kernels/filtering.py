@@ -33,7 +33,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from hhmm_tpu.core.lmath import log_normalize, log_vecmat, log_matvec, logsumexp
+from hhmm_tpu.core.lmath import (
+    log_vecmat,
+    log_matvec,
+    safe_log_normalize,
+    safe_logsumexp,
+)
 
 __all__ = ["forward_filter", "backward_pass", "smooth", "forward_backward"]
 
@@ -88,7 +93,12 @@ def forward_filter(
     xs = (log_obs[1:], m[1:]) if A_t is None else (log_obs[1:], m[1:], A_t)
     alpha_last, alpha_rest = lax.scan(step, alpha0, xs)
     log_alpha = jnp.concatenate([alpha0[None], alpha_rest], axis=0)
-    return log_alpha, logsumexp(alpha_last)
+    # guarded reduction: an all--inf final filter (impossible evidence /
+    # fully-gated series) keeps loglik = -inf (likelihood ORDERING stays
+    # honest for model-comparison consumers) but with zero — not NaN —
+    # gradients, so one degenerate series rejects/quarantines instead of
+    # poisoning its whole vmap lane; bitwise identical otherwise
+    return log_alpha, safe_logsumexp(alpha_last)
 
 
 def backward_pass(
@@ -132,8 +142,10 @@ def smooth(log_alpha: jnp.ndarray, log_beta: jnp.ndarray) -> jnp.ndarray:
     """Smoothed state log-probabilities ``log_gamma [T,K]`` (normalized per t).
 
     Equivalent of the reference's ``gamma_tk`` (`hmm/stan/hmm.stan:89-96`).
+    Uses the guarded normalization: a time step whose posterior support
+    is empty (all--inf row) stays an all--inf floor instead of NaN.
     """
-    return log_normalize(log_alpha + log_beta, axis=-1)
+    return safe_log_normalize(log_alpha + log_beta, axis=-1)
 
 
 def forward_backward(

@@ -1,0 +1,25 @@
+"""Fault-tolerance subsystem: in-scan chain-health guards
+(`robust/guards.py`), the self-healing retry/escalation/backend policy
+(`robust/retry.py`), and the fault-injection harness that proves the
+recovery paths end-to-end (`robust/faults.py`). Wiring: the samplers in
+`infer/` route every transition through the guard; `batch/fit.py`
+applies the retry policy per dispatch chunk. See `docs/robustness.md`.
+"""
+
+from hhmm_tpu.robust.guards import all_finite, finite_mask, guard_update, guard_where
+from hhmm_tpu.robust.faults import FaultPlan, SimulatedCrash, inject
+from hhmm_tpu.robust.retry import RetryPolicy, ensure_backend, escalate, rejitter
+
+__all__ = [
+    "all_finite",
+    "finite_mask",
+    "guard_update",
+    "guard_where",
+    "FaultPlan",
+    "SimulatedCrash",
+    "inject",
+    "RetryPolicy",
+    "ensure_backend",
+    "escalate",
+    "rejitter",
+]

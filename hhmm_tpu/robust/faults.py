@@ -1,0 +1,258 @@
+"""Fault-injection harness: deterministic corruption of sampler state,
+chunk-level crashes, and torn cache files, for proving the recovery
+paths in `tests/test_robust.py` end-to-end.
+
+Design constraints:
+
+- **Zero production overhead.** When no plan is active, the samplers
+  trace no injection ops at all — the compiled program is byte-for-byte
+  the plan-free program.
+- **Bit-identical controls.** The guard-path tests need an *uninjected*
+  run compiled from the *same* program as the injected one (so healthy
+  chains can be compared bitwise). A plan with ``step=-1 / chain=-1``
+  never fires but traces the identical ops; the fault arrays are traced
+  runtime inputs, not baked constants.
+- **In-scan faults target direct sampler calls.** ``sample_nuts`` /
+  ``sample_chees_batched`` / ``sample_gibbs`` consult the active plan at
+  trace time and thread per-chain ``(step, kind)`` arrays through their
+  scans. Under ``fit_batched``'s outer series ``vmap`` a single trace
+  serves every series, so in-scan plans cannot target one series there —
+  use the dispatch-level ``kind="unhealthy_result"`` fault (applied by
+  ``fit_batched`` between the XLA execution and the retry logic) and
+  ``crash_after_chunks`` instead.
+
+Usage::
+
+    with faults.inject(faults.FaultPlan(kind="nan_grad", step=40, chain=1)):
+        qs, stats = sample_nuts(...)
+    assert not stats["chain_healthy"][1]
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+__all__ = [
+    "FaultPlan",
+    "SimulatedCrash",
+    "inject",
+    "active",
+    "chain_fault_arrays",
+    "batch_fault_arrays",
+    "corrupt",
+    "corrupt_tree",
+    "note_chunk_complete",
+    "corrupt_chunk_result",
+    "tear_file",
+]
+
+# in-scan fault kinds → int codes traced into the sampler scans
+KIND_NONE = 0
+KIND_NAN_GRAD = 1
+KIND_NAN_LOGP = 2
+KIND_INF_LOGP = 3
+KIND_NAN_STATE = 4
+_IN_SCAN_KINDS: Dict[str, int] = {
+    "nan_grad": KIND_NAN_GRAD,
+    "nan_logp": KIND_NAN_LOGP,
+    "inf_logp": KIND_INF_LOGP,
+    "nan_state": KIND_NAN_STATE,
+}
+
+
+class SimulatedCrash(RuntimeError):
+    """Raised by :func:`note_chunk_complete` to simulate a process dying
+    between dispatch chunks (TPU preemption / watchdog kill). Completed
+    chunks are already cached, so a rerun resumes from the cache."""
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    """One fault to inject. ``kind`` selects the mechanism:
+
+    - ``"nan_grad" | "nan_logp" | "inf_logp" | "nan_state"``: in-scan —
+      corrupt the post-transition gradient / log-density / position of
+      chain ``chain`` (of series ``series`` for batched ChEES) at global
+      transition index ``step`` (warmup transitions count first).
+      ``step=-1`` or ``chain=-1`` makes a no-op plan that still traces
+      the injection ops (the bitwise control run).
+    - ``"unhealthy_result"``: dispatch-level — after a ``fit_batched``
+      chunk executes, poison chain ``chain`` of global series ``series``
+      with NaN draws and an unhealthy mask, on dispatch attempt 0 only
+      (or on every attempt with ``sticky=True``, to test graceful
+      degradation when healing cannot succeed).
+    - ``"none"``: carries only ``crash_after_chunks``.
+
+    ``crash_after_chunks=N`` additionally makes ``fit_batched`` raise
+    :class:`SimulatedCrash` after N chunks have completed (composable
+    with any kind).
+    """
+
+    kind: str = "none"
+    step: int = -1
+    chain: int = -1
+    series: int = 0
+    sticky: bool = False
+    crash_after_chunks: Optional[int] = None
+
+    def __post_init__(self):
+        if self.kind not in ("none", "unhealthy_result", *_IN_SCAN_KINDS):
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+
+
+_ACTIVE: list = []  # stack of FaultPlan
+_CHUNKS_DONE: list = []  # parallel stack of completed-chunk counters
+
+
+@contextmanager
+def inject(plan: FaultPlan):
+    """Activate ``plan`` for the duration of the block (re-entrant; the
+    innermost plan wins)."""
+    _ACTIVE.append(plan)
+    _CHUNKS_DONE.append(0)
+    try:
+        yield plan
+    finally:
+        _ACTIVE.pop()
+        _CHUNKS_DONE.pop()
+
+
+def active() -> Optional[FaultPlan]:
+    return _ACTIVE[-1] if _ACTIVE else None
+
+
+# ---------------------------------------------------------------- in-scan
+
+
+def chain_fault_arrays(n_chains: int) -> Optional[Tuple[jnp.ndarray, jnp.ndarray]]:
+    """Per-chain ``(fault_step, fault_kind)`` int32 arrays for the active
+    in-scan plan, or None when no in-scan plan is active (the production
+    path: no injection ops get traced). Only ``series == 0`` plans
+    target single-series samplers."""
+    plan = active()
+    if plan is None or plan.kind not in _IN_SCAN_KINDS:
+        return None
+    step = np.full((n_chains,), -1, np.int32)
+    kind = np.zeros((n_chains,), np.int32)
+    if plan.series == 0 and 0 <= plan.chain < n_chains:
+        step[plan.chain] = plan.step
+        kind[plan.chain] = _IN_SCAN_KINDS[plan.kind]
+    return jnp.asarray(step), jnp.asarray(kind)
+
+
+def batch_fault_arrays(
+    n_series: int, n_chains: int
+) -> Optional[Tuple[jnp.ndarray, jnp.ndarray]]:
+    """[B, C] ``(fault_step, fault_kind)`` arrays for the batched ChEES
+    sampler, or None when no in-scan plan is active."""
+    plan = active()
+    if plan is None or plan.kind not in _IN_SCAN_KINDS:
+        return None
+    step = np.full((n_series, n_chains), -1, np.int32)
+    kind = np.zeros((n_series, n_chains), np.int32)
+    if 0 <= plan.series < n_series and 0 <= plan.chain < n_chains:
+        step[plan.series, plan.chain] = plan.step
+        kind[plan.series, plan.chain] = _IN_SCAN_KINDS[plan.kind]
+    return jnp.asarray(step), jnp.asarray(kind)
+
+
+def _fire_where(fire, x):
+    """Broadcast the per-chain ``fire`` mask over ``x``'s trailing axes."""
+    fire = jnp.asarray(fire)
+    return fire.reshape(fire.shape + (1,) * (jnp.ndim(x) - fire.ndim))
+
+
+def corrupt(t, fault_step, fault_kind, logp=None, grad=None, q=None):
+    """Apply the traced in-scan corruption at transition index ``t``.
+
+    ``fault_step``/``fault_kind`` are the per-chain arrays (scalars under
+    a chain ``vmap``); shapes broadcast over the state's trailing axes.
+    Returns ``(logp, grad, q)`` with None passed through.
+    """
+    fire = (t == fault_step) & (fault_kind != KIND_NONE)
+    if logp is not None:
+        logp = jnp.where(fire & (fault_kind == KIND_NAN_LOGP), jnp.nan, logp)
+        logp = jnp.where(fire & (fault_kind == KIND_INF_LOGP), jnp.inf, logp)
+    if grad is not None:
+        grad = jnp.where(
+            _fire_where(fire & (fault_kind == KIND_NAN_GRAD), grad), jnp.nan, grad
+        )
+    if q is not None:
+        q = jnp.where(
+            _fire_where(fire & (fault_kind == KIND_NAN_STATE), q), jnp.nan, q
+        )
+    return logp, grad, q
+
+
+def corrupt_tree(t, fault_step, fault_kind, tree):
+    """``kind="nan_state"`` corruption of every float leaf of ``tree``
+    (the Gibbs parameter block, which has no gradient)."""
+    import jax
+
+    fire = (t == fault_step) & (fault_kind == KIND_NAN_STATE)
+
+    def leaf(x):
+        x = jnp.asarray(x)
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            return x
+        return jnp.where(_fire_where(fire, x), jnp.nan, x)
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+# ----------------------------------------------------------- dispatch-level
+
+
+def note_chunk_complete() -> None:
+    """Called by ``fit_batched`` after each chunk is computed *and*
+    cached; raises :class:`SimulatedCrash` when the active plan's
+    ``crash_after_chunks`` budget is exhausted."""
+    plan = active()
+    if plan is None or plan.crash_after_chunks is None:
+        return
+    _CHUNKS_DONE[-1] += 1
+    if _CHUNKS_DONE[-1] >= plan.crash_after_chunks:
+        raise SimulatedCrash(
+            f"simulated crash after {_CHUNKS_DONE[-1]} completed chunk(s)"
+        )
+
+
+def corrupt_chunk_result(qs, stats, chunk_start: int, chunk_len: int, attempt: int):
+    """Dispatch-level fault for the self-healing tests: poison one
+    chain's chunk result exactly as a mid-scan quarantine would surface
+    it (NaN draws + unhealthy mask). Fires on dispatch attempt 0 only
+    unless the plan is ``sticky``. No-op when inactive."""
+    plan = active()
+    if plan is None or plan.kind != "unhealthy_result":
+        return qs, stats
+    if attempt > 0 and not plan.sticky:
+        return qs, stats
+    s = plan.series - chunk_start
+    if not (0 <= s < chunk_len) or "chain_healthy" not in stats:
+        return qs, stats
+    qs = jnp.asarray(qs).at[s, plan.chain].set(jnp.nan)
+    stats = dict(stats)
+    stats["chain_healthy"] = (
+        jnp.asarray(stats["chain_healthy"]).at[s, plan.chain].set(False)
+    )
+    if "quarantine_step" in stats:
+        stats["quarantine_step"] = (
+            jnp.asarray(stats["quarantine_step"]).at[s, plan.chain].set(plan.step)
+        )
+    return qs, stats
+
+
+def tear_file(path: str, keep_bytes: int = 16) -> None:
+    """Truncate ``path`` to its first ``keep_bytes`` bytes — a torn
+    mid-write cache file (the crash mode atomic writes prevent and
+    ``ResultCache.get`` must tolerate)."""
+    with open(path, "rb") as f:
+        head = f.read(keep_bytes)
+    with open(path, "wb") as f:
+        f.write(head)

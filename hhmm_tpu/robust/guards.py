@@ -1,0 +1,98 @@
+"""In-scan chain-health guards: quarantine non-finite chains inside the
+sampling loop instead of letting one NaN poison a whole ``vmap`` batch.
+
+The north-star workload runs hundreds of chains inside a single jitted
+program (`batch/fit.py`). Without a guard, one chain whose log-density or
+gradient goes non-finite propagates NaN through every subsequent
+``lax.scan`` step of *its own lane* — and, worse, through any pooled
+adaptation statistic that reads it (`infer/chees.py`). The guard pattern
+used by every sampler is:
+
+    new_state, healthy = guard_update(healthy, new_state, prev_state)
+
+A transition whose proposed state contains any non-finite float is
+rejected in favor of the previous (finite) state, and the chain's
+``healthy`` flag drops to False — *permanently*: a quarantined chain is
+frozen at its last finite state for the remainder of the run (its
+adaptation state is frozen too, by the caller, via :func:`guard_where`).
+The final per-chain mask is surfaced as ``stats["chain_healthy"]`` with
+the global transition index of the quarantine event in
+``stats["quarantine_step"]`` (-1 = never tripped), and
+:func:`hhmm_tpu.infer.diagnostics.summary` accepts the mask to exclude
+quarantined chains from posterior summaries.
+
+All helpers are pure, jittable, and — on all-finite inputs — exact
+identities (``jnp.where(True, new, old)`` is a bitwise select), so the
+guarded samplers produce bit-identical draws to the unguarded ones on
+healthy trajectories. See `docs/robustness.md`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["all_finite", "finite_mask", "guard_where", "guard_update"]
+
+
+def finite_mask(tree: Any, batch_ndim: int = 0) -> jnp.ndarray:
+    """Per-chain finiteness of every float leaf in ``tree``.
+
+    Reduces all axes *after* the leading ``batch_ndim`` axes, returning a
+    bool array of shape ``tree_leaf.shape[:batch_ndim]`` (scalar for
+    ``batch_ndim=0``). Non-float leaves (ints, bools, PRNG keys) are
+    ignored — they cannot encode a NaN.
+    """
+    ok = None
+    for leaf in jax.tree_util.tree_leaves(tree):
+        leaf = jnp.asarray(leaf)
+        if not jnp.issubdtype(leaf.dtype, jnp.floating):
+            continue
+        f = jnp.isfinite(leaf)
+        f = f.reshape(leaf.shape[:batch_ndim] + (-1,)).all(axis=-1)
+        ok = f if ok is None else ok & f
+    if ok is None:
+        return jnp.ones((), bool) if batch_ndim == 0 else jnp.asarray(True)
+    return ok
+
+
+def all_finite(*trees: Any) -> jnp.ndarray:
+    """Scalar bool: every float leaf of every argument is finite."""
+    ok = jnp.asarray(True)
+    for tree in trees:
+        ok = ok & finite_mask(tree, batch_ndim=0)
+    return ok
+
+
+def guard_where(ok: jnp.ndarray, new: Any, old: Any) -> Any:
+    """Per-leaf ``jnp.where(ok, new, old)`` with ``ok`` broadcast over
+    each leaf's trailing axes (``ok`` has the leading chain/batch axes).
+
+    On ``ok == True`` this is a bitwise select of ``new`` — the guarded
+    path is an exact identity for healthy chains.
+    """
+
+    def sel(n, o):
+        n = jnp.asarray(n)
+        cond = ok.reshape(ok.shape + (1,) * (n.ndim - ok.ndim))
+        return jnp.where(cond, n, o)
+
+    return jax.tree_util.tree_map(sel, new, old)
+
+
+def guard_update(
+    healthy: jnp.ndarray, new: Any, old: Any, batch_ndim: int = 0
+) -> Tuple[Any, jnp.ndarray]:
+    """The chain-health transition guard.
+
+    ``healthy`` is the per-chain mask carried through the scan; ``new``
+    and ``old`` are matching pytrees of chain state (position, log
+    density, gradient, ...). Returns ``(state, healthy')`` where a chain
+    keeps ``new`` only if it was healthy *and* ``new`` is entirely
+    finite; otherwise it stays frozen at ``old`` and its flag drops to
+    False — permanently, because ``healthy' = healthy & finite(new)``.
+    """
+    ok = healthy & finite_mask(new, batch_ndim)
+    return guard_where(ok, new, old), ok

@@ -1,0 +1,154 @@
+"""Self-healing dispatch policy: bounded retries with deterministic
+re-jittered PRNG keys, an escalating remedy ladder, and graceful
+backend degradation.
+
+The reference's only resilience mechanism was per-task RDS memoization
+(`tayal2009/R/wf-trade.R:86-109`) — a crashed sweep resumed, but a chain
+that diverged or a backend that failed to initialize killed the run.
+This module supplies the policy half of the fault-tolerance subsystem
+(`batch/fit.py` supplies the mechanism):
+
+- **Escalation ladder** (:func:`escalate`), applied per failed
+  series-chunk when quarantined chains survive a dispatch:
+
+  1. fresh inits + re-jittered keys (same config),
+  2. \\+ halved ``init_step_size`` and raised ``target_accept``,
+  3. \\+ reduced ``max_treedepth`` (NUTS) / halved ``max_leapfrogs``
+     (ChEES).
+
+  Gibbs has no step-size knobs; every attempt is fresh inits + keys.
+- **Deterministic re-jitter** (:func:`rejitter`): retry keys are a pure
+  function of (original key, attempt), so a crashed-and-resumed sweep
+  replays the identical healing sequence and the digest cache stays
+  coherent.
+- **Backoff** (:meth:`RetryPolicy.backoff`) between device-level
+  retries of UNAVAILABLE faults.
+- **Backend degradation** (:func:`ensure_backend`): probe backend init
+  and fall back to CPU with a clear log line instead of crashing with
+  rc=1 — the `BENCH_r05.json` failure mode.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+
+__all__ = ["RetryPolicy", "escalate", "rejitter", "ensure_backend"]
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Bounds + knobs for the self-healing dispatch in ``fit_batched``.
+
+    ``max_heal_attempts``: quarantined-chain re-dispatches per chunk
+    (attempt 0 is the original dispatch). ``device_retries``: attempts
+    per dispatch for device-level UNAVAILABLE faults, with
+    ``backoff(attempt)`` seconds between them.
+    """
+
+    max_heal_attempts: int = 3
+    device_retries: int = 4
+    backoff_base_s: float = 15.0
+    step_size_factor: float = 0.5
+    target_accept_raise: float = 0.05
+    target_accept_cap: float = 0.95
+    treedepth_step: int = 2
+    treedepth_floor: int = 4
+    leapfrog_floor: int = 8
+
+    def backoff(self, attempt: int) -> float:
+        """Backoff before device-level retry ``attempt`` (0-based):
+        linear-in-attempt multiples of the base (matches the historical
+        ``_RETRY_SLEEP_S * (attempt + 1)`` schedule)."""
+        return self.backoff_base_s * (attempt + 1)
+
+
+def rejitter(key: jax.Array, attempt: int) -> jax.Array:
+    """Deterministic retry key: fold the attempt number (plus a salt so
+    attempt keys never collide with ordinary ``fold_in(key, i)`` series
+    derivations) into the original key."""
+    return jax.random.fold_in(jax.random.fold_in(key, 0x5EED), attempt)
+
+
+def escalate(config: Any, attempt: int, policy: RetryPolicy = RetryPolicy()) -> Any:
+    """Remedy ladder for healing attempt ``attempt`` (1-based).
+
+    Works on any frozen config dataclass by duck-typing the knobs it
+    owns (``init_step_size``/``target_accept`` for both HMC samplers,
+    ``max_treedepth`` for NUTS, ``max_leapfrogs`` for ChEES); a config
+    with none of them (Gibbs) is returned unchanged — its only remedies
+    are the fresh inits and re-jittered keys the caller applies.
+    """
+    if attempt <= 1:
+        return config
+    kw: Dict[str, Any] = {}
+    if hasattr(config, "init_step_size"):
+        kw["init_step_size"] = config.init_step_size * (
+            policy.step_size_factor ** (attempt - 1)
+        )
+    if hasattr(config, "target_accept"):
+        kw["target_accept"] = min(
+            policy.target_accept_cap,
+            config.target_accept + policy.target_accept_raise * (attempt - 1),
+        )
+    if attempt >= 3:
+        if hasattr(config, "max_treedepth"):
+            kw["max_treedepth"] = max(
+                policy.treedepth_floor, config.max_treedepth - policy.treedepth_step
+            )
+        if hasattr(config, "max_leapfrogs"):
+            kw["max_leapfrogs"] = max(
+                policy.leapfrog_floor, config.max_leapfrogs // 2
+            )
+    return dataclasses.replace(config, **kw) if kw else config
+
+
+def ensure_backend() -> Dict[str, Any]:
+    """Probe JAX backend initialization; degrade to CPU instead of
+    crashing when the accelerator plugin fails to come up.
+
+    Returns ``{"backend": name, "fallback": bool, "devices": n}``. On a
+    probe failure the platform is forced to CPU (config + env, with a
+    best-effort backend-cache clear so re-initialization can succeed)
+    and a clear log line is emitted — the fix for the `BENCH_r05.json`
+    rc=1 crash mode. Raises only if even the CPU backend cannot start.
+    """
+    try:
+        devs = jax.devices()
+        return {
+            "backend": jax.default_backend(),
+            "fallback": False,
+            "devices": len(devs),
+        }
+    except Exception as e:  # backend init failure (RuntimeError subclasses vary)
+        print(
+            f"# backend init failed ({type(e).__name__}: {e}); "
+            "falling back to JAX_PLATFORMS=cpu",
+            file=sys.stderr,
+            flush=True,
+        )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    # best-effort: drop any partially-initialized backend state so the
+    # retry below re-runs discovery under the CPU-only platform list
+    for clear in (
+        getattr(jax, "clear_backends", None),
+        getattr(getattr(jax, "_src", None), "xla_bridge", None)
+        and getattr(jax._src.xla_bridge, "_clear_backends", None),
+    ):
+        if clear is not None:
+            try:
+                clear()
+                break
+            except Exception:
+                pass
+    devs = jax.devices()
+    return {"backend": "cpu", "fallback": True, "devices": len(devs)}

@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Static robustness pass (tier-1, no JAX import — pure ``ast``).
+
+Asserts the two invariants the fault-tolerance subsystem
+(`docs/robustness.md`) depends on:
+
+1. **No bare ``except:``** anywhere under ``hhmm_tpu/`` — a bare handler
+   swallows ``KeyboardInterrupt``/``SystemExit`` and, worse, masks the
+   device faults the retry layer (`robust/retry.py`) must *see* to
+   classify (UNAVAILABLE vs deterministic). Catch concrete types.
+2. **Every public sampler entry point routes through the chain-health
+   guard**: each sampler module (`infer/run.py`, `infer/chees.py`,
+   `infer/gibbs.py`) must import from ``hhmm_tpu.robust.guards`` and
+   actually *call* a guard function — a sampler added (or refactored)
+   without the guard would silently reintroduce NaN poisoning of vmapped
+   batches.
+
+Exit 0 when clean, 1 with one line per violation. Run by
+``tests/test_robust.py`` so the pass is enforced in tier-1.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+from typing import List
+
+# sampler entry modules -> guard functions at least one of which must be
+# both imported from hhmm_tpu.robust.guards and called
+SAMPLER_MODULES = {
+    "hhmm_tpu/infer/run.py": ("guard_update", "guard_where"),
+    "hhmm_tpu/infer/chees.py": ("guard_update", "guard_where"),
+    "hhmm_tpu/infer/gibbs.py": ("guard_update", "guard_where"),
+}
+GUARDS_MODULE = "hhmm_tpu.robust.guards"
+
+
+def _bare_excepts(path: pathlib.Path, rel: str, problems: List[str]) -> None:
+    tree = ast.parse(path.read_text(), filename=str(path))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            problems.append(f"{rel}:{node.lineno}: bare `except:` (name the exception types)")
+
+
+def _guard_symbols(tree: ast.Module) -> set:
+    """Names bound from ``from hhmm_tpu.robust.guards import ...`` (the
+    robust package re-exports count too)."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module in (
+            GUARDS_MODULE,
+            "hhmm_tpu.robust",
+        ):
+            for alias in node.names:
+                names.add(alias.asname or alias.name)
+    return names
+
+
+def _called_names(tree: ast.Module) -> set:
+    calls = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+            calls.add(node.func.id)
+    return calls
+
+
+def check(root: pathlib.Path) -> List[str]:
+    problems: List[str] = []
+    pkg = root / "hhmm_tpu"
+    if not pkg.is_dir():
+        return [f"{root}: no hhmm_tpu/ package to check"]
+    for py in sorted(pkg.rglob("*.py")):
+        _bare_excepts(py, str(py.relative_to(root)), problems)
+    for rel, guard_fns in sorted(SAMPLER_MODULES.items()):
+        path = root / rel
+        if not path.is_file():
+            problems.append(f"{rel}: sampler module missing")
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        imported = _guard_symbols(tree) & set(guard_fns)
+        if not imported:
+            problems.append(
+                f"{rel}: does not import a chain-health guard from {GUARDS_MODULE} "
+                f"(expected one of {guard_fns})"
+            )
+            continue
+        if not (imported & _called_names(tree)):
+            problems.append(
+                f"{rel}: imports {sorted(imported)} but never calls a guard — "
+                "transitions are unguarded"
+            )
+    return problems
+
+
+def main(argv: List[str]) -> int:
+    root = (
+        pathlib.Path(argv[1])
+        if len(argv) > 1
+        else pathlib.Path(__file__).resolve().parent.parent
+    )
+    problems = check(root)
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"check_guards: {len(problems)} violation(s)")
+        return 1
+    print("check_guards: ok (no bare excepts; all samplers guarded)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

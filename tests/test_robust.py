@@ -1,0 +1,589 @@
+"""Fault-injection + robustness suite (tier-1, fast — see
+`docs/robustness.md`).
+
+Proves each recovery path end-to-end with the `robust/faults.py`
+injectors:
+
+- in-scan guards: a NaN injected into one chain's gradient mid-scan
+  leaves every other chain's posterior bit-identical to an uninjected
+  run and marks exactly that chain unhealthy (NUTS, Gibbs; ChEES
+  quarantines + stays finite — its adaptation is shared by design);
+- self-healing dispatch: quarantined series are re-dispatched with
+  re-jittered keys, healthy series kept bitwise, sticky faults degrade
+  gracefully instead of crashing;
+- crash recovery: a simulated crash between chunks + rerun resumes from
+  the cache and matches the uninterrupted run bitwise; torn/corrupt
+  cache entries are misses, not exceptions;
+- diagnostics never raise or NaN on pathological draws;
+- the static guard pass (`scripts/check_guards.py`) holds.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hhmm_tpu.batch import ResultCache, digest_key, fit_batched
+from hhmm_tpu.infer import (
+    ChEESConfig,
+    GibbsConfig,
+    SamplerConfig,
+    sample_chees_batched,
+    sample_gibbs,
+    sample_nuts,
+)
+from hhmm_tpu.infer.diagnostics import (
+    ess,
+    ess_many,
+    split_rhat,
+    split_rhat_many,
+    summary,
+)
+from hhmm_tpu.models import MultinomialHMM
+from hhmm_tpu.robust import FaultPlan, RetryPolicy, escalate, faults, rejitter
+from hhmm_tpu.robust.guards import all_finite, finite_mask, guard_update
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _vg(q):
+    """Standard-normal fused value-and-grad target."""
+    return -0.5 * jnp.sum(q * q), -q
+
+
+NUTS_CFG = SamplerConfig(
+    num_warmup=25, num_samples=25, num_chains=3, max_treedepth=4, init_step_size=0.5
+)
+
+_NUTS_RUNS = {}  # plan -> run result (each run recompiles; cache for tier-1 speed)
+
+
+def _run_nuts(plan):
+    if plan not in _NUTS_RUNS:
+        key = jax.random.PRNGKey(0)
+        init = 0.1 * jax.random.normal(jax.random.PRNGKey(1), (3, 2))
+        with faults.inject(plan):
+            qs, stats = sample_nuts(None, key, init, NUTS_CFG, vg_fn=_vg)
+        _NUTS_RUNS[plan] = (
+            np.asarray(qs),
+            {k: np.asarray(v) for k, v in stats.items()},
+        )
+    return _NUTS_RUNS[plan]
+
+
+class TestGuardHelpers:
+    def test_finite_mask_and_all_finite(self):
+        assert bool(all_finite((jnp.ones(3), jnp.zeros(()))))
+        assert not bool(all_finite((jnp.ones(3), jnp.asarray(np.nan))))
+        # int leaves are ignored (cannot encode NaN)
+        assert bool(all_finite(jnp.arange(3)))
+        m = finite_mask(
+            (jnp.asarray([[1.0, np.nan], [2.0, 3.0]]),), batch_ndim=1
+        )
+        np.testing.assert_array_equal(np.asarray(m), [False, True])
+
+    def test_guard_update_freezes_permanently(self):
+        healthy = jnp.asarray(True)
+        state = (jnp.ones(2), jnp.asarray(0.0))
+        bad = (jnp.full(2, np.nan), jnp.asarray(1.0))
+        state1, healthy = guard_update(healthy, bad, state)
+        assert not bool(healthy)
+        np.testing.assert_array_equal(np.asarray(state1[0]), np.ones(2))
+        # finite follow-up is still rejected: quarantine is permanent
+        good = (jnp.full(2, 5.0), jnp.asarray(2.0))
+        state2, healthy = guard_update(healthy, good, state1)
+        assert not bool(healthy)
+        np.testing.assert_array_equal(np.asarray(state2[0]), np.ones(2))
+
+
+class TestNutsGuard:
+    def test_nan_grad_mid_scan_quarantines_exactly_one_chain(self):
+        """The acceptance-criteria scenario: NaN into one chain's
+        gradient mid-scan -> all other chains bit-identical, exactly
+        that chain unhealthy, its draws finite and frozen."""
+        qs0, st0 = _run_nuts(FaultPlan(kind="nan_grad", step=-1, chain=-1))
+        qs1, st1 = _run_nuts(FaultPlan(kind="nan_grad", step=30, chain=1))
+        np.testing.assert_array_equal(st0["chain_healthy"], [True, True, True])
+        np.testing.assert_array_equal(st0["quarantine_step"], [-1, -1, -1])
+        np.testing.assert_array_equal(st1["chain_healthy"], [True, False, True])
+        np.testing.assert_array_equal(st1["quarantine_step"], [-1, 30, -1])
+        # other chains: bit-identical draws
+        np.testing.assert_array_equal(qs1[[0, 2]], qs0[[0, 2]])
+        # quarantined chain: all-finite, frozen at its last finite state
+        # (global step 30 = sampling draw index 5; the guard rejects the
+        # poisoned transition, so draw 5 repeats draw 4 and every draw
+        # after stays frozen)
+        assert np.isfinite(qs1[1]).all()
+        assert (qs1[1, 5:] == qs1[1, 5]).all()
+        np.testing.assert_array_equal(qs1[1, 5], qs1[1, 4])
+        # pre-fault draws of the injected chain match the control
+        np.testing.assert_array_equal(qs1[1, :5], qs0[1, :5])
+
+    def test_noop_plan_is_bitwise_control(self):
+        """A never-firing plan traces the same program as no plan at
+        all AND produces identical draws — the control is honest."""
+        qs0, st0 = _run_nuts(FaultPlan(kind="nan_grad", step=-1, chain=-1))
+        key = jax.random.PRNGKey(0)
+        init = 0.1 * jax.random.normal(jax.random.PRNGKey(1), (3, 2))
+        qs_plain, _ = sample_nuts(None, key, init, NUTS_CFG, vg_fn=_vg)
+        np.testing.assert_array_equal(qs0, np.asarray(qs_plain))
+
+    def test_warmup_fault_quarantines(self):
+        """A non-finite log-density during *warmup* also quarantines
+        (adaptation state frozen with the chain). The remaining
+        corruption kinds share this path and are unit-covered by
+        TestCorruptKinds."""
+        qs, st = _run_nuts(FaultPlan(kind="nan_logp", step=10, chain=2))
+        np.testing.assert_array_equal(st["chain_healthy"], [True, True, False])
+        assert st["quarantine_step"][2] == 10
+        assert np.isfinite(qs).all()
+
+
+class TestCorruptKinds:
+    """Pure unit coverage of every in-scan corruption kind (the
+    end-to-end guard path is exercised once per sampler above)."""
+
+    def _arrays(self, kind, chain=1, step=5, n=3):
+        with faults.inject(FaultPlan(kind=kind, step=step, chain=chain)):
+            return faults.chain_fault_arrays(n)
+
+    @pytest.mark.parametrize(
+        "kind,field,expect",
+        [
+            ("nan_logp", "logp", np.isnan),
+            ("inf_logp", "logp", np.isinf),
+            ("nan_grad", "grad", np.isnan),
+            ("nan_state", "q", np.isnan),
+        ],
+    )
+    def test_each_kind_hits_only_its_target(self, kind, field, expect):
+        fs, fk = self._arrays(kind)
+        logp = jnp.zeros(3)
+        grad = jnp.ones((3, 2))
+        q = jnp.ones((3, 2))
+        lo, gr, qo = faults.corrupt(jnp.asarray(5), fs, fk, logp, grad, q)
+        out = {"logp": np.asarray(lo), "grad": np.asarray(gr), "q": np.asarray(qo)}
+        assert expect(out[field][1]).all()
+        # only chain 1 touched, and only the targeted field
+        for name, arr in out.items():
+            mask = np.zeros(3, bool)
+            mask[1] = name == field
+            bad = ~np.isfinite(arr.reshape(3, -1)).all(axis=1)
+            np.testing.assert_array_equal(bad, mask)
+
+    def test_wrong_step_is_noop(self):
+        fs, fk = self._arrays("nan_grad", step=5)
+        _, gr, _ = faults.corrupt(jnp.asarray(4), fs, fk, None, jnp.ones((3, 2)), None)
+        assert np.isfinite(np.asarray(gr)).all()
+
+    def test_corrupt_tree_nan_state(self):
+        fs, fk = self._arrays("nan_state", chain=0)
+        tree = {"a": jnp.ones((3, 2)), "n": jnp.arange(3)}  # int leaf untouched
+        out = faults.corrupt_tree(jnp.asarray(5), fs, fk, tree)
+        assert np.isnan(np.asarray(out["a"])[0]).all()
+        assert np.isfinite(np.asarray(out["a"])[1:]).all()
+        np.testing.assert_array_equal(np.asarray(out["n"]), np.arange(3))
+
+
+class TestCheesGuard:
+    def test_nan_grad_quarantines_one_chain_of_one_series(self):
+        def lp_bc(q):
+            return -0.5 * jnp.sum(q * q, -1), -q
+
+        cfg = ChEESConfig(num_warmup=20, num_samples=15, num_chains=2, max_leapfrogs=8)
+        init = 0.3 * jax.random.normal(jax.random.PRNGKey(2), (2, 2, 3))
+        with faults.inject(FaultPlan(kind="nan_grad", step=25, chain=0, series=1)):
+            qs, st = sample_chees_batched(
+                lp_bc, jax.random.PRNGKey(0), init, cfg, probe_vg=_vg
+            )
+        healthy = np.asarray(st["chain_healthy"])
+        np.testing.assert_array_equal(healthy, [[True, True], [False, True]])
+        assert np.asarray(st["quarantine_step"])[1, 0] == 25
+        qs = np.asarray(qs)
+        assert np.isfinite(qs).all()
+        # frozen tail: global step 25 = sampling draw index 5
+        assert (qs[1, 0, 5:] == qs[1, 0, 5]).all()
+
+
+class TestGibbsGuard:
+    def _data(self):
+        rng = np.random.default_rng(0)
+        return {"x": rng.integers(0, 3, size=60)}
+
+    def test_nan_logp_quarantines_other_chain_bitwise(self):
+        model = MultinomialHMM(K=2, L=3)
+        cfg = GibbsConfig(num_warmup=5, num_samples=20, num_chains=2)
+        data = self._data()
+        with faults.inject(FaultPlan(kind="nan_logp", step=-1, chain=-1)):
+            qs0, st0 = sample_gibbs(model, data, jax.random.PRNGKey(3), cfg)
+        with faults.inject(FaultPlan(kind="nan_logp", step=12, chain=0)):
+            qs1, st1 = sample_gibbs(model, data, jax.random.PRNGKey(3), cfg)
+        np.testing.assert_array_equal(np.asarray(st0["chain_healthy"]), [True, True])
+        np.testing.assert_array_equal(np.asarray(st1["chain_healthy"]), [False, True])
+        np.testing.assert_array_equal(np.asarray(st1["quarantine_step"]), [12, -1])
+        qs0, qs1 = np.asarray(qs0), np.asarray(qs1)
+        # the other chain is bit-identical; the quarantined one stays
+        # finite, frozen from the fault's record (t=12 -> draw index 7)
+        np.testing.assert_array_equal(qs0[1], qs1[1])
+        assert np.isfinite(qs1).all()
+        assert (qs1[0, 7:] == qs1[0, 7]).all()
+        # like the HMC samplers, the recorded logp trace is guarded (the
+        # injected NaN records the last finite value; the event itself
+        # is surfaced via quarantine_step, asserted above)
+        assert np.isfinite(np.asarray(st1["logp"])).all()
+
+    def test_nan_state_freezes_params(self):
+        model = MultinomialHMM(K=2, L=3)
+        cfg = GibbsConfig(num_warmup=5, num_samples=15, num_chains=1)
+        with faults.inject(FaultPlan(kind="nan_state", step=8, chain=0)):
+            qs, st = sample_gibbs(model, self._data(), jax.random.PRNGKey(4), cfg)
+        assert not np.asarray(st["chain_healthy"])[0]
+        assert np.isfinite(np.asarray(qs)).all()
+
+
+class TestDiagnosticsRobust:
+    def test_split_rhat_nonfinite_is_inf(self):
+        x = np.random.default_rng(0).normal(size=(2, 40))
+        x[0, 3] = np.nan
+        assert split_rhat(x) == float("inf")
+        x[0, 3] = np.inf
+        assert split_rhat(x) == float("inf")
+
+    def test_split_rhat_zero_variance_is_one(self):
+        assert split_rhat(np.ones((2, 40))) == 1.0
+
+    def test_ess_nonfinite_is_zero(self):
+        x = np.random.default_rng(1).normal(size=(2, 64))
+        x[1, 10] = np.nan
+        assert ess(x) == 0.0
+
+    def test_ess_zero_variance_is_nominal(self):
+        assert ess(np.ones((2, 64))) == 4 * 32.0
+
+    def test_many_variants_match_scalars_per_row(self):
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(4, 2, 64))
+        x[1, 0, 5] = np.nan  # non-finite row
+        x[2] = 3.0  # zero-variance row
+        r_many = split_rhat_many(x)
+        e_many = ess_many(x)
+        for i in range(4):
+            assert r_many[i] == pytest.approx(split_rhat(x[i]), nan_ok=False)
+            assert e_many[i] == pytest.approx(ess(x[i]), rel=1e-12)
+        assert r_many[1] == float("inf") and e_many[1] == 0.0
+        assert r_many[2] == 1.0 and np.isfinite(e_many).all()
+
+    def test_summary_excludes_quarantined_chains(self):
+        rng = np.random.default_rng(3)
+        good = rng.normal(size=(1, 50))
+        bad = np.full((1, 50), np.nan)
+        samples = {"a": np.concatenate([good, bad])}
+        out = summary(samples, health=np.array([True, False]))
+        assert np.isfinite(out["a"]["mean"]).all()
+        assert out["a"]["mean"][0] == pytest.approx(good.mean())
+        assert out["a"]["chains_used"] == 1
+        assert out["a"]["chains_quarantined"] == 1
+        # all-quarantined: nothing dropped, flagged via chains_used=0
+        out2 = summary(samples, health=np.array([False, False]))
+        assert out2["a"]["chains_used"] == 0
+        # no mask: unchanged legacy shape (no health keys)
+        out3 = summary(samples)
+        assert "chains_used" not in out3["a"]
+
+
+class TestSafeLogsumexp:
+    def test_values_and_grads(self):
+        from hhmm_tpu.core.lmath import MASK_NEG, safe_logsumexp
+        from jax.scipy.special import logsumexp as lse
+
+        x = jnp.asarray([[0.5, -1.0, 2.0], [-np.inf, -np.inf, -np.inf]])
+        out = safe_logsumexp(x, axis=-1)
+        assert float(out[0]) == float(lse(x[0]))
+        # default floor is -inf: likelihood ORDERING stays honest (an
+        # impossible row ranks below any possible one)...
+        assert float(out[1]) == -np.inf
+        # ...while a finite floor is available for normalizer use
+        assert float(safe_logsumexp(x, axis=-1, floor=MASK_NEG)[1]) == MASK_NEG
+        # gradients: exact on live rows, exactly zero (never NaN) on
+        # all-masked rows — for either floor
+        for floor in (-np.inf, MASK_NEG):
+            g = jax.grad(
+                lambda v: jnp.nansum(
+                    jnp.where(
+                        jnp.isfinite(safe_logsumexp(v, axis=-1, floor=floor)),
+                        safe_logsumexp(v, axis=-1, floor=floor),
+                        0.0,
+                    )
+                )
+            )(x)
+            assert np.isfinite(np.asarray(g)).all()
+            np.testing.assert_array_equal(np.asarray(g[1]), 0.0)
+        g0 = jax.grad(lambda v: safe_logsumexp(v, axis=-1, floor=MASK_NEG).sum())(x)
+        np.testing.assert_allclose(
+            np.asarray(g0[0]), np.asarray(jax.grad(lambda v: lse(v))(x[0])), rtol=1e-6
+        )
+
+    def test_forward_filter_impossible_series_keeps_inf_ordering(self):
+        """A series whose evidence is impossible under the model keeps
+        loglik = -inf (NOT a finite floor: a finite value would outrank
+        genuinely low log-likelihoods in model-comparison consumers like
+        the Hassan likelihood-neighbor forecaster) — and never NaN.
+        Gradients through the scan interior can still be non-finite for
+        such fully-degenerate input; that is exactly what the in-scan
+        chain-health guard quarantines (TestNutsGuard). The boundary
+        guard's job is the zero cotangent into the all-masked reduction
+        (test_values_and_grads)."""
+        from hhmm_tpu.kernels.filtering import forward_filter
+
+        log_pi = jnp.log(jnp.asarray([0.5, 0.5]))
+        log_A = jnp.log(jnp.asarray([[0.7, 0.3], [0.4, 0.6]]))
+        log_obs = jnp.full((4, 2), -jnp.inf)
+        _, ll = forward_filter(log_pi, log_A, log_obs)
+        assert float(ll) == -np.inf
+
+    def test_smooth_empty_support_step_is_not_nan(self):
+        """smooth() on a time step with empty posterior support keeps
+        the -inf floor instead of NaN (guarded normalization)."""
+        from hhmm_tpu.kernels.filtering import smooth
+
+        la = jnp.asarray([[0.0, -1.0], [-jnp.inf, -jnp.inf]])
+        lb = jnp.zeros((2, 2))
+        g = np.asarray(smooth(la, lb))
+        assert not np.isnan(g).any()
+        assert np.isfinite(g[0]).all()
+
+
+class TestRetryPolicy:
+    def test_escalation_ladder_nuts(self):
+        cfg = SamplerConfig(init_step_size=0.2, target_accept=0.8, max_treedepth=10)
+        assert escalate(cfg, 1) == cfg  # fresh inits only
+        c2 = escalate(cfg, 2)
+        assert c2.init_step_size == pytest.approx(0.1)
+        assert c2.target_accept == pytest.approx(0.85)
+        assert c2.max_treedepth == 10
+        c3 = escalate(cfg, 3)
+        assert c3.init_step_size == pytest.approx(0.05)
+        assert c3.max_treedepth == 8
+
+    def test_escalation_ladder_chees_and_gibbs(self):
+        cc = ChEESConfig(max_leapfrogs=16, init_step_size=0.1)
+        c3 = escalate(cc, 3)
+        assert c3.max_leapfrogs == 8 and c3.init_step_size == pytest.approx(0.025)
+        # floors hold
+        assert escalate(ChEESConfig(max_leapfrogs=8), 3).max_leapfrogs == 8
+        assert escalate(SamplerConfig(max_treedepth=4), 3).max_treedepth == 4
+        # Gibbs has no knobs: unchanged at every rung
+        g = GibbsConfig()
+        assert escalate(g, 3) == g
+
+    def test_rejitter_deterministic_and_distinct(self):
+        k = jax.random.PRNGKey(7)
+        a1, a1b, a2 = rejitter(k, 1), rejitter(k, 1), rejitter(k, 2)
+        np.testing.assert_array_equal(np.asarray(a1), np.asarray(a1b))
+        assert not np.array_equal(np.asarray(a1), np.asarray(a2))
+        assert not np.array_equal(np.asarray(a1), np.asarray(k))
+
+    def test_backoff_schedule(self):
+        p = RetryPolicy(backoff_base_s=2.0)
+        assert [p.backoff(a) for a in range(3)] == [2.0, 4.0, 6.0]
+
+    def test_ensure_backend_falls_back_to_cpu(self, monkeypatch):
+        import hhmm_tpu.robust.retry as retry_mod
+
+        calls = {"n": 0}
+        real_devices = jax.devices
+
+        def flaky(*a, **kw):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("Unable to initialize backend 'tpu' (injected)")
+            return real_devices()
+
+        monkeypatch.setattr(retry_mod.jax, "devices", flaky)
+        out = retry_mod.ensure_backend()
+        assert out["fallback"] is True
+        assert out["backend"] == "cpu"
+        assert out["devices"] >= 1
+
+    def test_ensure_backend_healthy_passthrough(self):
+        from hhmm_tpu.robust.retry import ensure_backend
+
+        out = ensure_backend()
+        assert out["fallback"] is False
+        assert out["devices"] >= 1
+
+
+class TestCacheRobust:
+    def test_torn_file_is_miss_then_recomputable(self, tmp_path):
+        cache = ResultCache(str(tmp_path))
+        key = digest_key("torn")
+        cache.put(key, {"a": np.arange(8.0)})
+        path = os.path.join(str(tmp_path), f"{key}.npz")
+        faults.tear_file(path, keep_bytes=16)
+        assert cache.get(key) is None  # miss, not an exception
+        assert not os.path.exists(path)  # quarantined aside
+        cache.put(key, {"a": np.arange(8.0)})  # re-put works
+        np.testing.assert_array_equal(cache.get(key)["a"], np.arange(8.0))
+
+    def test_garbage_and_empty_files_are_misses(self, tmp_path):
+        cache = ResultCache(str(tmp_path))
+        for key, payload in [(digest_key("g"), b"not a zip at all"), (digest_key("e"), b"")]:
+            with open(os.path.join(str(tmp_path), f"{key}.npz"), "wb") as f:
+                f.write(payload)
+            assert cache.get(key) is None
+
+    def test_atomic_put_leaves_no_temp_files(self, tmp_path):
+        cache = ResultCache(str(tmp_path))
+        cache.put(digest_key("x"), {"a": np.eye(3)})
+        leftovers = [p for p in os.listdir(str(tmp_path)) if ".tmp" in p]
+        assert leftovers == []
+
+
+@pytest.fixture
+def multinom_setup():
+    rng = np.random.default_rng(0)
+    xs = rng.integers(0, 3, size=(4, 50))
+    model = MultinomialHMM(K=2, L=3)
+    cfg = GibbsConfig(num_warmup=5, num_samples=15, num_chains=1)
+    return model, xs, cfg
+
+
+class TestFitCrashResume:
+    def test_crash_between_chunks_resumes_bitwise(self, multinom_setup, tmp_path, capsys):
+        """Satellite: chunked dispatch resuming after a simulated crash
+        between chunks — completed chunks are cache hits, and the
+        resumed posteriors match an uninterrupted run bitwise."""
+        model, xs, cfg = multinom_setup
+        d_ref, d_crash = str(tmp_path / "ref"), str(tmp_path / "crash")
+        qs_ref, _ = fit_batched(
+            model, {"x": xs}, jax.random.PRNGKey(0), cfg, chunk_size=2, cache_dir=d_ref
+        )
+        with pytest.raises(faults.SimulatedCrash):
+            with faults.inject(FaultPlan(crash_after_chunks=1)):
+                fit_batched(
+                    model, {"x": xs}, jax.random.PRNGKey(0), cfg,
+                    chunk_size=2, cache_dir=d_crash,
+                )
+        # chunk 1 (+ the init entry) survived the crash on disk
+        assert len([f for f in os.listdir(d_crash) if f.endswith(".npz")]) == 2
+        capsys.readouterr()
+        qs2, st2 = fit_batched(
+            model, {"x": xs}, jax.random.PRNGKey(0), cfg,
+            chunk_size=2, cache_dir=d_crash,
+        )
+        out = capsys.readouterr().out
+        assert "chunk 1/2: cache hit" in out
+        assert "chunk 2/2: computed + cached" in out
+        np.testing.assert_array_equal(np.asarray(qs_ref), np.asarray(qs2))
+        assert np.asarray(st2["chain_healthy"]).all()
+
+
+class TestSelfHealing:
+    def test_quarantined_series_redisptached_healthy_kept_bitwise(
+        self, multinom_setup, tmp_path
+    ):
+        model, xs, cfg = multinom_setup
+        xs = xs[:2]
+        qs_clean, _ = fit_batched(
+            model, {"x": xs}, jax.random.PRNGKey(0), cfg, chunk_size=2
+        )
+        with faults.inject(FaultPlan(kind="unhealthy_result", series=1, chain=0, step=3)):
+            qs, st = fit_batched(
+                model, {"x": xs}, jax.random.PRNGKey(0), cfg,
+                chunk_size=2, cache_dir=str(tmp_path),
+            )
+        assert np.asarray(st["chain_healthy"]).all()  # healed
+        assert np.isfinite(np.asarray(qs)).all()
+        # the untouched series is bitwise the clean result; the healed
+        # one was re-dispatched with re-jittered keys (different draws)
+        np.testing.assert_array_equal(np.asarray(qs[0]), np.asarray(qs_clean[0]))
+        assert not np.array_equal(np.asarray(qs[1]), np.asarray(qs_clean[1]))
+        # the cache holds the healed result: a rerun reproduces it
+        qs_r, st_r = fit_batched(
+            model, {"x": xs}, jax.random.PRNGKey(0), cfg,
+            chunk_size=2, cache_dir=str(tmp_path),
+        )
+        np.testing.assert_array_equal(np.asarray(qs), np.asarray(qs_r))
+        assert np.asarray(st_r["chain_healthy"]).all()
+
+    def test_device_retries_zero_still_runs_once(self, multinom_setup):
+        """A no-device-retries policy executes the dispatch exactly once
+        instead of skipping it (regression: empty retry loop)."""
+        model, xs, cfg = multinom_setup
+        qs, st = fit_batched(
+            model, {"x": xs[:2]}, jax.random.PRNGKey(0), cfg,
+            chunk_size=2, retry=RetryPolicy(device_retries=0),
+        )
+        assert qs.shape[0] == 2
+        assert np.asarray(st["chain_healthy"]).all()
+
+    def test_sticky_fault_degrades_gracefully(self, multinom_setup, capsys):
+        """A series that cannot be healed is returned with its mask
+        down after the bounded ladder — the sweep completes."""
+        model, xs, cfg = multinom_setup
+        xs = xs[:2]
+        with faults.inject(
+            FaultPlan(kind="unhealthy_result", series=0, chain=0, step=3, sticky=True)
+        ):
+            qs, st = fit_batched(
+                model, {"x": xs}, jax.random.PRNGKey(0), cfg, chunk_size=2,
+                retry=RetryPolicy(max_heal_attempts=2),
+            )
+        healthy = np.asarray(st["chain_healthy"])
+        assert not healthy[0].all() and healthy[1].all()
+        out = capsys.readouterr().out
+        assert "healing attempt" in out and "still quarantined" in out
+
+
+class TestCheckGuardsScript:
+    def test_repo_passes(self):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "check_guards.py")],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "ok" in proc.stdout
+
+    def test_bare_except_and_unguarded_sampler_flagged(self, tmp_path):
+        pkg = tmp_path / "hhmm_tpu"
+        (pkg / "infer").mkdir(parents=True)
+        (pkg / "bad.py").write_text("try:\n    pass\nexcept:\n    pass\n")
+        (pkg / "infer" / "run.py").write_text("def sample_nuts():\n    pass\n")
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "scripts", "check_guards.py"),
+                str(tmp_path),
+            ],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 1
+        assert "bare `except:`" in proc.stdout
+        assert "chain-health guard" in proc.stdout
+
+
+class TestBenchCpuFallback:
+    # the end-to-end subprocess smoke is minutes of jax import + compile,
+    # so it rides in the slow lane; the fallback decision logic itself is
+    # covered fast by TestRetryPolicy::test_ensure_backend_falls_back_to_cpu
+    @pytest.mark.slow
+    def test_bench_quick_exits_zero_with_backend_record(self):
+        """`python bench.py` on a TPU-less host must exit 0 and emit a
+        JSON record carrying the backend/fallback fields (the
+        BENCH_r05.json crash mode, fixed)."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"), "--quick"],
+            capture_output=True, text=True, env=env, cwd=REPO, timeout=600,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        rec = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert rec["metric"] == "tayal_batched_posterior_throughput"
+        assert rec["backend"] == "cpu"
+        assert rec["backend_fallback"] is False  # cpu probe succeeded
+        assert rec["value"] > 0
