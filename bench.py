@@ -122,6 +122,159 @@ def utilization_model(sampler, *, series, chains, T, iters, dim,
     }
 
 
+def serve_bench(args, backend, degraded) -> None:
+    """``--serve``: streaming-inference service bench (`hhmm_tpu/serve/`).
+
+    End-to-end through the real artifact path: a short Gibbs
+    ``fit_batched`` over the first half of every series becomes thinned
+    snapshots in a ``SnapshotRegistry``; the ``MicroBatchScheduler``
+    attaches all series warm-started on that history, then replays the
+    second half tick by tick. The timed region is the sustained replay
+    *after* warmup flushes — where the compile-count metric must be
+    flat (every flush lands in an already-compiled bucket shape); a
+    non-flat count fails the bench (exit 1), the serving analog of the
+    agreement gate. Emits one JSON record with latency percentiles and
+    ticks/sec alongside the fit benches."""
+    import tempfile
+
+    from __graft_entry__ import _tayal_batch
+    from hhmm_tpu.batch import fit_batched
+    from hhmm_tpu.infer import GibbsConfig
+    from hhmm_tpu.models import TayalHHMM
+    from hhmm_tpu.serve import (
+        MicroBatchScheduler,
+        ServeMetrics,
+        SnapshotRegistry,
+        snapshot_from_fit,
+    )
+
+    B, T = args.series, args.T
+    draws = min(args.serve_draws, 8) if args.quick else args.serve_draws
+    n_hist = T // 2
+    ticks = min(args.ticks, T - n_hist, *( [16] if args.quick else [] ))
+    model = TayalHHMM(gate_mode="hard")
+    x, sign = _tayal_batch(B, T, seed=42)
+    x_np, s_np = np.asarray(x), np.asarray(sign)
+    names = [f"s{i:05d}" for i in range(B)]
+
+    # fit on the history half -> thinned servable snapshots
+    cfg = GibbsConfig(
+        num_warmup=50, num_samples=max(4 * draws, 100), num_chains=1
+    )
+    t0 = time.time()
+    samples, stats = fit_batched(
+        model,
+        {"x": x[:, :n_hist], "sign": sign[:, :n_hist]},
+        jax.random.PRNGKey(0),
+        cfg,
+        chunk_size=min(args.chunk, B),
+    )
+    fit_s = time.time() - t0
+    reg_root = tempfile.mkdtemp(prefix="serve_registry_")
+    # self-cleaning: repeated sweep invocations must not accumulate
+    # B-snapshot directories in /tmp (atexit also covers the exit-1
+    # recompile-gate path, which leaves via sys.exit)
+    import atexit
+    import shutil
+
+    atexit.register(shutil.rmtree, reg_root, ignore_errors=True)
+    registry = SnapshotRegistry(reg_root)
+    healthy = np.asarray(stats["chain_healthy"]).reshape(B, -1)
+    for i, name in enumerate(names):
+        registry.save(
+            name,
+            snapshot_from_fit(
+                model,
+                np.asarray(samples[i]),
+                chain_healthy=healthy[i],
+                n_draws=draws,
+                meta={"series": i, "n_hist": n_hist},
+            ),
+        )
+
+    # attach from the registry, filter warm-started on the fitted history
+    metrics = ServeMetrics()
+    sched = MicroBatchScheduler(
+        model,
+        buckets=(8, 64, max(64, B)),
+        registry=registry,
+        metrics=metrics,
+    )
+    t0 = time.time()
+    sched.attach_many(
+        [
+            (
+                name,
+                registry.load(name),
+                {"x": x_np[i, :n_hist], "sign": s_np[i, :n_hist]},
+            )
+            for i, name in enumerate(names)
+        ]
+    )
+    attach_s = time.time() - t0
+
+    def replay(t_lo, t_hi):
+        for t in range(t_lo, t_hi):
+            for i, name in enumerate(names):
+                sched.submit(name, {"x": int(x_np[i, t]), "sign": int(s_np[i, t])})
+            sched.flush()
+
+    warm_n = min(2, ticks)
+    replay(n_hist, n_hist + warm_n)
+    compiles_warm = metrics.compile_count
+    # steady-state measurement window: the percentiles and ticks/sec in
+    # the emitted record must describe the same (post-warmup) regime
+    metrics.reset_throughput_window()
+    t0 = time.time()
+    replay(n_hist + warm_n, n_hist + ticks)
+    replay_s = time.time() - t0
+    compiles_after_warmup = metrics.compile_count - compiles_warm
+    n_timed = (ticks - warm_n) * B
+    summary = metrics.summary()
+    print(
+        json.dumps(
+            {
+                "device": str(jax.devices()[0]),
+                "fit_s": round(fit_s, 3),
+                "attach_s": round(attach_s, 3),
+                "replay_s": round(replay_s, 3),
+                "warmup_flushes": warm_n,
+                **summary,
+                "config": vars(args),
+            }
+        ),
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "tayal_serve_tick_throughput",
+                "value": round(n_timed / replay_s, 1) if replay_s > 0 else None,
+                "unit": "ticks/sec",
+                "series": B,
+                "draws_per_series": draws,
+                "ticks_replayed": ticks,
+                "latency_p50_ms": summary["latency_p50_ms"],
+                "latency_p90_ms": summary["latency_p90_ms"],
+                "latency_p99_ms": summary["latency_p99_ms"],
+                "degraded_responses": summary["degraded_responses"],
+                "compile_count": summary["compile_count"],
+                "compiles_after_warmup": compiles_after_warmup,
+                "backend": backend["backend"],
+                "backend_fallback": backend["fallback"],
+                "degraded_cpu_smoke": degraded,
+            }
+        )
+    )
+    if compiles_after_warmup != 0:
+        print(
+            f"# serve bench FAILED: {compiles_after_warmup} XLA compiles "
+            "after warmup (bucketed dispatch must be compile-stable)",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--series", type=int, default=256)
@@ -206,6 +359,30 @@ def main() -> None:
         "here; the gated headline remains the default bench)",
     )
     ap.add_argument("--sweep-samples", type=int, default=2500)
+    ap.add_argument(
+        "--serve",
+        action="store_true",
+        help="run the streaming-service bench instead of the fit bench: "
+        "fit -> snapshot registry -> scheduler attach -> sustained tick "
+        "replay; emits a tayal_serve_tick_throughput JSON record and "
+        "fails (exit 1) on any post-warmup XLA recompile (see "
+        "docs/serving.md)",
+    )
+    ap.add_argument(
+        "--ticks",
+        type=int,
+        default=256,
+        help="serve: ticks replayed per series (capped at T/2 — the "
+        "second half of each simulated series; the first half is the "
+        "fit/warm-start history)",
+    )
+    ap.add_argument(
+        "--serve-draws",
+        type=int,
+        default=32,
+        help="serve: thinned posterior draws per snapshot (fixed across "
+        "series for compile stability)",
+    )
     ap.add_argument("--quick", action="store_true", help="tiny config for smoke tests")
     ap.add_argument(
         "--cpu",
@@ -255,6 +432,10 @@ def main() -> None:
         args.chains = 2 if args.sampler == "chees" else 1
     if args.quick:
         args.series, args.T, args.warmup, args.samples = 8, 128, 20, 20
+
+    if args.serve:
+        serve_bench(args, backend, degraded)
+        return
 
     from __graft_entry__ import _tayal_batch
     from hhmm_tpu.infer import ChEESConfig, SamplerConfig, sample_nuts

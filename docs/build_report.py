@@ -29,6 +29,8 @@ OUT = os.path.join(DOCS, "_build", "report.html")
 PAGES = [
     ("README.md", "Overview & index"),
     ("architecture.md", "Architecture"),
+    ("serving.md", "Streaming inference service"),
+    ("robustness.md", "Fault tolerance"),
     ("results.md", "Results"),
     ("tayal2009.md", "Tayal (2009) replication"),
     ("phi_protocol.md", "Pre-registered φ̂ protocol"),

@@ -17,6 +17,9 @@ Layer map (see SURVEY.md §7):
 - ``infer``    — iterative NUTS on TPU (vmapped chains), Stan-style warmup
   adaptation, Rhat/ESS diagnostics, k-means inits, relabeling.
 - ``parallel`` — mesh sharding for many-series scale-out, result caching.
+- ``robust``   — chain-health guards, self-healing retry, fault injection.
+- ``serve``    — streaming inference service: online forward-filter core,
+  posterior snapshot registry, micro-batching tick scheduler, metrics.
 - ``apps``     — Hassan (2005) forecasting and Tayal (2009) trading
   pipelines.
 """

@@ -3,7 +3,11 @@
 likelihood-neighbor forecaster, and the batched walk-forward harness."""
 
 from hhmm_tpu.apps.hassan.data import Dataset, make_dataset, simulate_ohlc
-from hhmm_tpu.apps.hassan.forecast import forecast_errors, neighbouring_forecast
+from hhmm_tpu.apps.hassan.forecast import (
+    forecast_errors,
+    neighbouring_forecast,
+    online_forecast_mean,
+)
 from hhmm_tpu.apps.hassan.wf import WFForecastResult, wf_forecast, DEFAULT_HYPERPARAMS
 
 __all__ = [
@@ -12,6 +16,7 @@ __all__ = [
     "simulate_ohlc",
     "forecast_errors",
     "neighbouring_forecast",
+    "online_forecast_mean",
     "WFForecastResult",
     "wf_forecast",
     "DEFAULT_HYPERPARAMS",

@@ -18,7 +18,13 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["neighbouring_forecast", "forecast_errors"]
+__all__ = ["neighbouring_forecast", "forecast_errors", "online_forecast_mean"]
+
+# jitted (log_alpha, A_ij, mu_k, ok) -> predictive mean, built lazily so
+# importing this module stays jax-free; one compile serves every series
+# (all snapshots share [D, dim]) — the per-tick forecast path must not
+# pay eager per-call dispatch overhead
+_FORECAST_J = None
 
 
 def neighbouring_forecast(
@@ -52,6 +58,46 @@ def neighbouring_forecast(
         w = np.exp(d) if weights == "reference" else np.exp(-d)
         out[n] = x[-1] + np.sum((x[ind + h] - x[ind]) * w) / np.sum(w)
     return out
+
+
+def online_forecast_mean(scheduler, series_id: str) -> float:
+    """Hassan-style next-observation point forecast, served online.
+
+    Reads ``series_id``'s streaming state off a
+    :class:`hhmm_tpu.serve.MicroBatchScheduler` serving a Gaussian-
+    emission model and returns the one-step-ahead posterior-predictive
+    mean ``E[x_{t+1} | x_{1:t}]``: per thinned draw, the filtered state
+    pushed through the transition and dotted with the state means
+    ``mu_k``; averaged over draws (`serve/online.py::
+    posterior_predictive_mean`). The offline reference forecasts the
+    next daily close from exactly this filtered-state information
+    (`hassan2005/R/forecast.R`); this is its constant-latency serving
+    analog — callers un-scale to price space as in
+    :func:`hhmm_tpu.apps.hassan.wf.wf_forecast`. Quarantined draws
+    (the scheduler's per-draw health mask) are excluded from the
+    average, matching the tick response.
+    """
+    global _FORECAST_J
+    if _FORECAST_J is None:
+        import jax
+
+        from hhmm_tpu.core.lmath import safe_log
+        from hhmm_tpu.serve.online import posterior_predictive_mean
+
+        def _forecast(log_alpha, A_ij, mu_k, ok):
+            return posterior_predictive_mean(
+                log_alpha, safe_log(A_ij), mu_k, weights=ok
+            )
+
+        _FORECAST_J = jax.jit(_forecast)
+
+    log_alpha, _, ok, params = scheduler.state(series_id)
+    if "mu_k" not in params or "A_ij" not in params:
+        raise ValueError(
+            "online_forecast_mean needs a Gaussian-emission HMM posterior "
+            f"(mu_k, A_ij); got parameters {sorted(params)}"
+        )
+    return float(_FORECAST_J(log_alpha, params["A_ij"], params["mu_k"], ok))
 
 
 def forecast_errors(actual: np.ndarray, predicted: np.ndarray) -> dict:

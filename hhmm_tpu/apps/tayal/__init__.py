@@ -14,6 +14,8 @@ from hhmm_tpu.apps.tayal.trading import Trades, topstate_trading, buyandhold, eq
 from hhmm_tpu.apps.tayal.analytics import (
     TopRuns,
     map_to_topstate,
+    online_flip_detector,
+    topstate_probs,
     topstate_runs,
     relabel_by_return,
     topstate_summary,
@@ -34,6 +36,8 @@ __all__ = [
     "equity_curve",
     "TopRuns",
     "map_to_topstate",
+    "online_flip_detector",
+    "topstate_probs",
     "topstate_runs",
     "relabel_by_return",
     "topstate_summary",

@@ -10,7 +10,15 @@ import numpy as np
 
 from hhmm_tpu.apps.tayal.constants import STATE_BEAR, STATE_BULL
 
-__all__ = ["TopRuns", "topstate_runs", "relabel_by_return", "topstate_summary", "map_to_topstate"]
+__all__ = [
+    "TopRuns",
+    "topstate_runs",
+    "relabel_by_return",
+    "topstate_summary",
+    "map_to_topstate",
+    "topstate_probs",
+    "online_flip_detector",
+]
 
 
 def map_to_topstate(state: np.ndarray, pairs=((0, 1), (2, 3))) -> np.ndarray:
@@ -30,6 +38,34 @@ def map_to_topstate(state: np.ndarray, pairs=((0, 1), (2, 3))) -> np.ndarray:
             f"states {sorted(set(state[unmapped].tolist()))} not covered by pairs {pairs}"
         )
     return out
+
+
+def topstate_probs(probs: np.ndarray, pairs=((0, 1), (2, 3))) -> np.ndarray:
+    """Filtered bottom-state probabilities [..., K] → top-state
+    (bear, bull) probabilities [..., 2].
+
+    The probability-space counterpart of :func:`map_to_topstate` (same
+    default pairing {0,1}→bear, {2,3}→bull): each top state owns the
+    summed mass of its production-state pair. Output order is (bear,
+    bull), matching the ``(STATE_BEAR, STATE_BULL)`` code order. Feed
+    the per-tick draw-averaged ``TickResponse.probs`` of the serving
+    scheduler into this, then into an online flip detector."""
+    p = np.asarray(probs)
+    return np.stack([p[..., list(pair)].sum(axis=-1) for pair in pairs], axis=-1)
+
+
+def online_flip_detector(hold: int = 3, margin: float = 0.0):
+    """Tayal-style online regime-flip detector over (bear, bull)
+    top-state probabilities: filtered argmax with hysteresis — the
+    committed regime flips only after ``hold`` consecutive decisive
+    ticks for the challenger (``margin`` over the runner-up), so a
+    single noisy tick never flips a position. Returns a
+    :class:`hhmm_tpu.serve.RegimeDetector`; call ``update(
+    topstate_probs(response.probs))`` per served tick and act on the
+    ``flipped`` flag."""
+    from hhmm_tpu.serve.online import RegimeDetector
+
+    return RegimeDetector(hold=hold, margin=margin)
 
 
 @dataclass(frozen=True)

@@ -376,7 +376,6 @@ def wf_trade(
         return _time.time()
 
     seen_shapes: set = set()
-    tm["decode.shapes_pending"] = len(pend)
     tm["decode.dispatches"] = 0
     for (b_ins, b_oos), idxs in pend.items():
         for c0 in range(0, len(idxs), G_DEC):
@@ -457,6 +456,12 @@ def wf_trade(
                 if meta[j][6] is not None:
                     dcache.put(meta[j][6], {"leg_state": leg_states[j]})
             _acc("decode.cache_io", t_sub)
+
+    # compile-shape accounting: the dispatch keys are (b_ins, b_oos,
+    # full) — a pending (b_ins, b_oos) pair can expand into both the
+    # full and under-filled variants, so the pre-dispatch pending-pair
+    # count under-reported first-call compiles; record the realized set
+    tm["decode.shapes_pending"] = len(seen_shapes)
 
     for k, v in sub.items():  # raw floats accumulated; rounded once
         tm[k] = round(v, 2)

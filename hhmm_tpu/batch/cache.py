@@ -27,11 +27,63 @@ import hashlib
 import json
 import os
 import sys
+import uuid
 from typing import Any, Dict, Optional
 
 import numpy as np
 
-__all__ = ["digest_key", "ResultCache"]
+__all__ = ["digest_key", "ResultCache", "atomic_write_npz", "load_npz_tolerant"]
+
+
+def atomic_write_npz(path: str, arrays: Dict[str, np.ndarray]) -> None:
+    """Crash-safe ``.npz`` write: unique temp name in the same directory
+    (same filesystem for ``os.replace``; pid+uuid so concurrent writers
+    — other processes AND other threads of this one — cannot tear each
+    other's temp), fsync, atomic replace. A reader can never observe a
+    half-written archive. Shared by the result cache below and the
+    serving snapshot registry (`hhmm_tpu/serve/registry.py`)."""
+    tmp = path + f".tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}.npz"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, **{k: np.asarray(v) for k, v in arrays.items()})
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
+
+def quarantine_corrupt(path: str, label: str, err: Exception) -> None:
+    """Move an unreadable entry aside as ``<path>.corrupt`` (so a
+    re-write under the same name works) and log why."""
+    print(
+        f"# {label}: dropping corrupt entry {os.path.basename(path)} "
+        f"({type(err).__name__}: {err})",
+        file=sys.stderr,
+        flush=True,
+    )
+    try:
+        os.replace(path, path + ".corrupt")
+    except OSError:
+        pass
+
+
+def load_npz_tolerant(path: str, label: str) -> Optional[Dict[str, np.ndarray]]:
+    """Corrupt-tolerant ``.npz`` read: a missing file is ``None``; a
+    torn/garbage/unreadable one is ALSO ``None`` (a miss, quarantined
+    aside via :func:`quarantine_corrupt`) instead of an exception
+    wedging the consumer. Members are fully materialized inside the
+    guard — a torn archive can pass the header check and fail
+    mid-member."""
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            return {k: np.asarray(z[k]) for k in z.files}
+    except Exception as e:
+        quarantine_corrupt(path, label, e)
+        return None
 
 
 def _update(h, obj) -> None:
@@ -73,42 +125,11 @@ class ResultCache:
     def get(self, key: str) -> Optional[Dict[str, np.ndarray]]:
         if not self.cache_dir:
             return None
-        path = self._path(key)
-        if not os.path.exists(path):
-            return None
-        try:
-            with np.load(path, allow_pickle=False) as z:
-                # force full materialization inside the try: a torn
-                # archive can pass the header check and fail mid-member
-                return {k: np.asarray(z[k]) for k in z.files}
-        except Exception as e:
-            # corrupt/unreadable entry == cache miss; move it aside so
-            # the recompute can re-put under the same key
-            print(
-                f"# ResultCache: dropping corrupt entry {os.path.basename(path)} "
-                f"({type(e).__name__}: {e})",
-                file=sys.stderr,
-                flush=True,
-            )
-            try:
-                os.replace(path, path + ".corrupt")
-            except OSError:
-                pass
-            return None
+        # corrupt/unreadable entry == cache miss, moved aside so the
+        # recompute can re-put under the same key
+        return load_npz_tolerant(self._path(key), "ResultCache")
 
     def put(self, key: str, value: Dict[str, np.ndarray]) -> None:
         if not self.cache_dir:
             return
-        # unique temp name (same dir => same filesystem for os.replace):
-        # two concurrent writers of the same key must not tear each
-        # other's temp file; last replace wins with an intact archive
-        tmp = self._path(key) + f".tmp.{os.getpid()}.npz"
-        try:
-            with open(tmp, "wb") as f:
-                np.savez(f, **{k: np.asarray(v) for k, v in value.items()})
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self._path(key))
-        finally:
-            if os.path.exists(tmp):
-                os.remove(tmp)
+        atomic_write_npz(self._path(key), value)

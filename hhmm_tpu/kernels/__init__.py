@@ -1,4 +1,5 @@
 from hhmm_tpu.kernels.filtering import (
+    filter_step,
     forward_filter,
     backward_pass,
     smooth,
@@ -16,6 +17,7 @@ from hhmm_tpu.kernels.assoc import forward_filter_assoc, forward_filter_seqshard
 from hhmm_tpu.kernels.alpha_fused import forward_alpha
 
 __all__ = [
+    "filter_step",
     "forward_filter_assoc",
     "forward_filter_seqshard",
     "forward_filter",

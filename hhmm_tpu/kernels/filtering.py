@@ -40,9 +40,35 @@ from hhmm_tpu.core.lmath import (
     safe_logsumexp,
 )
 
-__all__ = ["forward_filter", "backward_pass", "smooth", "forward_backward"]
+__all__ = [
+    "filter_step",
+    "forward_filter",
+    "backward_pass",
+    "smooth",
+    "forward_backward",
+]
 
 _NEG_INF = -jnp.inf
+
+
+def filter_step(
+    log_alpha: jnp.ndarray,
+    log_A: jnp.ndarray,
+    log_obs_t: jnp.ndarray,
+    mask_t: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """One forward-filter recurrence step: ``α'_j = lse_i(α_i + A_ij) + obs_j``.
+
+    This is the per-step body of :func:`forward_filter`'s ``lax.scan`` —
+    factored out so the streaming service (`hhmm_tpu/serve/online.py`)
+    folds the *identical* arithmetic one tick at a time: an O(K²) update
+    with no re-scan, bitwise-matching the batch filter. A masked step
+    (``mask_t == 0``) returns the carry unchanged (padding no-op).
+    """
+    new = log_vecmat(log_alpha, log_A) + log_obs_t
+    if mask_t is not None:
+        new = jnp.where(mask_t > 0, new, log_alpha)
+    return new
 
 
 def _split_A(log_A: jnp.ndarray, T: int):
@@ -84,9 +110,7 @@ def forward_filter(
             lA = log_A
         else:
             obs_t, m_t, lA = xs
-        new = log_vecmat(carry, lA) + obs_t
-        if mask is not None:
-            new = jnp.where(m_t > 0, new, carry)
+        new = filter_step(carry, lA, obs_t, m_t if mask is not None else None)
         return new, new
 
     m = jnp.ones((T,), log_obs.dtype) if mask is None else mask

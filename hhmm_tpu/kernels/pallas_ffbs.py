@@ -106,7 +106,13 @@ def _ffbs_kernel(
     else:
         ll_ref, z_ref, alpha_scr = refs
     T, K, B = obs_ref.shape
-    A = A_ref[:]
+    # clamp at kernel entry: a caller passing an accidental -inf in A
+    # would NaN both the unrolled column select (`0 * -inf` in
+    # _select_col) and the backward-draw logits (`g * Acol` with g = 0);
+    # at the clamp floor exp underflows to exactly 0, so bad input
+    # degrades to zero-probability paths instead of NaN-ing every draw.
+    # Model-produced inputs (safe_log / MASK_NEG floors) pass unchanged.
+    A = jnp.maximum(A_ref[:], _CLAMP)
 
     def A_at(t):
         if not gated:

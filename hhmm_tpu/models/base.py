@@ -183,6 +183,39 @@ class BaseHMMModel:
 
         return vg
 
+    # ---- streaming (serve/) hooks ----
+
+    def tick_init(self, params: Dict[str, jnp.ndarray], obs: Data):
+        """First-tick streaming terms ``(log_pi [K], log_obs_0 [K])``.
+
+        ``obs`` is a dict of per-tick scalars (the length-1 slice of the
+        model's data keys, e.g. ``{"x": x_0, "sign": sign_0}``). Derived
+        from the model's own :meth:`build` on a synthetic length-1
+        window, so gating/emission semantics cannot drift from the batch
+        path."""
+        data1 = {k: jnp.asarray(v)[None] for k, v in obs.items()}
+        log_pi, _, log_obs, _ = self.build(params, data1)
+        return log_pi, log_obs[0]
+
+    def tick_terms(self, params: Dict[str, jnp.ndarray], obs: Data):
+        """Per-tick streaming terms ``(log_A_step [K, K], log_obs_t [K])``
+        for the transition *into* the new tick and its emission.
+
+        Built from :meth:`build` on a synthetic 2-step window (the tick
+        duplicated), so time-varying gates — e.g. the Tayal stan-mode
+        sign gate, whose transition factor depends on the destination
+        tick's sign — come out of the same single source of truth as the
+        batch filter. Homogeneous models return their 2-D ``log_A``
+        unchanged; time-varying models return the one [K, K] slice
+        driving the (t-1)→t step. The throwaway first row of ``log_obs``
+        is discarded."""
+        data2 = {
+            k: jnp.stack([jnp.asarray(v), jnp.asarray(v)]) for k, v in obs.items()
+        }
+        _, log_A, log_obs, _ = self.build(params, data2)
+        lA = log_A if log_A.ndim == 2 else log_A[0]
+        return lA, log_obs[1]
+
     def init_unconstrained(self, key: jax.Array, data: Data) -> jnp.ndarray:
         """Default init: standard normal draw on the unconstrained space
         (Stan's default is uniform(-2,2); models override with k-means

@@ -358,6 +358,13 @@ class TreeHMM(BaseHMMModel):
                     "(prior_mu_scale); a flat prior is improper for "
                     "leaves with no assigned observations"
                 )
+            if self.prior_sigma_scale is None:
+                raise ValueError(
+                    "TreeHMM.gibbs_update needs a proper sigma prior "
+                    "(prior_sigma_scale); a flat prior leaves the sigma "
+                    "conditional improper for leaves with no assigned "
+                    "observations"
+                )
         rt = self.routes
         x = jnp.asarray(data["x"])
         mask = data.get("mask")
@@ -422,10 +429,12 @@ class TreeHMM(BaseHMMModel):
         rss = s2 - 2.0 * mu * s1 + n_k * mu**2  # Σ (x - mu_z)² per leaf
 
         def log_target(sig):
-            ll = -n_k * jnp.log(sig) - 0.5 * rss / sig**2
-            if self.prior_sigma_scale is not None:
-                ll = ll - 0.5 * (sig / self.prior_sigma_scale) ** 2
-            return ll
+            # the guard above makes prior_sigma_scale non-None here
+            return (
+                -n_k * jnp.log(sig)
+                - 0.5 * rss / sig**2
+                - 0.5 * (sig / self.prior_sigma_scale) ** 2
+            )
 
         lower = 1e-4  # Positive bijector support floor (specs())
         for step_key in jax.random.split(k_sig, 2):

@@ -1,0 +1,51 @@
+"""Streaming inference service: online forward-filter serving
+(`serve/online.py`), the posterior snapshot registry
+(`serve/registry.py`), the micro-batching tick scheduler
+(`serve/scheduler.py`), and serving metrics (`serve/metrics.py`).
+
+The online layer over the offline stack: `batch/fit.py` produces a
+posterior → `snapshot_from_fit` banks it as a servable artifact →
+`MicroBatchScheduler.attach` loads it (optionally warm-started from
+recorded history) → per-tick `submit`/`flush` advances every stream's
+filter in O(K²) with a compile-stable bucketed dispatch. See
+`docs/serving.md`.
+"""
+
+from hhmm_tpu.serve.metrics import ServeMetrics
+from hhmm_tpu.serve.online import (
+    RegimeDetector,
+    StreamState,
+    filter_scan,
+    posterior_predictive_mean,
+    predictive_state_logprobs,
+    stream_init,
+    stream_step,
+)
+from hhmm_tpu.serve.registry import (
+    SNAPSHOT_VERSION,
+    PosteriorSnapshot,
+    SnapshotRegistry,
+    build_model,
+    model_spec,
+    snapshot_from_fit,
+)
+from hhmm_tpu.serve.scheduler import MicroBatchScheduler, TickResponse
+
+__all__ = [
+    "ServeMetrics",
+    "RegimeDetector",
+    "StreamState",
+    "filter_scan",
+    "posterior_predictive_mean",
+    "predictive_state_logprobs",
+    "stream_init",
+    "stream_step",
+    "SNAPSHOT_VERSION",
+    "PosteriorSnapshot",
+    "SnapshotRegistry",
+    "build_model",
+    "model_spec",
+    "snapshot_from_fit",
+    "MicroBatchScheduler",
+    "TickResponse",
+]

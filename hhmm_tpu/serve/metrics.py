@@ -1,0 +1,130 @@
+"""Serving metrics: per-request latency histogram, throughput, and the
+XLA compile counter whose flatness is the no-recompile guarantee.
+
+The scheduler's contract (`serve/scheduler.py`) is that after warmup the
+vmapped tick kernel never recompiles — every flush lands in one of a
+small fixed set of padded bucket shapes. That claim is only auditable if
+compiles are *counted*: ``compile_count`` tracks the number of distinct
+traced signatures across the scheduler's jitted entry points (read from
+jit's own specialization cache), and ``tests/test_serve.py`` plus
+``bench.py --serve`` assert it stays flat over a sustained tick replay.
+
+The latency histogram uses fixed log-spaced bucket edges (constant
+memory, mergeable across processes); quantiles are read from the
+cumulative counts at the conservative upper edge of the containing
+bucket.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["ServeMetrics"]
+
+
+class ServeMetrics:
+    """Histogram + counters for one scheduler instance."""
+
+    def __init__(self, edges: Optional[Sequence[float]] = None):
+        # 1 µs .. 60 s: log-spaced, generous at both ends (CPU smoke
+        # tests sit in the ms range, TPU serving in the µs range)
+        self.edges = np.asarray(
+            edges if edges is not None else np.geomspace(1e-6, 60.0, 48)
+        )
+        self.counts = np.zeros(len(self.edges) + 1, dtype=np.int64)
+        self.requests = 0
+        self.ticks = 0
+        self.degraded_responses = 0
+        self.degraded_attaches = 0
+        self.superseded_responses = 0
+        self.flushes = 0
+        self.busy_seconds = 0.0
+        self.compile_count = 0
+
+    # ---- recording ----
+
+    def reset_throughput_window(self) -> None:
+        """Zero the latency histogram and throughput counters — 'start
+        measuring now'. Benches call this after warmup so the reported
+        percentiles and ticks/sec describe the steady state, not the
+        compile flushes; the compile counter and degradation counters
+        (cumulative health facts) are deliberately kept."""
+        self.counts[:] = 0
+        self.requests = 0
+        self.ticks = 0
+        self.flushes = 0
+        self.busy_seconds = 0.0
+
+    def observe_latency(self, latency_s: float, n: int = 1) -> None:
+        """Record ``n`` requests that completed with ``latency_s``."""
+        self.counts[int(np.searchsorted(self.edges, latency_s))] += n
+        self.requests += n
+
+    def observe_flush(self, n_ticks: int, seconds: float) -> None:
+        """Record one micro-batch flush: ``n_ticks`` state updates in
+        ``seconds`` of wall-clock."""
+        self.flushes += 1
+        self.ticks += n_ticks
+        self.busy_seconds += seconds
+
+    def note_degraded_response(self, n: int = 1) -> None:
+        self.degraded_responses += n
+
+    def note_degraded_attach(self) -> None:
+        self.degraded_attaches += 1
+
+    def note_superseded_response(self) -> None:
+        """A tick() dict collapse dropped an older same-series response
+        (latest-wins); the filter state still folded that tick."""
+        self.superseded_responses += 1
+
+    def set_compile_count(self, n: int) -> None:
+        self.compile_count = int(n)
+
+    # ---- reading ----
+
+    def quantile(self, q: float) -> float:
+        """Latency quantile (seconds), conservative (upper bucket edge).
+        A quantile landing in the unbounded overflow bucket (beyond the
+        last edge) returns ``inf`` — a pathological tail must read as
+        pathological, not as the largest edge."""
+        if self.requests == 0:
+            return float("nan")
+        cum = np.cumsum(self.counts)
+        idx = int(np.searchsorted(cum, q * self.requests, side="left"))
+        if idx >= len(self.edges):
+            return float("inf")
+        return float(self.edges[idx])
+
+    def ticks_per_sec(self) -> float:
+        return self.ticks / self.busy_seconds if self.busy_seconds > 0 else float("nan")
+
+    def summary(self) -> Dict[str, float]:
+        """JSON-ready metrics record (the `bench.py --serve` payload).
+        An empty measurement window reports ``None`` (JSON null) and an
+        overflow-bucket quantile the string ``"inf"`` — never a bare
+        NaN/Infinity token that breaks strict JSON consumers of the
+        bench records."""
+
+        def _q_ms(q: float):
+            v = self.quantile(q)
+            if np.isnan(v):
+                return None
+            return round(v * 1e3, 4) if np.isfinite(v) else "inf"
+
+        tps = self.ticks_per_sec()
+        return {
+            "requests": int(self.requests),
+            "ticks": int(self.ticks),
+            "flushes": int(self.flushes),
+            "ticks_per_sec": None if np.isnan(tps) else round(tps, 1),
+            "latency_p50_ms": _q_ms(0.50),
+            "latency_p90_ms": _q_ms(0.90),
+            "latency_p99_ms": _q_ms(0.99),
+            "degraded_responses": int(self.degraded_responses),
+            "degraded_attaches": int(self.degraded_attaches),
+            "superseded_responses": int(self.superseded_responses),
+            "compile_count": int(self.compile_count),
+        }

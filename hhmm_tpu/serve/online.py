@@ -1,0 +1,266 @@
+"""Online forward-filter core: O(K²) per-tick state updates, one-step-
+ahead posterior-predictive forecasting, and regime-flip detection.
+
+Everything upstream of this module is offline — fit a posterior with
+`batch/fit.py`, decode a full sequence, write a record. Serving inverts
+the access pattern: a tick arrives, the filtered state must advance in
+O(K²) with **constant memory** and no re-scan of history. The recurrence
+is the same one the batch kernels scan (`kernels/filtering.py`); it is
+factored there as :func:`~hhmm_tpu.kernels.filtering.filter_step` and
+wrapped here in a :class:`StreamState` carrying the *normalized*
+filtered log-probabilities plus the running log-likelihood — the scaled
+forward algorithm, which never under/overflows however long the stream
+runs (the unnormalized carry drifts linearly toward −inf and loses f32
+resolution after ~1e5 ticks; the normalized carry is O(1) forever).
+
+Numerics contract, pinned in ``tests/test_serve.py``:
+
+- folding T :func:`stream_step` updates one tick at a time reproduces
+  the full-sequence ``lax.scan`` filter :func:`filter_scan` **bitwise**
+  (same dtype, CPU) — the two paths trace identical per-step ops;
+- both agree with the batch :func:`~hhmm_tpu.kernels.forward_filter` up
+  to the normalization identity (``log_alpha_norm[t] = log_alpha[t] −
+  lse(log_alpha[t])``, ``loglik[t] = lse(log_alpha[t])``), exact in
+  infinite precision and tested to dtype tolerance;
+- every normalization routes through the guarded
+  ``safe_log_normalize`` / ``safe_logsumexp`` (`core/lmath.py`,
+  enforced by ``scripts/check_guards.py``): impossible evidence
+  degrades the state to an all-−inf floor and the running log-lik to
+  −inf — never NaN — which the scheduler's health mask then quarantines
+  (`serve/scheduler.py`), exactly the chain-health discipline of
+  `robust/guards.py`.
+
+Per-tick model terms (transition slice + emission row) come from
+``BaseHMMModel.tick_init`` / ``tick_terms`` (`models/base.py`), which
+derive them from each model's own ``build`` so streaming semantics
+cannot drift from the batch filter.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from hhmm_tpu.core.lmath import (
+    log_vecmat,
+    safe_log_normalize,
+    safe_logsumexp,
+)
+from hhmm_tpu.kernels.filtering import _split_A, filter_step
+
+__all__ = [
+    "StreamState",
+    "stream_init",
+    "stream_step",
+    "filter_scan",
+    "predictive_state_logprobs",
+    "posterior_predictive_mean",
+    "RegimeDetector",
+]
+
+
+class StreamState(NamedTuple):
+    """Constant-memory filter state of one stream (one series × draw).
+
+    ``log_alpha`` [K]: normalized filtered state log-probabilities
+    ``log p(z_t = k | x_{1:t})``; ``loglik``: scalar running marginal
+    log-likelihood ``log p(x_{1:t})``. Add them back together to recover
+    the batch kernel's unnormalized ``log_alpha`` (exact in infinite
+    precision)."""
+
+    log_alpha: jnp.ndarray
+    loglik: jnp.ndarray
+
+
+def stream_init(
+    log_pi: jnp.ndarray,
+    log_obs0: jnp.ndarray,
+    mask0: Optional[jnp.ndarray] = None,
+) -> StreamState:
+    """Filter state after absorbing the first observation.
+
+    Mirrors ``forward_filter``'s ``alpha0 = log_pi + log_obs[0]`` (a
+    masked t=0 falls back to the prior, same convention)."""
+    unnorm = log_pi + log_obs0
+    if mask0 is not None:
+        unnorm = jnp.where(mask0 > 0, unnorm, log_pi)
+    return StreamState(
+        safe_log_normalize(unnorm), safe_logsumexp(unnorm)
+    )
+
+
+def stream_step(
+    state: StreamState,
+    log_A: jnp.ndarray,
+    log_obs_t: jnp.ndarray,
+    mask_t: Optional[jnp.ndarray] = None,
+) -> StreamState:
+    """Advance the filter by one tick: O(K²), no re-scan.
+
+    ``log_A`` is the [K, K] transition slice driving the (t−1)→t step
+    (time-varying gates pass their per-step slice — see
+    ``BaseHMMModel.tick_terms``). The normalization increment
+    ``lse(α')`` is the per-tick conditional evidence
+    ``log p(x_t | x_{1:t-1})``, accumulated into ``loglik``. A masked
+    tick (``mask_t == 0``) leaves the state untouched — the no-op
+    convention :func:`filter_scan` uses for the padded tail of
+    warm-start histories (the scheduler's *lane* padding instead
+    repeats a live request and discards its outputs)."""
+    unnorm = filter_step(state.log_alpha, log_A, log_obs_t)
+    new = StreamState(
+        safe_log_normalize(unnorm),
+        state.loglik + safe_logsumexp(unnorm),
+    )
+    if mask_t is None:
+        return new
+    keep = mask_t > 0
+    return StreamState(
+        jnp.where(keep, new.log_alpha, state.log_alpha),
+        jnp.where(keep, new.loglik, state.loglik),
+    )
+
+
+def filter_scan(
+    log_pi: jnp.ndarray,
+    log_A: jnp.ndarray,
+    log_obs: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Full-sequence ``lax.scan`` of :func:`stream_step` — the batch
+    counterpart of the tick fold, used to warm-start a stream from
+    recorded history (`serve/scheduler.py::attach_many`) and as the
+    bitwise reference in ``tests/test_serve.py``.
+
+    Returns ``(log_alpha_norm [T, K], loglik [T])`` — the normalized
+    filter and running log-likelihood after every step. Accepts the
+    same homogeneous [K, K] or time-varying [T-1, K, K] ``log_A`` as
+    :func:`~hhmm_tpu.kernels.forward_filter`."""
+    T = log_obs.shape[0]
+    # same slice validation/convention as the batch kernel's scan
+    A_t = _split_A(log_A, T)
+
+    m = jnp.ones((T,), log_obs.dtype) if mask is None else mask
+    st0 = stream_init(log_pi, log_obs[0], None if mask is None else m[0])
+
+    def step(st, xs):
+        if A_t is None:
+            obs_t, m_t = xs
+            lA = log_A
+        else:
+            obs_t, m_t, lA = xs
+        st = stream_step(st, lA, obs_t, m_t if mask is not None else None)
+        return st, st
+
+    xs = (log_obs[1:], m[1:]) if A_t is None else (log_obs[1:], m[1:], A_t)
+    _, rest = lax.scan(step, st0, xs)
+    log_alpha = jnp.concatenate([st0.log_alpha[None], rest.log_alpha], axis=0)
+    loglik = jnp.concatenate([st0.loglik[None], rest.loglik], axis=0)
+    return log_alpha, loglik
+
+
+# ---- one-step-ahead forecasting ----
+
+
+def predictive_state_logprobs(
+    log_alpha: jnp.ndarray, log_A: jnp.ndarray
+) -> jnp.ndarray:
+    """One-step-ahead state distribution ``log p(z_{t+1} | x_{1:t}) [K]``
+    from the normalized filter: push the filter through the transition
+    (guarded normalization — a dead filter stays an all-−inf floor)."""
+    return safe_log_normalize(log_vecmat(log_alpha, log_A))
+
+
+def posterior_predictive_mean(
+    log_alpha: jnp.ndarray,
+    log_A: jnp.ndarray,
+    state_means: jnp.ndarray,
+    weights: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Posterior-predictive mean of the next observation, averaged over
+    thinned posterior draws — the Hassan-style next-close point forecast
+    served online (`apps/hassan/forecast.py::online_forecast_mean`).
+
+    ``log_alpha`` [D, K] per-draw normalized filters, ``log_A`` [D, K, K]
+    per-draw transitions, ``state_means`` [D, K] per-draw emission means
+    (``mu_k``). Per draw: ``E[x_{t+1} | x_{1:t}, θ_d] = Σ_j p(z_{t+1}=j)
+    μ_{d,j}``; the returned scalar is the (``weights``-)averaged draw
+    mean — the Monte Carlo posterior-predictive mean. Pass the
+    scheduler's per-draw health mask as ``weights`` so quarantined
+    draws (non-finite parameters, frozen stale filters) cannot poison
+    the forecast. An all-zero mask falls back to averaging whatever
+    per-draw forecasts are still FINITE — stricter than the tick
+    response's all-frozen-draws average, because a frozen filter can be
+    finite while its NaN parameters still poison the forecast side."""
+    pred = jax.vmap(
+        lambda a, lA: jnp.exp(predictive_state_logprobs(a, lA))
+    )(log_alpha, log_A)
+    per_draw = jnp.sum(pred * state_means, axis=-1)  # [D]
+    if weights is None:
+        return jnp.mean(per_draw)
+    w = (jnp.asarray(weights) > 0).astype(per_draw.dtype)
+    # masked draws must be *zeroed*, not just zero-weighted: a NaN
+    # parameter draw would survive `NaN * 0`. With every draw
+    # quarantined, fall back to whatever per-draw values are still
+    # finite (frozen filters can forecast even when the mask is down);
+    # only a series with NO finite draw value at all yields NaN — the
+    # genuinely-undefined case, which arrives alongside a
+    # ``degraded=True`` tick response consumers must gate on.
+    finite = jnp.isfinite(per_draw).astype(per_draw.dtype)
+    w = jnp.where(jnp.sum(w) > 0, w, finite)
+    vals = jnp.where(w > 0, per_draw, 0.0)
+    return jnp.sum(vals * w) / jnp.sum(w)
+
+
+# ---- regime-flip detection ----
+
+
+@dataclass
+class RegimeDetector:
+    """Filtered-argmax regime tracking with hysteresis (Tayal-style
+    online bull/bear flip detection).
+
+    A tick votes for regime ``g`` when ``g`` is the argmax of the
+    (draw-averaged) regime probabilities and leads the runner-up by at
+    least ``margin``. The committed regime flips only after ``hold``
+    *consecutive* decisive votes for the same challenger — a single-tick
+    blip (filter noise around a flat stretch) never flips. Host-side and
+    O(1) per tick; feed it ``apps/tayal/analytics.py::topstate_probs``
+    of the scheduler's per-tick response."""
+
+    hold: int = 3
+    margin: float = 0.0
+    regime: int = -1  # committed regime (-1 = not yet committed)
+    _cand: int = field(default=-1, repr=False)
+    _streak: int = field(default=0, repr=False)
+
+    def update(self, probs) -> Tuple[int, bool]:
+        """Absorb one tick of regime probabilities; returns
+        ``(committed_regime, flipped_this_tick)``."""
+        probs = np.asarray(probs, dtype=np.float64)
+        if probs.ndim != 1 or probs.shape[0] < 2:
+            raise ValueError(f"need a 1-D probs vector of >=2 regimes, got {probs.shape}")
+        order = np.argsort(probs)
+        top = int(order[-1])
+        decisive = bool(probs[top] - probs[int(order[-2])] >= self.margin)
+        if self.regime < 0:
+            # first commitment is not a flip
+            if decisive:
+                self.regime = top
+            return self.regime, False
+        if not decisive or top == self.regime:
+            self._cand, self._streak = -1, 0
+            return self.regime, False
+        if top == self._cand:
+            self._streak += 1
+        else:
+            self._cand, self._streak = top, 1
+        if self._streak >= self.hold:
+            self.regime, self._cand, self._streak = top, -1, 0
+            return self.regime, True
+        return self.regime, False

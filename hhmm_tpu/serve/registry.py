@@ -1,0 +1,275 @@
+"""Posterior snapshot registry: fitted posteriors as servable artifacts.
+
+The bridge between the offline fit path (`batch/fit.py`) and the
+streaming service: a **snapshot** is (thinned unconstrained draws +
+reconstructible model spec + health flag + format version), saved under
+a stable per-series name so the scheduler can attach, re-attach after a
+restart, and fall back to the *last healthy* snapshot when a new fit
+comes back quarantined (`serve/scheduler.py`).
+
+Storage uses `batch/cache.py`'s crash-safety helpers directly
+(``atomic_write_npz`` / ``load_npz_tolerant`` — one implementation of
+the pattern, not a copy):
+
+- **atomic writes** — the archive is written to a unique temp name in
+  the same directory, fsynced, and ``os.replace``d into place, so a
+  reader never observes a half-written snapshot;
+- **corrupt-tolerant reads** — a torn/garbage/unreadable file is a
+  *miss* (``load`` returns ``None``), quarantined aside as
+  ``<name>.npz.corrupt`` so a re-save works, instead of an exception
+  wedging the serving process;
+- **cache-style versioning** — ``SNAPSHOT_VERSION`` is stored in the
+  archive and checked on load; a snapshot written by an incompatible
+  format is a miss (left in place: it is not corrupt, just foreign),
+  the same bump-the-string discipline as `batch/fit.py`'s sampler
+  version keys.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+import json
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from hhmm_tpu.batch.cache import (
+    atomic_write_npz,
+    load_npz_tolerant,
+    quarantine_corrupt,
+)
+
+__all__ = [
+    "SNAPSHOT_VERSION",
+    "PosteriorSnapshot",
+    "SnapshotRegistry",
+    "model_spec",
+    "build_model",
+    "snapshot_from_fit",
+]
+
+SNAPSHOT_VERSION = "serve-snapshot-v1"
+
+
+# ---- model spec round-trip ----
+
+
+def model_spec(model) -> Dict[str, Any]:
+    """Reconstructible identity of a model instance: class name + the
+    constructor kwargs read back off the instance (every model in the
+    zoo stores its constructor args as same-named attributes).
+
+    Only JSON-safe values survive: scalars, strings, ``None``, numpy
+    arrays (tagged), and ``NIGPrior`` (tagged dataclass). A model whose
+    constructor needs anything richer (e.g. ``TreeHMM``'s tree
+    structure) is rejected with a clear error rather than silently
+    pickled — snapshots must stay loadable across refactors."""
+    cls = type(model)
+    kwargs: Dict[str, Any] = {}
+    for name, p in inspect.signature(cls.__init__).parameters.items():
+        if name == "self" or p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD):
+            continue
+        if not hasattr(model, name):
+            raise ValueError(
+                f"{cls.__name__}.{name} is a constructor arg but not an "
+                "instance attribute — cannot build a snapshot spec"
+            )
+        kwargs[name] = _encode_value(cls.__name__, name, getattr(model, name))
+    return {"class": cls.__name__, "kwargs": kwargs}
+
+
+def _encode_value(cls_name: str, name: str, v: Any) -> Any:
+    from hhmm_tpu.models import NIGPrior
+
+    if isinstance(v, NIGPrior):
+        return {"__nig__": dataclasses.asdict(v)}
+    if isinstance(v, np.ndarray) or hasattr(v, "tolist") and not isinstance(
+        v, (int, float, bool)
+    ):
+        arr = np.asarray(v)
+        return {"__array__": arr.tolist(), "dtype": str(arr.dtype)}
+    if isinstance(v, (int, float, str, bool)) or v is None:
+        return v
+    raise ValueError(
+        f"{cls_name}.{name}={type(v).__name__} is not snapshot-serializable "
+        "(supported: scalars, str, None, arrays, NIGPrior)"
+    )
+
+
+def _decode_value(v: Any) -> Any:
+    from hhmm_tpu.models import NIGPrior
+
+    if isinstance(v, dict) and "__nig__" in v:
+        return NIGPrior(**v["__nig__"])
+    if isinstance(v, dict) and "__array__" in v:
+        return np.asarray(v["__array__"], dtype=v["dtype"])
+    return v
+
+
+def build_model(spec: Dict[str, Any]):
+    """Instantiate the model a snapshot was fitted with."""
+    import hhmm_tpu.models as models
+
+    cls = getattr(models, spec["class"], None)
+    if cls is None:
+        raise ValueError(f"unknown model class in snapshot spec: {spec['class']!r}")
+    return cls(**{k: _decode_value(v) for k, v in spec["kwargs"].items()})
+
+
+# ---- snapshot ----
+
+
+@dataclass(frozen=True)
+class PosteriorSnapshot:
+    """A servable posterior: thinned draws + spec + health."""
+
+    spec: Dict[str, Any]
+    draws: np.ndarray  # [D, dim] thinned unconstrained draws
+    healthy: bool = True
+    version: str = SNAPSHOT_VERSION
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+    def model(self):
+        return build_model(self.spec)
+
+
+def snapshot_from_fit(
+    model,
+    samples,
+    chain_healthy=None,
+    n_draws: int = 64,
+    meta: Optional[Dict[str, Any]] = None,
+) -> PosteriorSnapshot:
+    """Thin one series' fit into a servable snapshot.
+
+    ``samples`` [chains, draws, dim] — one series' slice of
+    :func:`hhmm_tpu.batch.fit_batched`'s output; ``chain_healthy``
+    [chains] — the same slice of ``stats["chain_healthy"]`` (the
+    `robust/` quarantine mask). Quarantined chains' draws are excluded
+    from the thinning; a fit whose *every* chain is quarantined yields
+    ``healthy=False`` (the scheduler then refuses to let it replace a
+    healthy serving state). Thinning is the evenly-spaced ``linspace``
+    selection the walk-forward decode uses, repeat-padded so every
+    snapshot carries exactly ``n_draws`` rows (fixed draw count = one
+    compile per scheduler bucket)."""
+    samples = np.asarray(samples)
+    if samples.ndim != 3:
+        raise ValueError(f"samples must be [chains, draws, dim], got {samples.shape}")
+    if chain_healthy is None:
+        keep = np.ones(samples.shape[0], dtype=bool)
+    else:
+        keep = np.asarray(chain_healthy).astype(bool).reshape(samples.shape[0])
+    healthy = bool(keep.any())
+    flat = (samples[keep] if healthy else samples).reshape(-1, samples.shape[-1])
+    if flat.shape[0] == 0:
+        raise ValueError(
+            f"fit has zero draws (samples shape {samples.shape}) — "
+            "nothing to thin into a snapshot"
+        )
+    sel = np.linspace(0, len(flat) - 1, min(n_draws, len(flat))).astype(int)
+    draws = flat[sel]
+    if len(draws) < n_draws:  # repeat-pad tiny posteriors to the fixed D
+        draws = draws[np.arange(n_draws) % len(draws)]
+    return PosteriorSnapshot(
+        spec=model_spec(model),
+        draws=np.ascontiguousarray(draws),
+        healthy=healthy,
+        meta=dict(meta or {}),
+    )
+
+
+# ---- registry ----
+
+
+class SnapshotRegistry:
+    """Named snapshot store (one ``.npz`` per name) with atomic writes
+    and corrupt-tolerant reads — see the module docstring."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, name: str) -> str:
+        if not name or any(c in name for c in "/\\\0") or name.startswith("."):
+            raise ValueError(f"invalid snapshot name: {name!r}")
+        return os.path.join(self.root, f"{name}.npz")
+
+    def names(self) -> List[str]:
+        # temps are "<name>.npz.tmp.<pid>.npz" (a crash can strand one)
+        # and quarantined files "<name>.npz.corrupt": neither is a
+        # servable snapshot
+        return sorted(
+            f[: -len(".npz")]
+            for f in os.listdir(self.root)
+            if f.endswith(".npz") and ".npz.tmp." not in f
+        )
+
+    def save(self, name: str, snap: PosteriorSnapshot) -> str:
+        """Write ``snap`` under ``name`` (atomic).
+
+        A quarantined snapshot (``healthy=False``) never *displaces* a
+        healthy one: the registry's serving contract is that
+        ``load(name)`` yields the last healthy posterior for the
+        scheduler's degraded-fit fallback, so overwriting it with an
+        unservable artifact would destroy exactly the state the
+        fallback needs. Such a save is refused (logged, existing path
+        returned); with no healthy predecessor on disk it proceeds —
+        a degraded posterior beats none."""
+        path = self._path(name)
+        if not snap.healthy and os.path.exists(path):
+            prev = self.load(name)
+            if prev is not None and prev.healthy:
+                print(
+                    f"# SnapshotRegistry: refusing to replace healthy "
+                    f"snapshot {name!r} with a quarantined fit "
+                    "(healthy=False); keeping the servable artifact",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                return path
+        atomic_write_npz(
+            path,
+            {
+                "version": np.asarray(snap.version),
+                "spec_json": np.asarray(json.dumps(snap.spec, sort_keys=True)),
+                "draws": np.asarray(snap.draws),
+                "healthy": np.asarray(bool(snap.healthy)),
+                "meta_json": np.asarray(
+                    json.dumps(snap.meta, sort_keys=True, default=str)
+                ),
+            },
+        )
+        return path
+
+    def load(self, name: str) -> Optional[PosteriorSnapshot]:
+        path = self._path(name)
+        raw = load_npz_tolerant(path, "SnapshotRegistry")
+        if raw is None:
+            return None
+        try:
+            version = str(raw["version"])
+            spec = json.loads(str(raw["spec_json"]))
+            draws = np.asarray(raw["draws"])
+            healthy = bool(raw["healthy"])
+            meta = json.loads(str(raw["meta_json"]))
+        except Exception as e:
+            # archive readable but fields missing/garbled (a foreign or
+            # damaged payload): same quarantine-as-miss discipline
+            quarantine_corrupt(path, "SnapshotRegistry", e)
+            return None
+        if version != SNAPSHOT_VERSION:
+            # foreign format: a miss, but NOT corrupt — leave it alone
+            print(
+                f"# SnapshotRegistry: snapshot {name!r} has version "
+                f"{version!r} (want {SNAPSHOT_VERSION!r}); treating as a miss",
+                file=sys.stderr,
+                flush=True,
+            )
+            return None
+        return PosteriorSnapshot(
+            spec=spec, draws=draws, healthy=healthy, version=version, meta=meta
+        )

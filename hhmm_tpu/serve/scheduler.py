@@ -1,0 +1,608 @@
+"""Micro-batching tick scheduler: queue per-series updates, dispatch
+them in a small fixed set of padded batch shapes, never recompile after
+warmup.
+
+The serving workload is thousands of independent series each advancing
+one tick at a time. Dispatching each tick alone wastes the chip (a K=4
+filter step is ~100 flops) and — worse — a naive ``vmap`` over "whatever
+arrived this flush" recompiles on every distinct batch size. This
+scheduler applies the same discipline as the batch fit path
+(`batch/fit.py` chunking + `batch/pad.py` padding): pending ticks are
+grouped into the smallest **bucket** shape that fits (default 8/32/128,
+oversize flushes split into max-bucket chunks), lanes are padded by
+repeating the last request, and one jitted update kernel per bucket
+shape serves every flush thereafter. After warmup the XLA compile count
+is *flat* — audited by the ``compile_count`` metric
+(`serve/metrics.py`) and asserted over a 256-series sustained replay in
+``tests/test_serve.py`` and ``bench.py --serve``.
+
+Robustness (the `robust/` discipline, applied to serving):
+
+- the tick kernel guards every update with the chain-health pattern
+  (`robust/guards.py`): a draw whose filter goes non-finite (impossible
+  evidence under that draw's parameters) is frozen at its last healthy
+  state — permanently, ``ok' = ok & finite(new)`` — and excluded from
+  the response average; a series with no healthy draws left keeps
+  serving its last healthy filtered state with ``degraded=True``
+  instead of erroring;
+- a **quarantined fit** (snapshot with ``healthy=False`` — every chain
+  tripped the `robust/` quarantine, `serve/registry.py`) never replaces
+  a healthy serving state: ``attach`` falls back to the currently
+  attached posterior, else the registry's last healthy snapshot, and
+  only serves the degraded draws (flagged) when no healthy fallback
+  exists.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from hhmm_tpu.batch.pad import pad_ragged
+from hhmm_tpu.core.lmath import safe_log_normalize
+from hhmm_tpu.robust.guards import finite_mask, guard_update
+from hhmm_tpu.serve.metrics import ServeMetrics
+from hhmm_tpu.serve.online import StreamState, filter_scan, stream_init, stream_step
+from hhmm_tpu.serve.registry import (
+    PosteriorSnapshot,
+    SnapshotRegistry,
+    model_spec,
+)
+
+__all__ = ["TickResponse", "MicroBatchScheduler"]
+
+
+@dataclass(frozen=True)
+class TickResponse:
+    """One served tick: draw-averaged filtered state + health."""
+
+    series_id: str
+    probs: np.ndarray  # [K] posterior-mean filtered state probabilities
+    loglik: float  # running log-likelihood, mean over healthy draws
+    healthy_draws: int
+    degraded: bool
+    latency_s: float
+
+
+class MicroBatchScheduler:
+    """See module docstring. One instance serves one model family; all
+    attached series share the snapshot draw count (fixed ``D`` = one
+    compile per bucket)."""
+
+    def __init__(
+        self,
+        model,
+        buckets: Sequence[int] = (8, 32, 128),
+        registry: Optional[SnapshotRegistry] = None,
+        metrics: Optional[ServeMetrics] = None,
+        history_pad: int = 64,
+    ):
+        if not buckets or any(b <= 0 for b in buckets):
+            raise ValueError(f"buckets must be positive, got {buckets}")
+        self.model = model
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
+        self.registry = registry
+        self.metrics = metrics if metrics is not None else ServeMetrics()
+        self.history_pad = int(history_pad)
+        self.n_draws: Optional[int] = None
+        self._series: Dict[str, Dict[str, Any]] = {}
+        self._pending: List[Tuple[str, Dict[str, Any], float]] = []
+        self._undelivered: List[TickResponse] = []
+        self._draws_cache: Dict[Tuple[str, ...], jnp.ndarray] = {}
+        self._obs_dtypes: Dict[str, Any] = {}
+        self._init_j = jax.jit(self._init_impl)
+        self._update_j = jax.jit(self._update_impl)
+        self._replay_j = jax.jit(self._replay_impl)
+        self._unpack_j = jax.jit(jax.vmap(lambda t: model.unpack(t)[0]))
+        try:
+            # serving-model identity, checked against every attached
+            # snapshot's stored spec (None for models whose constructor
+            # args aren't spec-serializable — dim check still applies)
+            self._model_spec = model_spec(model)
+        except ValueError:
+            self._model_spec = None
+        self._signatures: set = set()
+
+    # ---- jitted kernels (one specialization per bucket shape) ----
+
+    def _unpack_params(self, theta):
+        return self.model.unpack(theta)[0]
+
+    def _guarded(self, st: StreamState, prev: StreamState, prev_ok):
+        """Per-draw chain-health guard + draw-averaged response stats.
+        THE ``robust.guards.guard_update`` — the same transition guard
+        every sampler routes through: a draw keeps the update only
+        while it was healthy AND the update is finite; otherwise it
+        freezes at its last healthy state, permanently."""
+        kept, okd = guard_update(prev_ok, st, prev, batch_ndim=1)  # [D]
+        dt = kept.log_alpha.dtype
+        # a fully-dead series averages its frozen (last-healthy) states
+        w = jnp.where(okd.any(), okd, jnp.ones_like(okd)).astype(dt)
+        denom = w.sum()
+        probs = (jnp.exp(kept.log_alpha) * w[:, None]).sum(0) / denom
+        mean_ll = (kept.loglik * w).sum() / denom
+        return kept.log_alpha, kept.loglik, okd, probs, mean_ll
+
+    def _init_impl(self, draws, obs):
+        """First tick of a batch of fresh series: α₀ from the model's
+        own (π, obs₀). draws [N, D, dim]; obs dict of [N] scalars."""
+
+        def one_series(dr, o):
+            def one_draw(theta):
+                params = self._unpack_params(theta)
+                log_pi, log_obs0 = self.model.tick_init(params, o)
+                return stream_init(log_pi, log_obs0), log_pi
+
+            st, log_pi = jax.vmap(one_draw)(dr)
+            # fallback state for draws dead on arrival: the prior filter
+            prior = StreamState(
+                safe_log_normalize(log_pi), jnp.zeros_like(st.loglik)
+            )
+            ok0 = jnp.ones(st.loglik.shape, bool)
+            return self._guarded(st, prior, ok0)
+
+        return jax.vmap(one_series)(draws, obs)
+
+    def _update_impl(self, draws, alpha, ll, ok, obs):
+        """One tick for a batch of live series. draws [N, D, dim],
+        alpha [N, D, K], ll [N, D], ok [N, D] bool, obs dict of [N]."""
+
+        def one_series(dr, a, l, okd, o):
+            prev = StreamState(a, l)
+
+            def one_draw(theta, ad, ld):
+                params = self._unpack_params(theta)
+                log_A, log_obs_t = self.model.tick_terms(params, o)
+                return stream_step(StreamState(ad, ld), log_A, log_obs_t)
+
+            st = jax.vmap(one_draw)(dr, a, l)
+            return self._guarded(st, prev, okd)
+
+        return jax.vmap(one_series)(draws, alpha, ll, ok, obs)
+
+    def _replay_impl(self, draws, data_b):
+        """Warm-start a batch of series from padded history (one
+        full-sequence :func:`filter_scan` per draw). draws [N, D, dim];
+        data_b dict of [N, T] arrays + ``mask`` [N, T]."""
+
+        def one_series(dr, data_s):
+            def one_draw(theta):
+                params = self._unpack_params(theta)
+                log_pi, log_A, log_obs, mask = self.model.build(params, data_s)
+                la, lls = filter_scan(log_pi, log_A, log_obs, mask)
+                return StreamState(la[-1], lls[-1])
+
+            st = jax.vmap(one_draw)(dr)
+            okd = finite_mask(st, batch_ndim=1)
+            return st.log_alpha, st.loglik, okd
+
+        return jax.vmap(one_series)(draws, data_b)
+
+    # ---- attach ----
+
+    def _resolve_snapshot(
+        self, series_id: str, snap: PosteriorSnapshot
+    ) -> Tuple[PosteriorSnapshot, bool, bool]:
+        """Quarantine-mask fallback. Returns ``(snapshot_to_serve,
+        degraded, keep_current_state)``."""
+        if snap.healthy:
+            return snap, False, False
+        cur = self._series.get(series_id)
+        if cur is not None and not cur["degraded_attach"]:
+            # keep serving the attached healthy posterior
+            return snap, True, True
+        if self.registry is not None:
+            prev = self.registry.load(series_id)
+            if prev is not None and prev.healthy:
+                # the fallback draws are healthy: serving is NOT degraded
+                # (only the rejected fit is, counted in the metrics)
+                return prev, False, False
+        # no healthy fallback anywhere: serve the degraded draws, flagged
+        return snap, True, False
+
+    def attach(self, series_id: str, snapshot: PosteriorSnapshot, history=None):
+        """Attach (or re-attach) one series. ``history``: optional dict
+        of per-tick arrays [T_h] to warm-start the filter from (replayed
+        through :func:`filter_scan`; ragged lengths across an
+        ``attach_many`` batch are padded with `batch/pad.py`)."""
+        self.attach_many([(series_id, snapshot, history)])
+
+    def attach_many(self, items) -> None:
+        """Attach a batch of series in one padded replay dispatch.
+        ``items``: iterable of ``(series_id, snapshot, history_or_None)``.
+
+        The whole batch is resolved and validated BEFORE any scheduler
+        state mutates (the flush() validate-before-pop discipline): a
+        bad item fails the attach with the draw-count lock, caches, and
+        series table untouched, so a corrected retry is not poisoned by
+        the failed attempt."""
+        # ---- pass 1: resolve + validate, no state mutation ----
+        n_draws = self.n_draws
+        resolved, keeps = [], []
+        n_degraded_fits = 0
+        for series_id, snap, hist in items:
+            if snap is None:  # a registry miss handed straight through
+                raise ValueError(
+                    f"no snapshot for series {series_id!r} (registry miss / "
+                    "corrupt entry?) — nothing to attach"
+                )
+            use, degraded, keep = self._resolve_snapshot(series_id, snap)
+            n_degraded_fits += int(not snap.healthy)
+            if keep:
+                keeps.append(series_id)
+                continue
+            if self._model_spec is not None and use.spec != self._model_spec:
+                # a stale snapshot fitted under a different model
+                # class/config must fail loudly at attach, not be
+                # silently unpacked with the wrong bijectors
+                raise ValueError(
+                    f"snapshot for {series_id!r} was fitted with "
+                    f"{use.spec}, but this scheduler serves "
+                    f"{self._model_spec}"
+                )
+            draws = np.asarray(use.draws)
+            if draws.ndim != 2:
+                raise ValueError(f"snapshot draws must be [D, dim], got {draws.shape}")
+            if draws.shape[1] != self.model.n_free:
+                raise ValueError(
+                    f"snapshot for {series_id!r} has dim {draws.shape[1]}; "
+                    f"the serving model has n_free={self.model.n_free}"
+                )
+            if n_draws is None:
+                n_draws = draws.shape[0]
+            elif draws.shape[0] != n_draws:
+                raise ValueError(
+                    f"snapshot for {series_id!r} carries {draws.shape[0]} draws; "
+                    f"this scheduler serves {n_draws} (fixed for compile "
+                    "stability — thin with snapshot_from_fit(n_draws=...))"
+                )
+            resolved.append((series_id, jnp.asarray(draws), degraded, hist))
+        self._validate_histories(
+            [(s, h) for s, _, _, h in resolved if h is not None]
+        )
+
+        # ---- pass 2: compute (still no scheduler-state mutation — a
+        # replay failure, e.g. a history missing a model data key that
+        # only surfaces inside build(), must leave everything intact) --
+        fresh = [(s, d, g) for s, d, g, h in resolved if h is None]
+        warm = [(s, d, g, h) for s, d, g, h in resolved if h is not None]
+        new_recs: Dict[str, Dict[str, Any]] = {}
+        for series_id, draws, degraded in fresh:
+            new_recs[series_id] = {
+                "draws": draws,
+                "alpha": None,  # initialized by the first tick
+                "ll": None,
+                "ok": None,
+                "degraded_attach": degraded,
+                "rejected_fits": 0,
+            }
+        if warm:
+            new_recs.update(self._warm_records(warm))
+        if resolved:
+            # pre-warm the shared [D, dim] unpack used by state(): its
+            # one compile must land in the attach window, not surprise
+            # the first post-warmup forecast (the compile-count metric
+            # audits it alongside the dispatch kernels)
+            jax.block_until_ready(self._unpack_j(resolved[0][1]))
+            self._note_signature(
+                "unpack",
+                tuple(resolved[0][1].shape),
+                str(resolved[0][1].dtype),
+            )
+
+        # ---- pass 3: commit ----
+        self.n_draws = n_draws
+        for _ in range(n_degraded_fits):  # counted only on a committed attach
+            self.metrics.note_degraded_attach()
+        if resolved:  # keeps-only batches change no draw bank identity
+            self._draws_cache.clear()
+        for series_id in keeps:
+            rec = self._series[series_id]
+            rec["rejected_fits"] = rec.get("rejected_fits", 0) + 1
+        self._series.update(new_recs)
+        if resolved:
+            self._refresh_compile_count()
+
+    @staticmethod
+    def _validate_histories(hists) -> None:
+        """Attach-batch history validation (runs in the no-mutation
+        pass): shared key set, and per-series consistent lengths across
+        keys — a shorter key would silently misalign against the padded
+        mask instead of erroring."""
+        if not hists:
+            return
+        keys = sorted(hists[0][1].keys())
+        for series_id, h in hists:
+            if sorted(h.keys()) != keys:
+                raise ValueError("histories in one attach batch must share keys")
+            lengths = {k: np.asarray(h[k]).shape[0] for k in keys}
+            if len(set(lengths.values())) != 1:
+                raise ValueError(
+                    f"history for {series_id!r} has inconsistent lengths "
+                    f"across keys: {lengths}"
+                )
+
+    def _warm_records(self, warm) -> Dict[str, Dict[str, Any]]:
+        """Run the padded history replays and return the series records
+        to commit — the caller commits them only after EVERY chunk (and
+        the rest of the attach batch) succeeded."""
+        out: Dict[str, Dict[str, Any]] = {}
+        keys = sorted(warm[0][3].keys())
+        max_t = max(np.asarray(h[keys[0]]).shape[0] for _, _, _, h in warm)
+        T_pad = -(-max_t // self.history_pad) * self.history_pad
+        for c0 in range(0, len(warm), self.buckets[-1]):
+            chunk = warm[c0 : c0 + self.buckets[-1]]
+            lanes = self._pad_lanes(chunk)
+            bn = len(lanes)
+            data_b: Dict[str, jnp.ndarray] = {}
+            mask = None
+            for k in keys:
+                padded, m = pad_ragged(
+                    [np.asarray(h[k]) for _, _, _, h in lanes], length=T_pad
+                )
+                data_b[k] = jnp.asarray(padded)
+                mask = m
+            data_b["mask"] = jnp.asarray(mask)
+            draws_b = jnp.stack([d for _, d, _, _ in lanes])
+            alpha, ll, okd = jax.block_until_ready(
+                self._replay_j(draws_b, data_b)
+            )
+            self._note_signature(
+                "replay",
+                bn,
+                (T_pad,) + tuple(str(data_b[k].dtype) for k in keys),
+            )
+            for i, (series_id, draws, degraded, _) in enumerate(chunk):
+                out[series_id] = {
+                    "draws": draws,
+                    "alpha": alpha[i],
+                    "ll": ll[i],
+                    "ok": okd[i],
+                    "degraded_attach": degraded,
+                    "rejected_fits": 0,
+                }
+        return out
+
+    # ---- ticking ----
+
+    def submit(self, series_id: str, obs: Dict[str, Any]) -> None:
+        """Queue one tick for ``series_id``; runs at the next flush.
+        ``obs``: dict of per-tick scalars (the model's data keys, e.g.
+        ``{"x": 4, "sign": 1}`` for Tayal)."""
+        if series_id not in self._series:
+            raise KeyError(f"series {series_id!r} is not attached")
+        self._pending.append((series_id, obs, time.perf_counter()))
+
+    def tick(self, obs_by_series: Dict[str, Dict[str, Any]]) -> Dict[str, TickResponse]:
+        """Convenience: submit every (series, obs) pair and flush,
+        returning the LATEST response per series (latest-wins). When
+        the flush also delivers older responses for the same series
+        (queued ticks, or responses carried over a partial failure),
+        those are superseded — dropped, counted in
+        ``metrics.superseded_responses`` — because the dict shape can
+        only carry one response per series (re-parking them would
+        circulate forever). The underlying filter state folded every
+        tick regardless; consumers that need EVERY per-tick response
+        (e.g. a regime detector) should drive ``submit()``/``flush()``
+        directly, where nothing is collapsed."""
+        for series_id, obs in obs_by_series.items():
+            self.submit(series_id, obs)
+        out: Dict[str, TickResponse] = {}
+        for r in self.flush():  # older (carried / earlier-wave) first
+            if r.series_id in out:
+                self.metrics.note_superseded_response()
+            out[r.series_id] = r
+        return out
+
+    def flush(self) -> List[TickResponse]:
+        """Dispatch all pending ticks in bucketed micro-batches.
+
+        Multiple queued ticks for the same series dispatch as sequential
+        waves (submission order preserved): each must fold into the
+        filter from the state its predecessor produced, never from a
+        shared stale prior.
+
+        Partial-failure contract: if a dispatch raises mid-flush (a
+        malformed observation value), already-dispatched waves have
+        committed their state atomically — their responses are KEPT and
+        delivered at the head of the next successful ``flush()`` (a
+        committed tick must never lose its response: re-submitting it
+        would double-fold the observation) — while every un-dispatched
+        tick is re-queued, retryable."""
+        if not self._pending:
+            return []
+        # validate BEFORE popping or dispatching anything: a malformed
+        # tick must fail the flush cleanly (queue intact, retryable),
+        # not abort half-way with some series already advanced
+        obs_keys = sorted(self._pending[0][1].keys())
+        for series_id, obs, _ in self._pending:
+            if sorted(obs.keys()) != obs_keys:
+                raise ValueError(
+                    f"tick observation for {series_id!r} has keys "
+                    f"{sorted(obs.keys())}; this flush expects {obs_keys} "
+                    "(queue left intact)"
+                )
+        pending, self._pending = self._pending, []
+        t0 = time.perf_counter()
+        waves: List[list] = []
+        wave, seen = [], set()
+        for p in pending:
+            if p[0] in seen:
+                waves.append(wave)
+                wave, seen = [], set()
+            wave.append(p)
+            seen.add(p[0])
+        waves.append(wave)
+        responses: List[TickResponse] = []
+        dispatched: set = set()
+        try:
+            for wave in waves:
+                # fresh/live split per wave: a first-ever tick in wave k
+                # makes its series live for wave k+1
+                fresh = [p for p in wave if self._series[p[0]]["alpha"] is None]
+                live = [p for p in wave if self._series[p[0]]["alpha"] is not None]
+                for group, kernel in ((fresh, "init"), (live, "update")):
+                    for c0 in range(0, len(group), self.buckets[-1]):
+                        chunk = group[c0 : c0 + self.buckets[-1]]
+                        responses.extend(self._dispatch(chunk, kernel))
+                        dispatched.update(id(p) for p in chunk)
+        except BaseException:
+            # a malformed observation value (wrong shape/dtype) can only
+            # surface inside a dispatch; that group commits no state, so
+            # re-queue every un-dispatched tick (retryable) before
+            # propagating. Already-dispatched waves advanced atomically:
+            # their metrics are recorded and their responses carried to
+            # the next flush (see the partial-failure contract above).
+            done = time.perf_counter()
+            for p in pending:
+                if id(p) in dispatched:
+                    self.metrics.observe_latency(done - p[2])
+            if dispatched:
+                self.metrics.observe_flush(len(dispatched), done - t0)
+            self._undelivered.extend(responses)
+            self._pending = [
+                p for p in pending if id(p) not in dispatched
+            ] + self._pending
+            raise
+        done = time.perf_counter()
+        for _, _, t_submit in pending:
+            self.metrics.observe_latency(done - t_submit)
+        self.metrics.observe_flush(len(pending), done - t0)
+        self._refresh_compile_count()
+        carried, self._undelivered = self._undelivered, []
+        return carried + responses
+
+    def _dispatch(self, group, kernel: str) -> List[TickResponse]:
+        if not group:
+            return []
+        lanes = self._pad_lanes(group)
+        bn = len(lanes)
+        obs_keys = sorted(group[0][1].keys())  # validated by flush()
+        obs_b = {}
+        dtype_locks: Dict[str, Any] = {}
+        for k in obs_keys:
+            arr = jnp.asarray(np.stack([np.asarray(obs[k]) for _, obs, _ in lanes]))
+            # canonical per-key dtype: a producer oscillating between
+            # numpy and Python scalars (same value domain) must not
+            # change the jit signature and retrace the warm kernel.
+            # The lock PROMOTES on widening drift (int ticks followed by
+            # float ticks re-lock to the promoted type — one honest,
+            # counter-visible recompile) — it never narrows: casting
+            # 1.75 to a first-seen int dtype would silently corrupt
+            # every subsequent filter update. Locks commit only after
+            # the dispatch succeeds: a malformed flush must not leave a
+            # polluted lock forcing spurious retraces forever after.
+            locked = self._obs_dtypes.get(k)
+            if locked is None:
+                dtype_locks[k] = arr.dtype
+            else:
+                promoted = jnp.promote_types(locked, arr.dtype)
+                if promoted != locked:
+                    dtype_locks[k] = promoted
+                arr = arr.astype(dtype_locks.get(k, locked))
+            obs_b[k] = arr
+        # the draw bank is immutable between attaches: cache the stacked
+        # [bucket, D, dim] array per lane membership so the per-tick hot
+        # path ships only the arrays that actually change (alpha/ll/ok)
+        lane_key = tuple(s for s, _, _ in lanes)
+        draws_b = self._draws_cache.get(lane_key)
+        if draws_b is None:
+            if len(self._draws_cache) >= 64:  # bound churny memberships
+                self._draws_cache.clear()
+            draws_b = jnp.stack([self._series[s]["draws"] for s in lane_key])
+            self._draws_cache[lane_key] = draws_b
+        if kernel == "init":
+            out = self._init_j(draws_b, obs_b)
+        else:
+            alpha_b = jnp.stack([self._series[s]["alpha"] for s, _, _ in lanes])
+            ll_b = jnp.stack([self._series[s]["ll"] for s, _, _ in lanes])
+            ok_b = jnp.stack([self._series[s]["ok"] for s, _, _ in lanes])
+            out = self._update_j(draws_b, alpha_b, ll_b, ok_b, obs_b)
+        alpha, ll, okd, probs, mean_ll = jax.block_until_ready(out)
+        self._obs_dtypes.update(dtype_locks)  # dispatch succeeded
+        # dtype-aware signature: the fallback compile audit (no
+        # _cache_size on the jitted fn) must see dtype-promotion
+        # retraces, not just bucket shapes
+        self._note_signature(
+            kernel, bn, tuple(str(obs_b[k].dtype) for k in obs_keys)
+        )
+        done = time.perf_counter()
+        responses = []
+        for i, (series_id, _, t_submit) in enumerate(group):
+            rec = self._series[series_id]
+            rec["alpha"], rec["ll"], rec["ok"] = alpha[i], ll[i], okd[i]
+            n_ok = int(np.asarray(okd[i]).sum())
+            degraded = bool(rec["degraded_attach"]) or n_ok == 0
+            if degraded:
+                self.metrics.note_degraded_response()
+            responses.append(
+                TickResponse(
+                    series_id=series_id,
+                    probs=np.asarray(probs[i]),
+                    loglik=float(mean_ll[i]),
+                    healthy_draws=n_ok,
+                    degraded=degraded,
+                    latency_s=done - t_submit,
+                )
+            )
+        return responses
+
+    # ---- introspection ----
+
+    def state(self, series_id: str):
+        """Serving state of one series for app-level consumers
+        (`apps/hassan/forecast.py`, `apps/tayal/analytics.py`):
+        ``(log_alpha [D, K], loglik [D], ok [D], params)`` — the
+        per-draw filter, the health mask (consumers must exclude or
+        down-weight quarantined draws, exactly as the tick response
+        average does), and the per-draw constrained parameter dict
+        (unpacked through one jitted vmap on first access and cached on
+        the series record: the draw bank is immutable between attaches,
+        and this accessor sits on the per-tick forecast hot path)."""
+        rec = self._series[series_id]
+        if rec["alpha"] is None:
+            raise ValueError(f"series {series_id!r} has not received a tick yet")
+        if rec.get("params") is None:
+            rec["params"] = self._unpack_j(rec["draws"])
+        return rec["alpha"], rec["ll"], rec["ok"], rec["params"]
+
+    def series_ids(self) -> List[str]:
+        return sorted(self._series)
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def _pad_lanes(self, chunk: list) -> list:
+        """Pad a (≤ max bucket) chunk to its bucket shape by repeating
+        the last entry — the single lane-padding policy for both the
+        replay and tick dispatches (padded lanes' outputs are
+        discarded). Compile stability depends on every dispatch landing
+        on exactly these shapes."""
+        bn = self._bucket_for(len(chunk))
+        return [chunk[min(i, len(chunk) - 1)] for i in range(bn)]
+
+    def _note_signature(self, kernel: str, bucket: int, extra) -> None:
+        self._signatures.add((kernel, bucket, extra))
+
+    def _refresh_compile_count(self) -> None:
+        """Compile accounting: jit's own specialization-cache sizes (one
+        entry per distinct traced signature) when available, else the
+        host-side signature set."""
+        n = 0
+        for f in (self._init_j, self._update_j, self._replay_j, self._unpack_j):
+            cache_size = getattr(f, "_cache_size", None)
+            if callable(cache_size):
+                n += cache_size()
+            else:
+                n = len(self._signatures)
+                break
+        self.metrics.set_compile_count(n)

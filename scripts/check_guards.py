@@ -1,22 +1,31 @@
 #!/usr/bin/env python
 """Static robustness pass (tier-1, no JAX import — pure ``ast``).
 
-Asserts the two invariants the fault-tolerance subsystem
-(`docs/robustness.md`) depends on:
+Asserts the invariants the fault-tolerance subsystem
+(`docs/robustness.md`) and the streaming service (`docs/serving.md`)
+depend on:
 
-1. **No bare ``except:``** anywhere under ``hhmm_tpu/`` — a bare handler
-   swallows ``KeyboardInterrupt``/``SystemExit`` and, worse, masks the
-   device faults the retry layer (`robust/retry.py`) must *see* to
-   classify (UNAVAILABLE vs deterministic). Catch concrete types.
+1. **No bare ``except:``** anywhere under ``hhmm_tpu/`` (the serving
+   layer included) — a bare handler swallows
+   ``KeyboardInterrupt``/``SystemExit`` and, worse, masks the device
+   faults the retry layer (`robust/retry.py`) must *see* to classify
+   (UNAVAILABLE vs deterministic). Catch concrete types.
 2. **Every public sampler entry point routes through the chain-health
    guard**: each sampler module (`infer/run.py`, `infer/chees.py`,
    `infer/gibbs.py`) must import from ``hhmm_tpu.robust.guards`` and
    actually *call* a guard function — a sampler added (or refactored)
    without the guard would silently reintroduce NaN poisoning of vmapped
    batches.
+3. **The online filter step routes through the guarded normalization**:
+   ``serve/online.py`` must import ``safe_log_normalize`` from
+   ``hhmm_tpu.core.lmath`` and call it — a streaming update normalized
+   with a bare ``log_normalize`` would turn impossible evidence into
+   NaN state instead of the −inf floor the scheduler's quarantine mask
+   detects (`serve/scheduler.py`).
 
 Exit 0 when clean, 1 with one line per violation. Run by
-``tests/test_robust.py`` so the pass is enforced in tier-1.
+``tests/test_robust.py`` (and re-asserted by ``tests/test_serve.py``)
+so the pass is enforced in tier-1.
 """
 
 from __future__ import annotations
@@ -35,6 +44,13 @@ SAMPLER_MODULES = {
 }
 GUARDS_MODULE = "hhmm_tpu.robust.guards"
 
+# serving modules -> guard functions that must be imported from the
+# named source modules AND called (invariant 3 in the module docstring)
+SERVE_MODULES = {
+    "hhmm_tpu/serve/online.py": ("safe_log_normalize",),
+}
+LMATH_MODULES = ("hhmm_tpu.core.lmath", "hhmm_tpu.core")
+
 
 def _bare_excepts(path: pathlib.Path, rel: str, problems: List[str]) -> None:
     tree = ast.parse(path.read_text(), filename=str(path))
@@ -43,15 +59,12 @@ def _bare_excepts(path: pathlib.Path, rel: str, problems: List[str]) -> None:
             problems.append(f"{rel}:{node.lineno}: bare `except:` (name the exception types)")
 
 
-def _guard_symbols(tree: ast.Module) -> set:
-    """Names bound from ``from hhmm_tpu.robust.guards import ...`` (the
-    robust package re-exports count too)."""
+def _imported_symbols(tree: ast.Module, modules) -> set:
+    """Names bound from ``from <module> import ...`` for any of
+    ``modules`` (package re-exports count too)."""
     names = set()
     for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom) and node.module in (
-            GUARDS_MODULE,
-            "hhmm_tpu.robust",
-        ):
+        if isinstance(node, ast.ImportFrom) and node.module in modules:
             for alias in node.names:
                 names.add(alias.asname or alias.name)
     return names
@@ -72,24 +85,41 @@ def check(root: pathlib.Path) -> List[str]:
         return [f"{root}: no hhmm_tpu/ package to check"]
     for py in sorted(pkg.rglob("*.py")):
         _bare_excepts(py, str(py.relative_to(root)), problems)
-    for rel, guard_fns in sorted(SAMPLER_MODULES.items()):
-        path = root / rel
-        if not path.is_file():
-            problems.append(f"{rel}: sampler module missing")
-            continue
-        tree = ast.parse(path.read_text(), filename=str(path))
-        imported = _guard_symbols(tree) & set(guard_fns)
-        if not imported:
-            problems.append(
-                f"{rel}: does not import a chain-health guard from {GUARDS_MODULE} "
-                f"(expected one of {guard_fns})"
-            )
-            continue
-        if not (imported & _called_names(tree)):
-            problems.append(
-                f"{rel}: imports {sorted(imported)} but never calls a guard — "
-                "transitions are unguarded"
-            )
+
+    def check_guarded(spec, source_modules, kind, noun, what):
+        for rel, guard_fns in sorted(spec.items()):
+            path = root / rel
+            if not path.is_file():
+                problems.append(f"{rel}: {kind} module missing")
+                continue
+            tree = ast.parse(path.read_text(), filename=str(path))
+            imported = _imported_symbols(tree, source_modules) & set(guard_fns)
+            if not imported:
+                problems.append(
+                    f"{rel}: does not import a {noun} from {source_modules[0]} "
+                    f"(expected one of {guard_fns})"
+                )
+                continue
+            if not (imported & _called_names(tree)):
+                problems.append(
+                    f"{rel}: imports {sorted(imported)} but never calls it — "
+                    f"{what}"
+                )
+
+    check_guarded(
+        SAMPLER_MODULES,
+        (GUARDS_MODULE, "hhmm_tpu.robust"),
+        "sampler",
+        "chain-health guard",
+        "transitions are unguarded",
+    )
+    check_guarded(
+        SERVE_MODULES,
+        LMATH_MODULES,
+        "serving",
+        "guarded normalization",
+        "the online step is unguarded",
+    )
     return problems
 
 
@@ -105,7 +135,10 @@ def main(argv: List[str]) -> int:
     if problems:
         print(f"check_guards: {len(problems)} violation(s)")
         return 1
-    print("check_guards: ok (no bare excepts; all samplers guarded)")
+    print(
+        "check_guards: ok (no bare excepts; all samplers guarded; "
+        "online serve step guarded)"
+    )
     return 0
 
 

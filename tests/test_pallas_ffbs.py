@@ -52,6 +52,26 @@ class TestKernelParity:
         np.testing.assert_array_equal(np.asarray(z_k), np.asarray(z_r))
         np.testing.assert_allclose(np.asarray(ll_k), np.asarray(ll_r), rtol=1e-5)
 
+    def test_inf_transition_degrades_not_nan(self, rng):
+        """An accidental -inf in A (callers should use safe_log /
+        MASK_NEG, but bad input happens) is clamped at kernel entry:
+        draws stay valid states from the zero-probability-path
+        distribution instead of NaN-ing via `0 * -inf` in the column
+        select and backward-draw logits."""
+        K, T, B = 4, 17, 3
+        hmms = [_random_hmm(rng, T, K) for _ in range(B)]
+        log_pi = jnp.stack([h[0] for h in hmms])
+        log_A = jnp.stack([h[1] for h in hmms]).at[:, 0, 2].set(-jnp.inf)
+        log_obs = jnp.stack([h[2] for h in hmms])
+        mask = jnp.stack([h[3] for h in hmms])
+        u = jnp.asarray(rng.uniform(size=(B, T)), jnp.float32)
+        z, ll = pallas_ffbs(log_pi, log_A, log_obs, mask, u, interpret=True)
+        z = np.asarray(z)
+        assert ((z >= 0) & (z < K)).all()
+        assert np.isfinite(z).all()
+        # the forbidden 0->2 transition is never drawn
+        assert not ((z[:, :-1] == 0) & (z[:, 1:] == 2)).any()
+
     def test_loglik_matches_forward_filter(self, rng):
         log_pi, log_A, log_obs, mask = _random_hmm(rng, 40, 3, masked_tail=5)
         u = jnp.asarray(rng.uniform(size=(1, 40)), jnp.float32)

@@ -1,0 +1,781 @@
+"""Streaming inference service suite (`hhmm_tpu/serve/`, tier-1, fast —
+see `docs/serving.md`).
+
+Pins the subsystem's four contracts end-to-end:
+
+- **online filter**: folding T streamed `stream_step` updates one tick
+  at a time reproduces the full-sequence ``lax.scan`` filter BITWISE
+  (same dtype, CPU), and both match the batch ``forward_filter`` up to
+  the normalization identity; per-tick model terms (``tick_init`` /
+  ``tick_terms``) reproduce each model's own batch build, gates
+  included;
+- **snapshot registry**: round-trip including model-spec
+  reconstruction; a torn/garbage file is a miss (quarantined aside),
+  not an exception; a foreign format version is a miss;
+- **scheduler**: after warmup every flush of a 256-series sustained
+  tick replay lands in an already-compiled bucket shape (compile-count
+  metric flat); degraded series are served from their last healthy
+  snapshot instead of erroring;
+- **serving analytics**: regime-flip hysteresis, posterior-predictive
+  forecasting, latency metrics.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hhmm_tpu.kernels import forward_filter
+from hhmm_tpu.core.lmath import safe_logsumexp
+from hhmm_tpu.models import GaussianHMM, MultinomialHMM, TayalHHMM
+from hhmm_tpu.robust import faults
+from hhmm_tpu.serve import (
+    MicroBatchScheduler,
+    PosteriorSnapshot,
+    RegimeDetector,
+    ServeMetrics,
+    SnapshotRegistry,
+    StreamState,
+    build_model,
+    filter_scan,
+    model_spec,
+    posterior_predictive_mean,
+    snapshot_from_fit,
+    stream_init,
+    stream_step,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _random_hmm(rng, T, K, dtype=np.float32):
+    log_pi = np.log(rng.dirichlet(np.ones(K))).astype(dtype)
+    log_A = np.log(rng.dirichlet(np.ones(K), size=K)).astype(dtype)
+    log_obs = (rng.normal(size=(T, K)) - 1.0).astype(dtype)
+    return jnp.asarray(log_pi), jnp.asarray(log_A), jnp.asarray(log_obs)
+
+
+def _fold(log_pi, log_A, log_obs, mask=None):
+    """The serving path: one jitted step folded tick by tick."""
+    init_j, step_j = jax.jit(stream_init), jax.jit(stream_step)
+    st = init_j(log_pi, log_obs[0], None if mask is None else mask[0])
+    alphas, lls = [st.log_alpha], [st.loglik]
+    for t in range(1, log_obs.shape[0]):
+        lA = log_A if log_A.ndim == 2 else log_A[t - 1]
+        st = step_j(st, lA, log_obs[t], None if mask is None else mask[t])
+        alphas.append(st.log_alpha)
+        lls.append(st.loglik)
+    return np.stack([np.asarray(a) for a in alphas]), np.asarray(lls)
+
+
+class TestStreamFilter:
+    def test_fold_matches_scan_bitwise_f32(self, rng):
+        """The acceptance criterion: N streamed `filter_step` updates
+        (via stream_step, tick at a time, separately jitted) reproduce
+        the full-sequence ``lax.scan`` filter bitwise on CPU."""
+        log_pi, log_A, log_obs = _random_hmm(rng, 96, 4)
+        a_fold, ll_fold = _fold(log_pi, log_A, log_obs)
+        a_scan, ll_scan = jax.jit(filter_scan)(log_pi, log_A, log_obs)
+        np.testing.assert_array_equal(a_fold, np.asarray(a_scan))
+        np.testing.assert_array_equal(ll_fold, np.asarray(ll_scan))
+
+    def test_fold_matches_scan_bitwise_f64(self, rng):
+        with jax.experimental.enable_x64():
+            log_pi, log_A, log_obs = _random_hmm(rng, 48, 3, np.float64)
+            a_fold, ll_fold = _fold(log_pi, log_A, log_obs)
+            a_scan, ll_scan = jax.jit(filter_scan)(log_pi, log_A, log_obs)
+        assert a_fold.dtype == np.float64
+        np.testing.assert_array_equal(a_fold, np.asarray(a_scan))
+        np.testing.assert_array_equal(ll_fold, np.asarray(ll_scan))
+
+    def test_fold_matches_scan_bitwise_masked(self, rng):
+        mask = jnp.asarray((rng.uniform(size=40) > 0.3).astype(np.float32))
+        log_pi, log_A, log_obs = _random_hmm(rng, 40, 3)
+        a_fold, ll_fold = _fold(log_pi, log_A, log_obs, mask)
+        a_scan, ll_scan = jax.jit(filter_scan)(log_pi, log_A, log_obs, mask)
+        np.testing.assert_array_equal(a_fold, np.asarray(a_scan))
+        np.testing.assert_array_equal(ll_fold, np.asarray(ll_scan))
+
+    def test_matches_batch_forward_filter(self, rng):
+        """Normalization identity vs the batch kernel: streamed
+        ``(log_alpha_norm, loglik)`` equal the unnormalized filter's
+        ``(log_alpha − lse(log_alpha), lse(log_alpha))`` per step."""
+        log_pi, log_A, log_obs = _random_hmm(rng, 64, 4)
+        a_fold, ll_fold = _fold(log_pi, log_A, log_obs)
+        la, ll = forward_filter(log_pi, log_A, log_obs)
+        ll_t = np.asarray(safe_logsumexp(la, axis=-1))
+        np.testing.assert_allclose(ll_fold, ll_t, rtol=0, atol=1e-5)
+        np.testing.assert_allclose(
+            a_fold, np.asarray(la) - ll_t[:, None], rtol=0, atol=1e-5
+        )
+        np.testing.assert_allclose(ll_fold[-1], float(ll), rtol=0, atol=1e-5)
+
+    def test_time_varying_transitions(self, rng):
+        """[T-1, K, K] log_A (IOHMM / stan-gate form) streams the same
+        per-step slices the scan consumes."""
+        K, T = 3, 24
+        log_pi, _, log_obs = _random_hmm(rng, T, K)
+        log_A_t = jnp.asarray(
+            np.log(rng.dirichlet(np.ones(K), size=(T - 1, K))).astype(np.float32)
+        )
+        a_fold, ll_fold = _fold(log_pi, log_A_t, log_obs)
+        la, ll = forward_filter(log_pi, log_A_t, log_obs)
+        np.testing.assert_allclose(ll_fold[-1], float(ll), rtol=0, atol=1e-5)
+        a_scan, ll_scan = jax.jit(filter_scan)(log_pi, log_A_t, log_obs)
+        np.testing.assert_array_equal(a_fold, np.asarray(a_scan))
+        np.testing.assert_array_equal(ll_fold, np.asarray(ll_scan))
+
+    def test_impossible_evidence_degrades_not_nan(self):
+        """Dead-stream discipline: impossible evidence floors the state
+        at −inf and the running loglik at −inf — never NaN — so the
+        scheduler's health mask can quarantine it."""
+        log_pi = jnp.log(jnp.asarray([0.5, 0.5], jnp.float32))
+        log_A = jnp.log(jnp.full((2, 2), 0.5, jnp.float32))
+        st = stream_init(log_pi, jnp.zeros(2))
+        st = stream_step(st, log_A, jnp.full((2,), -jnp.inf))
+        assert not np.isnan(np.asarray(st.log_alpha)).any()
+        assert float(st.loglik) == -np.inf
+        # and stays degraded (still no NaN) on a follow-up good tick
+        st2 = stream_step(st, log_A, jnp.zeros(2))
+        assert not np.isnan(np.asarray(st2.log_alpha)).any()
+
+
+class TestTickTerms:
+    """Model tick hooks reproduce each model's own batch build."""
+
+    @pytest.mark.parametrize("gate_mode", ["hard", "stan"])
+    def test_tayal_stream_matches_batch_loglik(self, rng, gate_mode):
+        from hhmm_tpu.sim import hmm_sim, obsmodel_categorical
+
+        A = np.array(
+            [[0.0, 0.4, 0.6, 0.0], [1.0, 0.0, 0.0, 0.0],
+             [0.3, 0.0, 0.0, 0.7], [0.0, 0.0, 1.0, 0.0]]
+        )
+        p1 = np.array([0.5, 0.0, 0.5, 0.0])
+        phi = rng.dirichlet(np.ones(9) * 2.0, size=4)
+        z, x = hmm_sim(jax.random.PRNGKey(0), 60, A, p1, obsmodel_categorical(phi))
+        up = np.array([0, 1, 1, 0])
+        sign = np.where(up[np.asarray(z)] == 1, 0, 1).astype(np.int32)
+        x = np.asarray(x, np.int32)
+        model = TayalHHMM(gate_mode=gate_mode)
+        params, _ = model.unpack(model.init_unconstrained(jax.random.PRNGKey(1), {"x": x, "sign": sign}))
+        # streamed: tick_init + per-tick tick_terms
+        st = stream_init(*model.tick_init(params, {"x": x[0], "sign": sign[0]}))
+        for t in range(1, len(x)):
+            lA, lobs = model.tick_terms(params, {"x": x[t], "sign": sign[t]})
+            st = stream_step(st, lA, lobs)
+        ll_batch = float(model.loglik(params, {"x": jnp.asarray(x), "sign": jnp.asarray(sign)}))
+        np.testing.assert_allclose(float(st.loglik), ll_batch, rtol=0, atol=2e-4)
+
+    def test_gaussian_stream_matches_batch_loglik(self, rng):
+        x = rng.normal(size=50).astype(np.float32)
+        model = GaussianHMM(K=3)
+        params, _ = model.unpack(
+            model.init_unconstrained(jax.random.PRNGKey(2), {"x": x})
+        )
+        st = stream_init(*model.tick_init(params, {"x": x[0]}))
+        for t in range(1, len(x)):
+            st = stream_step(st, *model.tick_terms(params, {"x": x[t]}))
+        ll_batch = float(model.loglik(params, {"x": jnp.asarray(x)}))
+        np.testing.assert_allclose(float(st.loglik), ll_batch, rtol=0, atol=2e-4)
+
+
+def _fake_snapshot(model, n_draws=6, scale=0.3, seed=0, healthy=True):
+    rng = np.random.default_rng(seed)
+    draws = (rng.normal(size=(n_draws, model.n_free)) * scale).astype(np.float32)
+    return PosteriorSnapshot(
+        spec=model_spec(model), draws=draws, healthy=healthy
+    )
+
+
+class TestRegistry:
+    def test_round_trip_and_spec_reconstruction(self, tmp_path):
+        model = TayalHHMM(gate_mode="hard")
+        reg = SnapshotRegistry(str(tmp_path))
+        snap = _fake_snapshot(model, n_draws=5)
+        reg.save("aapl", snap)
+        back = reg.load("aapl")
+        np.testing.assert_array_equal(back.draws, snap.draws)
+        assert back.healthy and back.version == snap.version
+        m2 = build_model(back.spec)
+        assert isinstance(m2, TayalHHMM) and m2.gate_mode == "hard" and m2.L == 9
+        assert reg.names() == ["aapl"]
+
+    def test_nig_prior_spec_round_trips(self):
+        from hhmm_tpu.models import NIGPrior
+
+        model = GaussianHMM(3, nig_prior=NIGPrior(m0=1.0, kappa0=0.5))
+        m2 = build_model(model_spec(model))
+        assert m2.K == 3 and m2.nig_prior == model.nig_prior
+
+    def test_torn_file_is_a_miss(self, tmp_path):
+        """The acceptance scenario: a crash-torn snapshot is a miss
+        (quarantined aside), and a re-save serves again."""
+        model = MultinomialHMM(K=2, L=3)
+        reg = SnapshotRegistry(str(tmp_path))
+        snap = _fake_snapshot(model)
+        path = reg.save("t", snap)
+        faults.tear_file(path, keep_bytes=16)
+        assert reg.load("t") is None  # miss, not an exception
+        assert not os.path.exists(path)  # quarantined aside
+        assert os.path.exists(path + ".corrupt")
+        reg.save("t", snap)
+        np.testing.assert_array_equal(reg.load("t").draws, snap.draws)
+
+    def test_garbage_and_empty_are_misses(self, tmp_path):
+        reg = SnapshotRegistry(str(tmp_path))
+        for name, payload in [("g", b"not a zip"), ("e", b"")]:
+            with open(os.path.join(str(tmp_path), f"{name}.npz"), "wb") as f:
+                f.write(payload)
+            assert reg.load(name) is None
+
+    def test_foreign_version_is_a_miss_but_not_corrupt(self, tmp_path):
+        model = MultinomialHMM(K=2, L=3)
+        reg = SnapshotRegistry(str(tmp_path))
+        snap = _fake_snapshot(model)
+        import dataclasses
+
+        reg.save("v", dataclasses.replace(snap, version="serve-snapshot-v999"))
+        assert reg.load("v") is None
+        # the file is foreign, not corrupt: left in place
+        assert os.path.exists(os.path.join(str(tmp_path), "v.npz"))
+
+    def test_atomic_save_leaves_no_temp_files(self, tmp_path):
+        reg = SnapshotRegistry(str(tmp_path))
+        reg.save("x", _fake_snapshot(MultinomialHMM(K=2, L=3)))
+        assert [p for p in os.listdir(str(tmp_path)) if ".tmp" in p] == []
+
+    def test_names_skip_stranded_temps_and_corpses(self, tmp_path):
+        """A temp stranded by a mid-write crash (finally never ran) and
+        a quarantined .corrupt file are not servable snapshot names."""
+        reg = SnapshotRegistry(str(tmp_path))
+        reg.save("real", _fake_snapshot(MultinomialHMM(K=2, L=3)))
+        for stranded in ("real.npz.tmp.12345.npz", "old.npz.corrupt"):
+            with open(os.path.join(str(tmp_path), stranded), "wb") as f:
+                f.write(b"partial")
+        assert reg.names() == ["real"]
+
+    def test_quarantined_save_never_displaces_healthy(self, tmp_path):
+        """The serving contract behind the scheduler's registry
+        fallback: saving a quarantined re-fit under a name holding a
+        healthy snapshot is refused — `load` keeps yielding the last
+        healthy posterior. With no healthy predecessor the degraded
+        save proceeds."""
+        model = MultinomialHMM(K=2, L=3)
+        reg = SnapshotRegistry(str(tmp_path))
+        good = _fake_snapshot(model, seed=1)
+        bad = _fake_snapshot(model, seed=2, healthy=False)
+        reg.save("s", good)
+        reg.save("s", bad)  # refused
+        back = reg.load("s")
+        assert back.healthy
+        np.testing.assert_array_equal(back.draws, good.draws)
+        # a healthy re-fit still replaces freely
+        good2 = _fake_snapshot(model, seed=3)
+        reg.save("s", good2)
+        np.testing.assert_array_equal(reg.load("s").draws, good2.draws)
+        # no healthy predecessor: the degraded snapshot is banked
+        reg.save("fresh", bad)
+        assert reg.load("fresh") is not None
+        assert not reg.load("fresh").healthy
+
+    def test_from_fit_excludes_quarantined_chains(self):
+        model = MultinomialHMM(K=2, L=3)
+        rng = np.random.default_rng(0)
+        samples = rng.normal(size=(2, 10, model.n_free)).astype(np.float32)
+        samples[1] = 777.0  # the quarantined chain's (frozen) draws
+        snap = snapshot_from_fit(
+            model, samples, chain_healthy=[True, False], n_draws=8
+        )
+        assert snap.healthy
+        assert snap.draws.shape == (8, model.n_free)
+        assert not (snap.draws == 777.0).any()
+        # every chain quarantined -> degraded snapshot, draws kept
+        snap2 = snapshot_from_fit(
+            model, samples, chain_healthy=[False, False], n_draws=8
+        )
+        assert not snap2.healthy and snap2.draws.shape == (8, model.n_free)
+
+
+def _tayal_stream(n_series, T, seed=0):
+    from __graft_entry__ import _tayal_batch
+
+    x, sign = _tayal_batch(n_series, T, seed=seed)
+    return np.asarray(x), np.asarray(sign)
+
+
+class TestScheduler:
+    def test_warmup_compiles_once_256_series(self):
+        """The acceptance criterion: a sustained tick replay of 256
+        Tayal series triggers ZERO new XLA compiles after warmup — the
+        compile-count metric stays flat."""
+        model = TayalHHMM(gate_mode="hard")
+        B, T = 256, 12
+        x, sign = _tayal_stream(B, T, seed=3)
+        snap = _fake_snapshot(model, n_draws=4)
+        sched = MicroBatchScheduler(model, buckets=(8, 64, 256))
+        sched.attach_many([(f"s{i}", snap, None) for i in range(B)])
+
+        def replay(t):
+            for i in range(B):
+                sched.submit(f"s{i}", {"x": int(x[i, t]), "sign": int(sign[i, t])})
+            return sched.flush()
+
+        replay(0)  # warmup: first tick compiles the init kernel
+        replay(1)  # warmup: second tick compiles the update kernel
+        warm = sched.metrics.compile_count
+        assert warm > 0
+        for t in range(2, T):
+            out = replay(t)
+            assert len(out) == B
+        assert sched.metrics.compile_count == warm  # flat: zero new compiles
+        assert sched.metrics.ticks == B * T
+        # a partial flush pads into the smallest bucket: first use of
+        # that bucket shape compiles once, every later one is free
+        sched.submit("s0", {"x": int(x[0, 0]), "sign": int(sign[0, 0])})
+        sched.submit("s1", {"x": int(x[1, 0]), "sign": int(sign[1, 0])})
+        (r0, _) = sched.flush()
+        small = sched.metrics.compile_count
+        assert small == warm + 1
+        assert r0.probs.shape == (4,) and abs(r0.probs.sum() - 1.0) < 1e-4
+        for i in range(3):  # 3 series still land in the 8-bucket: flat
+            sched.submit(f"s{i}", {"x": int(x[i, 1]), "sign": int(sign[i, 1])})
+        sched.flush()
+        assert sched.metrics.compile_count == small
+
+    def test_warm_start_history_matches_fresh_replay(self):
+        """attach(history=...) warm-starts the filter to exactly the
+        state a tick-by-tick replay of that history reaches (ragged
+        histories padded via batch/pad)."""
+        model = TayalHHMM(gate_mode="hard")
+        x, sign = _tayal_stream(2, 40, seed=5)
+        snap = _fake_snapshot(model, n_draws=3)
+        warm = MicroBatchScheduler(model, buckets=(4,))
+        warm.attach_many(
+            [
+                ("a", snap, {"x": x[0, :30], "sign": sign[0, :30]}),
+                ("b", snap, {"x": x[1, :17], "sign": sign[1, :17]}),  # ragged
+            ]
+        )
+        cold = MicroBatchScheduler(model, buckets=(4,))
+        cold.attach_many([("a", snap, None), ("b", snap, None)])
+        for t in range(30):
+            cold.submit("a", {"x": int(x[0, t]), "sign": int(sign[0, t])})
+            if t < 17:
+                cold.submit("b", {"x": int(x[1, t]), "sign": int(sign[1, t])})
+            cold.flush()
+        for sid in ("a", "b"):
+            aw, lw, _, _ = warm.state(sid)
+            ac, lc, _, _ = cold.state(sid)
+            np.testing.assert_allclose(
+                np.asarray(aw), np.asarray(ac), rtol=0, atol=1e-5
+            )
+            np.testing.assert_allclose(
+                np.asarray(lw), np.asarray(lc), rtol=0, atol=1e-4
+            )
+
+    def test_degraded_fit_served_from_last_healthy_snapshot(self, tmp_path):
+        """The quarantine-fallback path: a snapshot whose every chain
+        was quarantined (healthy=False) never replaces a healthy serving
+        state — the series keeps serving, un-degraded, from the attached
+        posterior; with no healthy fallback anywhere the degraded draws
+        serve flagged instead of erroring."""
+        model = MultinomialHMM(K=2, L=3)
+        reg = SnapshotRegistry(str(tmp_path))
+        good = _fake_snapshot(model, n_draws=4, seed=1)
+        bad = _fake_snapshot(model, n_draws=4, seed=2, healthy=False)
+        sched = MicroBatchScheduler(model, buckets=(4,), registry=reg)
+        sched.attach("s", good)
+        r1 = sched.tick({"s": {"x": 1}})["s"]
+        assert not r1.degraded
+        # degraded re-fit arrives: rejected, serving state kept
+        sched.attach("s", bad)
+        r2 = sched.tick({"s": {"x": 2}})["s"]
+        assert not r2.degraded
+        assert sched.metrics.degraded_attaches == 1
+        # registry fallback: fresh scheduler, healthy snapshot on disk
+        reg.save("r", good)
+        sched2 = MicroBatchScheduler(model, buckets=(4,), registry=reg)
+        sched2.attach("r", bad)
+        r3 = sched2.tick({"r": {"x": 0}})["r"]
+        assert not r3.degraded  # serving the registry's healthy draws
+        # no healthy fallback at all: serve the degraded draws, flagged
+        sched3 = MicroBatchScheduler(model, buckets=(4,))
+        sched3.attach("q", bad)
+        r4 = sched3.tick({"q": {"x": 0}})["q"]
+        assert r4.degraded
+        assert np.isfinite(r4.probs).all()
+
+    def test_nonfinite_draws_frozen_and_flagged(self):
+        """A stream whose filter goes non-finite is frozen at its last
+        healthy state (robust/ guard semantics) and served degraded —
+        not an error, never NaN in the response. Gaussian emissions with
+        NaN parameters are the realistic trigger (discrete models floor
+        bad parameters through safe_log before the filter sees them)."""
+        model = GaussianHMM(K=2)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=8).astype(np.float32)
+        ok_draws = np.stack(
+            [
+                np.asarray(
+                    model.init_unconstrained(jax.random.PRNGKey(i), {"x": x})
+                )
+                for i in range(4)
+            ]
+        )
+        snap_ok = PosteriorSnapshot(spec=model_spec(model), draws=ok_draws)
+        nan_draws = np.full((4, model.n_free), np.nan, np.float32)
+        snap_nan = PosteriorSnapshot(spec=model_spec(model), draws=nan_draws)
+        sched = MicroBatchScheduler(model, buckets=(4,))
+        sched.attach_many([("ok", snap_ok, None), ("dead", snap_nan, None)])
+        for t in range(3):
+            out = sched.tick(
+                {"ok": {"x": float(x[t])}, "dead": {"x": float(x[t])}}
+            )
+            assert not out["ok"].degraded and out["ok"].healthy_draws == 4
+            assert out["dead"].degraded and out["dead"].healthy_draws == 0
+            assert np.isfinite(out["dead"].probs).all()
+            assert np.isfinite(out["ok"].probs).all()
+
+    def test_double_submit_same_series_folds_both_ticks(self):
+        """Two ticks queued for one series before a flush dispatch as
+        sequential waves: the second folds from the first's state, and
+        the result matches tick-by-tick flushing exactly."""
+        model = MultinomialHMM(K=2, L=3)
+        snap = _fake_snapshot(model, n_draws=3, seed=4)
+        queued = MicroBatchScheduler(model, buckets=(4,))
+        queued.attach("s", snap)
+        for xv in (0, 1, 2, 1):
+            queued.submit("s", {"x": xv})
+        out = queued.flush()
+        assert len(out) == 4
+        stepped = MicroBatchScheduler(model, buckets=(4,))
+        stepped.attach("s", snap)
+        for xv in (0, 1, 2, 1):
+            stepped.tick({"s": {"x": xv}})
+        aq, lq, _, _ = queued.state("s")
+        ast_, lst, _, _ = stepped.state("s")
+        np.testing.assert_array_equal(np.asarray(aq), np.asarray(ast_))
+        np.testing.assert_array_equal(np.asarray(lq), np.asarray(lst))
+
+    def test_mismatched_draw_count_rejected(self):
+        model = MultinomialHMM(K=2, L=3)
+        sched = MicroBatchScheduler(model, buckets=(4,))
+        sched.attach("a", _fake_snapshot(model, n_draws=4))
+        with pytest.raises(ValueError, match="draws"):
+            sched.attach("b", _fake_snapshot(model, n_draws=8))
+
+    def test_unattached_series_rejected(self):
+        sched = MicroBatchScheduler(MultinomialHMM(K=2, L=3), buckets=(4,))
+        with pytest.raises(KeyError):
+            sched.submit("nope", {"x": 0})
+
+    def test_stale_snapshot_from_other_model_rejected(self):
+        """A snapshot fitted under a different model config (here: the
+        other Tayal gate mode) fails loudly at attach instead of being
+        silently unpacked with the wrong model."""
+        hard, stan = TayalHHMM(gate_mode="hard"), TayalHHMM(gate_mode="stan")
+        sched = MicroBatchScheduler(hard, buckets=(4,))
+        with pytest.raises(ValueError, match="fitted with"):
+            sched.attach("s", _fake_snapshot(stan))
+        # dim mismatch is caught even when the spec matches textually
+        small = _fake_snapshot(MultinomialHMM(K=2, L=3))
+        sched_g = MicroBatchScheduler(MultinomialHMM(K=2, L=4), buckets=(4,))
+        with pytest.raises(ValueError, match="fitted with|n_free"):
+            sched_g.attach("s", small)
+
+    def test_malformed_tick_fails_flush_before_any_dispatch(self):
+        """A tick with wrong observation keys fails the whole flush
+        up-front — no series advances, the queue stays intact — instead
+        of aborting half-applied after some waves already committed."""
+        model = MultinomialHMM(K=2, L=3)
+        snap = _fake_snapshot(model, n_draws=3)
+        sched = MicroBatchScheduler(model, buckets=(4,))
+        sched.attach_many([("a", snap, None), ("b", snap, None)])
+        sched.submit("a", {"x": 0})
+        sched.submit("b", {"y": 1})  # typo'd key
+        with pytest.raises(ValueError, match="queue left intact"):
+            sched.flush()
+        assert len(sched._pending) == 2  # nothing was popped
+        assert sched._series["a"]["alpha"] is None  # nothing dispatched
+
+    def test_bad_obs_value_requeues_undispatched_keeps_committed(self):
+        """A malformed observation *value* (wrong shape) only surfaces
+        inside a dispatch: the failing group commits no state and its
+        ticks go back on the queue (retryable), while waves that already
+        committed keep their responses — delivered at the head of the
+        next flush, never re-submitted (that would double-fold them)."""
+        model = MultinomialHMM(K=2, L=3)
+        snap = _fake_snapshot(model, n_draws=3)
+        sched = MicroBatchScheduler(model, buckets=(4,))
+        sched.attach_many([("a", snap, None), ("b", snap, None)])
+        sched.tick({"a": {"x": 0}, "b": {"x": 1}})  # both live + warm
+        # wave 1 = [a], wave 2 = [a, bad-b]
+        sched.submit("a", {"x": 1})
+        sched.submit("a", {"x": 0})
+        sched.submit("b", {"x": np.array([1, 2])})  # wrong shape
+        with pytest.raises(Exception):
+            sched.flush()
+        assert len(sched._pending) == 2  # wave-2 ticks requeued
+        ll_after_fail = float(np.asarray(sched._series["a"]["ll"]).sum())
+        # fix the bad tick and flush: wave-1's committed response is
+        # carried in, plus the two retried ticks
+        sched._pending[1] = ("b", {"x": 1}, sched._pending[1][2])
+        out = sched.flush()
+        assert [r.series_id for r in out] == ["a", "a", "b"]
+        assert float(np.asarray(sched._series["a"]["ll"]).sum()) != ll_after_fail
+
+    def test_float_ticks_after_int_warmup_not_truncated(self):
+        """Dtype drift (int ticks during warmup, float ticks later)
+        must PROMOTE the locked observation dtype, never truncate: the
+        served loglik equals the all-float replay."""
+        model = GaussianHMM(K=2)
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=4).astype(np.float32) + 1.75
+        draws = np.stack(
+            [
+                np.asarray(
+                    model.init_unconstrained(jax.random.PRNGKey(i), {"x": x})
+                )
+                for i in range(2)
+            ]
+        )
+        snap = PosteriorSnapshot(spec=model_spec(model), draws=draws)
+        drift = MicroBatchScheduler(model, buckets=(2,))
+        drift.attach("s", snap)
+        drift.tick({"s": {"x": 1}})  # int first tick locks the dtype...
+        for v in x:
+            drift.tick({"s": {"x": float(v)}})  # ...floats must survive
+        clean = MicroBatchScheduler(model, buckets=(2,))
+        clean.attach("s", snap)
+        clean.tick({"s": {"x": 1.0}})
+        for v in x:
+            clean.tick({"s": {"x": float(v)}})
+        _, ll_d, _, _ = drift.state("s")
+        _, ll_c, _, _ = clean.state("s")
+        np.testing.assert_allclose(
+            np.asarray(ll_d), np.asarray(ll_c), rtol=0, atol=1e-5
+        )
+
+    def test_failed_attach_batch_commits_nothing(self):
+        """A bad item anywhere in an attach batch leaves the scheduler
+        untouched — in particular the draw-count lock, so a corrected
+        retry with a different (consistent) draw count succeeds."""
+        model = MultinomialHMM(K=2, L=3)
+        sched = MicroBatchScheduler(model, buckets=(4,))
+        ok8 = _fake_snapshot(model, n_draws=8, seed=1)
+        bad = PosteriorSnapshot(
+            spec=model_spec(model),
+            draws=np.zeros((4, model.n_free + 1), np.float32),  # wrong dim
+        )
+        with pytest.raises(ValueError, match="n_free"):
+            sched.attach_many([("a", ok8, None), ("b", bad, None)])
+        assert sched.series_ids() == [] and sched.n_draws is None
+        # a failure surfacing only inside the warm replay (history with
+        # a wrong data key) is just as atomic: nothing committed
+        with pytest.raises(Exception):
+            sched.attach_many(
+                [("a", ok8, None), ("b", ok8, {"wrong_key": np.arange(5)})]
+            )
+        assert sched.series_ids() == [] and sched.n_draws is None
+        # corrected retry at a different draw count is NOT poisoned
+        ok16 = _fake_snapshot(model, n_draws=16, seed=2)
+        sched.attach_many([("a", ok16, None), ("b", ok16, None)])
+        assert sched.series_ids() == ["a", "b"] and sched.n_draws == 16
+
+    def test_tick_latest_wins_counts_superseded(self):
+        """tick()'s per-series dict keeps the latest response; an older
+        one for the same series (a queued tick) is superseded — dropped
+        and counted, never re-circulated into later flushes (the filter
+        state folded both ticks regardless)."""
+        model = MultinomialHMM(K=2, L=3)
+        sched = MicroBatchScheduler(model, buckets=(4,))
+        sched.attach("a", _fake_snapshot(model, n_draws=3))
+        sched.submit("a", {"x": 0})  # queued before the tick() call
+        out = sched.tick({"a": {"x": 1}})  # two waves, same series
+        assert len(out) == 1
+        assert sched.metrics.superseded_responses == 1
+        assert sched.metrics.ticks == 2  # both folded into the filter
+        sched.submit("a", {"x": 2})
+        out2 = sched.flush()  # ONLY the new tick: nothing circulates
+        assert len(out2) == 1
+
+    def test_snapshot_from_fit_zero_draws_clear_error(self):
+        model = MultinomialHMM(K=2, L=3)
+        with pytest.raises(ValueError, match="zero draws"):
+            snapshot_from_fit(
+                model, np.zeros((2, 0, model.n_free), np.float32)
+            )
+
+    def test_attach_none_snapshot_clear_error(self):
+        """A registry miss handed straight to attach (the natural
+        `sched.attach(name, registry.load(name))` restart pattern) is a
+        clear ValueError, not an AttributeError deep in resolution."""
+        sched = MicroBatchScheduler(MultinomialHMM(K=2, L=3), buckets=(4,))
+        with pytest.raises(ValueError, match="registry miss"):
+            sched.attach("gone", None)
+
+
+class TestServingAnalytics:
+    def test_regime_detector_hysteresis(self):
+        det = RegimeDetector(hold=3)
+        assert det.update([0.9, 0.1]) == (0, False)  # first commit, no flip
+        # a 2-tick blip does not flip
+        for _ in range(2):
+            assert det.update([0.2, 0.8]) == (0, False)
+        assert det.update([0.9, 0.1]) == (0, False)  # streak reset
+        # 3 consecutive decisive ticks flip exactly once
+        assert det.update([0.2, 0.8]) == (0, False)
+        assert det.update([0.2, 0.8]) == (0, False)
+        assert det.update([0.2, 0.8]) == (1, True)
+        assert det.update([0.2, 0.8]) == (1, False)  # stays, no re-flip
+
+    def test_regime_detector_margin(self):
+        det = RegimeDetector(hold=1, margin=0.2)
+        assert det.update([0.55, 0.45]) == (-1, False)  # indecisive
+        assert det.update([0.7, 0.3]) == (0, False)
+        assert det.update([0.55, 0.45]) == (0, False)  # within margin: holds
+        assert det.update([0.2, 0.8]) == (1, True)
+
+    def test_tayal_topstate_probs_and_flip(self):
+        from hhmm_tpu.apps.tayal import online_flip_detector, topstate_probs
+
+        p = topstate_probs(np.array([0.1, 0.2, 0.3, 0.4]))
+        np.testing.assert_allclose(p, [0.3, 0.7])  # (bear, bull)
+        det = online_flip_detector(hold=2)
+        det.update([0.9, 0.1])
+        det.update([0.1, 0.9])
+        regime, flipped = det.update([0.1, 0.9])
+        assert (regime, flipped) == (1, True)
+
+    def test_hassan_online_forecast(self):
+        """Served posterior-predictive mean equals the hand-computed
+        Σ_j p(z_{t+1}=j | x_{1:t}) μ_j averaged over draws."""
+        from hhmm_tpu.apps.hassan import online_forecast_mean
+        from hhmm_tpu.core.lmath import safe_log
+
+        model = GaussianHMM(K=2)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=12).astype(np.float32)
+        draws = np.stack(
+            [
+                np.asarray(
+                    model.init_unconstrained(jax.random.PRNGKey(i), {"x": x})
+                )
+                for i in range(3)
+            ]
+        )
+        snap = PosteriorSnapshot(spec=model_spec(model), draws=draws)
+        sched = MicroBatchScheduler(model, buckets=(2,))
+        sched.attach("g", snap)
+        for t in range(len(x)):
+            sched.tick({"g": {"x": float(x[t])}})
+        got = online_forecast_mean(sched, "g")
+        alpha, _, ok, params = sched.state("g")
+        want = float(
+            posterior_predictive_mean(
+                alpha, safe_log(params["A_ij"]), params["mu_k"]
+            )
+        )
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        assert np.isfinite(got)
+
+    def test_hassan_forecast_excludes_quarantined_draws(self):
+        """One NaN-parameter draw among healthy ones: the tick path
+        quarantines it (response stays healthy) and the forecast must
+        exclude it too — finite, equal to the healthy-draw forecast."""
+        from hhmm_tpu.apps.hassan import online_forecast_mean
+        from hhmm_tpu.core.lmath import safe_log
+
+        model = GaussianHMM(K=2)
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=6).astype(np.float32)
+        good = np.stack(
+            [
+                np.asarray(
+                    model.init_unconstrained(jax.random.PRNGKey(i), {"x": x})
+                )
+                for i in range(3)
+            ]
+        )
+        mixed = np.concatenate(
+            [good, np.full((1, model.n_free), np.nan, np.float32)]
+        )
+        sched = MicroBatchScheduler(model, buckets=(2,))
+        sched.attach(
+            "m", PosteriorSnapshot(spec=model_spec(model), draws=mixed)
+        )
+        for t in range(len(x)):
+            r = sched.tick({"m": {"x": float(x[t])}})["m"]
+        assert r.healthy_draws == 3 and not r.degraded
+        got = online_forecast_mean(sched, "m")
+        assert np.isfinite(got)
+        # equals the forecast from a healthy-draws-only snapshot
+        # (padded to the same D so the scheduler accepts it)
+        sched2 = MicroBatchScheduler(model, buckets=(2,))
+        sched2.attach(
+            "h",
+            PosteriorSnapshot(
+                spec=model_spec(model), draws=good[[0, 1, 2, 0]]
+            ),
+        )
+        for t in range(len(x)):
+            sched2.tick({"h": {"x": float(x[t])}})
+        alpha, _, ok, params = sched2.state("h")
+        # draw 0 is duplicated in the padded snapshot: average the 3
+        # unique healthy draws by hand (one single-draw call each)
+        from hhmm_tpu.serve.online import posterior_predictive_mean as ppm
+
+        want = float(
+            np.mean(
+                [
+                    float(
+                        ppm(
+                            alpha[i : i + 1],
+                            safe_log(params["A_ij"][i : i + 1]),
+                            params["mu_k"][i : i + 1],
+                        )
+                    )
+                    for i in range(3)
+                ]
+            )
+        )
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_metrics_quantiles_and_summary(self):
+        m = ServeMetrics()
+        for v in (0.001,) * 90 + (0.5,) * 10:
+            m.observe_latency(v)
+        m.observe_flush(100, 2.0)
+        assert m.quantile(0.5) <= 0.002
+        assert m.quantile(0.99) >= 0.4
+        s = m.summary()
+        assert s["requests"] == 100 and s["ticks"] == 100
+        assert s["ticks_per_sec"] == 50.0
+        assert s["latency_p50_ms"] < s["latency_p99_ms"]
+        # an empty window is JSON-safe: None, never a bare NaN token
+        import json as _json
+
+        empty = ServeMetrics().summary()
+        assert empty["latency_p50_ms"] is None
+        assert empty["ticks_per_sec"] is None
+        _json.loads(_json.dumps(empty))  # strict-parseable
+        # reset keeps cumulative health facts, zeroes the window
+        m.set_compile_count(7)
+        m.reset_throughput_window()
+        assert m.requests == 0 and m.compile_count == 7
+
+    def test_check_guards_covers_serve(self):
+        """The static pass enforces the serving invariant (guarded
+        normalization in the online step) — and the repo passes it."""
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "check_guards.py")],
+            capture_output=True,
+            text=True,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "online serve step guarded" in proc.stdout

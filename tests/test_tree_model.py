@@ -49,6 +49,21 @@ class TestStructure:
         prob_slots = [n for n, _, _, _, _ in m._slots]
         assert prob_slots == ["pi_n1", "A_n1_r0", "A_n1_r1"]
 
+    def test_gibbs_requires_proper_gaussian_priors(self):
+        """Both flat-prior opt-outs are rejected by the Gibbs block: a
+        flat mu OR sigma prior leaves the conditional improper on empty
+        leaves (the sigma guard mirrors the mu guard)."""
+        from hhmm_tpu.hhmm.examples import hier2x2_tree
+
+        z = jnp.zeros(5, jnp.int32)
+        data = {"x": jnp.zeros(5)}
+        m_mu = TreeHMM(hier2x2_tree(), order_mu="none", prior_mu_scale=None)
+        with pytest.raises(ValueError, match="prior_mu_scale"):
+            m_mu.gibbs_update(jax.random.PRNGKey(0), z, data, m_mu.spec_params())
+        m_sig = TreeHMM(hier2x2_tree(), order_mu="none", prior_sigma_scale=None)
+        with pytest.raises(ValueError, match="prior_sigma_scale"):
+            m_sig.gibbs_update(jax.random.PRNGKey(0), z, data, m_sig.spec_params())
+
     def test_mixed_emissions_rejected(self):
         from hhmm_tpu.hhmm.structure import End, Internal, Production, finalize
 
