@@ -275,6 +275,98 @@ def serve_bench(args, backend, degraded) -> None:
         sys.exit(1)
 
 
+def assoc_sweep(args, backend) -> None:
+    """``--assoc-sweep``: sequential-scan vs associative-scan decode
+    throughput (`kernels/assoc.py`, dispatched by
+    `kernels/dispatch.py`) on the Tayal hard-gate model.
+
+    One decode = forward filter + Viterbi per series (the walk-forward
+    decode pair); each (T, branch) point is timed as ONE vmapped jitted
+    dispatch over the series batch with compile excluded. Emits a
+    single ``tayal_assoc_decode_throughput`` JSON record with
+    sequential-vs-assoc series/s at every T plus the winner and what
+    the dispatch table (``use_assoc``) currently picks — a disagreement
+    between ``winner`` and ``dispatch_auto`` means the crossover table
+    is stale (re-run `scripts/tpu_assoc_probe.py`). Exit 0 always (the
+    record is the regression surface; `tests/test_assoc.py` gates the
+    --quick smoke in tier-1)."""
+    from __graft_entry__ import _tayal_batch
+    from hhmm_tpu.kernels import (
+        forward_filter,
+        forward_filter_assoc,
+        use_assoc,
+        viterbi,
+        viterbi_assoc,
+    )
+    from hhmm_tpu.models import TayalHHMM
+
+    model = TayalHHMM(gate_mode="hard")
+    Ts = [64, 128] if args.quick else [256, 1024, 4096]
+    B = 8 if args.quick else 64
+    reps = 2 if args.quick else 5
+
+    def decode(filt, vit):
+        def one(theta, x, sign):
+            params, _ = model.unpack(theta)
+            log_pi, log_A, log_obs, _ = model.build(
+                params, {"x": x, "sign": sign}
+            )
+            _, ll = filt(log_pi, log_A, log_obs)
+            z, _ = vit(log_pi, log_A, log_obs)
+            return ll, z
+
+        return jax.jit(jax.vmap(one))
+
+    fns = {
+        "seq": decode(forward_filter, viterbi),
+        "assoc": decode(forward_filter_assoc, viterbi_assoc),
+    }
+    points = []
+    for T in Ts:
+        x, sign = _tayal_batch(B, T, seed=42)
+        theta = jnp.stack(
+            [
+                model.init_unconstrained(k, {"x": x[i], "sign": sign[i]})
+                for i, k in enumerate(
+                    jax.random.split(jax.random.PRNGKey(5), B)
+                )
+            ]
+        )
+        row = {"T": T, "series": B}
+        for name, fn in fns.items():
+            jax.block_until_ready(fn(theta, x, sign))  # compile
+            t0 = time.time()
+            for _ in range(reps):
+                jax.block_until_ready(fn(theta, x, sign))
+            dt = (time.time() - t0) / reps
+            row[f"{name}_series_per_sec"] = round(B / dt, 1)
+        row["speedup_assoc"] = round(
+            row["assoc_series_per_sec"] / row["seq_series_per_sec"], 3
+        )
+        row["winner"] = (
+            "assoc" if row["speedup_assoc"] > 1.0 else "seq"
+        )
+        row["dispatch_auto"] = (
+            "assoc" if use_assoc(model.K, T) else "seq"
+        )
+        points.append(row)
+        print(json.dumps(row), file=sys.stderr, flush=True)
+    print(
+        json.dumps(
+            {
+                "metric": "tayal_assoc_decode_throughput",
+                "unit": "series/sec",
+                "value": points[-1]["assoc_series_per_sec"],
+                "points": points,
+                "backend": backend["backend"],
+                "backend_fallback": backend["fallback"],
+                "device": str(jax.devices()[0]),
+                "quick": bool(args.quick),
+            }
+        )
+    )
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--series", type=int, default=256)
@@ -360,6 +452,16 @@ def main() -> None:
     )
     ap.add_argument("--sweep-samples", type=int, default=2500)
     ap.add_argument(
+        "--assoc-sweep",
+        action="store_true",
+        help="run the sequential-vs-associative-scan decode sweep "
+        "instead of the fit bench: times forward filter + Viterbi per "
+        "series on both branches at T in {256, 1024, 4096} ({64, 128} "
+        "with --quick) and emits a tayal_assoc_decode_throughput JSON "
+        "record with the dispatch table's picks (kernels/dispatch.py; "
+        "see docs/parallel_scan.md)",
+    )
+    ap.add_argument(
         "--serve",
         action="store_true",
         help="run the streaming-service bench instead of the fit bench: "
@@ -411,7 +513,13 @@ def main() -> None:
         # crash mode); ensure_backend logs the failure + fallback
         backend = ensure_backend()
     degraded = False
-    if backend["backend"] == "cpu" and not args.cpu and not args.quick and args.scale_sweep is None:
+    if (
+        backend["backend"] == "cpu"
+        and not args.cpu
+        and not args.quick
+        and args.scale_sweep is None
+        and not args.assoc_sweep
+    ):
         # no accelerator: the full gated bench is a TPU workload (hours
         # on CPU). Emit an honest degraded smoke record and exit 0 so
         # sweep tooling sees "no TPU" instead of a crash; --cpu forces
@@ -432,6 +540,10 @@ def main() -> None:
         args.chains = 2 if args.sampler == "chees" else 1
     if args.quick:
         args.series, args.T, args.warmup, args.samples = 8, 128, 20, 20
+
+    if args.assoc_sweep:
+        assoc_sweep(args, backend)
+        return
 
     if args.serve:
         serve_bench(args, backend, degraded)

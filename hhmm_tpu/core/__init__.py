@@ -5,6 +5,7 @@ from hhmm_tpu.core.lmath import (
     log_vecmat,
     softmax,
 )
+from hhmm_tpu.core import compat
 from hhmm_tpu.core import dists
 from hhmm_tpu.core import bijectors
 
@@ -14,6 +15,7 @@ __all__ = [
     "log_matvec",
     "log_vecmat",
     "softmax",
+    "compat",
     "dists",
     "bijectors",
 ]

@@ -1,0 +1,57 @@
+"""Version-compatibility shims for JAX APIs that moved between releases.
+
+The pinned JAX in this environment (0.4.x) predates two APIs the
+sequence-parallel kernels (`kernels/assoc.py`) were written against:
+
+- ``jax.shard_map`` — graduated from ``jax.experimental.shard_map`` in
+  0.6; the experimental module's signature additionally takes
+  ``check_rep``, which we disable on the fallback path because the old
+  replication checker has no public way to mark a value device-varying
+  (that is exactly what ``lax.pcast`` was added for).
+- ``lax.pcast(x, axes, to="varying")`` — the explicit
+  replicated→varying cast (``lax.pvary`` in some intermediate
+  releases). When neither exists the fallback ``shard_map`` runs with
+  ``check_rep=False``, so no cast is needed and the shim is the
+  identity.
+
+Both shims resolve the preferred API at call time (not import time) so
+a JAX upgrade is picked up without touching call sites, and the unit
+tests in ``tests/test_assoc.py`` execute the fallback paths directly.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+__all__ = ["shard_map", "pcast_varying"]
+
+
+def shard_map(f, *, mesh, in_specs, out_specs):
+    """``jax.shard_map`` when available, else
+    ``jax.experimental.shard_map.shard_map`` with ``check_rep=False``
+    (the old replication checker rejects device-varying scan carries
+    that the modern API handles via ``lax.pcast``)."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
+
+
+def pcast_varying(x, axis_name: str):
+    """Mark ``x`` as device-varying over ``axis_name``.
+
+    Resolution order: ``lax.pcast(..., to="varying")`` (current API) →
+    ``lax.pvary`` (intermediate releases) → identity (the fallback
+    ``shard_map`` above runs with ``check_rep=False``, where replication
+    is untracked and the cast is a no-op).
+    """
+    pcast = getattr(lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, (axis_name,), to="varying")
+    pvary = getattr(lax, "pvary", None)
+    if pvary is not None:
+        return pvary(x, (axis_name,))
+    return x

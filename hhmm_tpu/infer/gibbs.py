@@ -48,7 +48,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from hhmm_tpu.kernels.ffbs import backward_sample, ffbs_fused
+from hhmm_tpu.kernels.dispatch import ffbs_dispatch
+from hhmm_tpu.kernels.ffbs import backward_sample
 from hhmm_tpu.kernels.filtering import forward_filter
 from hhmm_tpu.robust import faults
 from hhmm_tpu.robust.guards import all_finite, guard_where
@@ -59,11 +60,20 @@ __all__ = ["GibbsConfig", "sample_gibbs", "transition_counts", "emission_counts"
 @dataclass(frozen=True)
 class GibbsConfig:
     """Budget for :func:`sample_gibbs`. No adaptation knobs — blocked
-    Gibbs has no step size or trajectory to tune."""
+    Gibbs has no step size or trajectory to tune.
+
+    ``time_parallel`` routes the z-update's FFBS through the (K, T)
+    crossover dispatch (`kernels/dispatch.py`): ``"auto"`` (default)
+    keeps the fused Pallas kernel where it applies and picks the
+    sequential scan vs the O(log T)-depth associative-scan FFBS from
+    the measured table elsewhere; ``True``/``False`` force a branch.
+    Every route uses the same pre-drawn-uniform inverse-CDF draws, so
+    the choice is a scheduling decision, not a statistical one."""
 
     num_warmup: int = 100
     num_samples: int = 250
     num_chains: int = 1
+    time_parallel: object = "auto"
 
 
 def transition_counts(z: jnp.ndarray, K: int, mask: Optional[jnp.ndarray]) -> jnp.ndarray:
@@ -169,13 +179,29 @@ def sample_gibbs(
             # Pallas eligibility.
             k_z, k_par = jax.random.split(k)
             log_pi, log_A, log_obs, mask = build(params, data)
-            if gk is not None:
-                z, ll = ffbs_fused(k_z, log_pi, log_A, log_obs, mask, *gk)
-            elif log_A.ndim == 3:
+            if log_A.ndim == 3:
+                if gk is not None:
+                    # the build_vg/gate_keys contract promises a
+                    # homogeneous log_A when gate keys are in play;
+                    # sampling ungated here would silently target the
+                    # wrong conditional — fail at trace time instead
+                    raise ValueError(
+                        f"{type(model).__name__}.gate_keys is set but "
+                        "build_vg returned time-varying log_A "
+                        f"{log_A.shape}; gate keys require homogeneous "
+                        "log_A [K, K]"
+                    )
                 log_alpha, ll = forward_filter(log_pi, log_A, log_obs, mask)
                 z = backward_sample(k_z, log_alpha, log_A, mask)
             else:
-                z, ll = ffbs_fused(k_z, log_pi, log_A, log_obs, mask)
+                # crossover-dispatched FFBS (kernels/dispatch.py):
+                # fused Pallas on TPU, associative-scan past the
+                # measured (K, T) crossover, sequential scan below it
+                gate = gk if gk is not None else (None, None)
+                z, ll = ffbs_dispatch(
+                    k_z, log_pi, log_A, log_obs, mask, *gate,
+                    time_parallel=config.time_parallel,
+                )
             new = model.gibbs_update(k_par, z, data, params)
             if fault_step is not None:
                 ll, _, _ = faults.corrupt(t, fault_step, fault_kind, logp=ll)

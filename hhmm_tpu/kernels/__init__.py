@@ -13,13 +13,40 @@ from hhmm_tpu.kernels.ffbs import (
     ffbs_sample,
 )
 from hhmm_tpu.kernels.grad import forward_loglik
-from hhmm_tpu.kernels.assoc import forward_filter_assoc, forward_filter_seqshard
+from hhmm_tpu.kernels.assoc import (
+    backward_assoc,
+    ffbs_assoc,
+    ffbs_assoc_sample,
+    forward_filter_assoc,
+    forward_filter_seqshard,
+    smooth_assoc,
+    viterbi_assoc,
+)
+from hhmm_tpu.kernels.dispatch import (
+    backward_dispatch,
+    ffbs_dispatch,
+    forward_filter_dispatch,
+    smooth_dispatch,
+    use_assoc,
+    viterbi_dispatch,
+)
 from hhmm_tpu.kernels.alpha_fused import forward_alpha
 
 __all__ = [
     "filter_step",
     "forward_filter_assoc",
+    "backward_assoc",
+    "smooth_assoc",
+    "viterbi_assoc",
+    "ffbs_assoc",
+    "ffbs_assoc_sample",
     "forward_filter_seqshard",
+    "forward_filter_dispatch",
+    "backward_dispatch",
+    "smooth_dispatch",
+    "viterbi_dispatch",
+    "ffbs_dispatch",
+    "use_assoc",
     "forward_filter",
     "forward_alpha",
     "backward_pass",

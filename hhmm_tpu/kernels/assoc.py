@@ -1,30 +1,50 @@
-"""Long-sequence forward filtering: associative scan + sequence sharding.
+"""Time-parallel HMM engine: O(log T)-depth filtering, smoothing,
+Viterbi, FFBS, and sequence-sharded filtering.
 
 The reference's recursions are strictly sequential ``for (t in 2:T)``
-Stan loops (`hmm/stan/hmm.stan:32`, SURVEY.md §5). In log-space the
-forward recursion is a product in the (logsumexp, +) matrix semiring:
+Stan loops (`hmm/stan/hmm.stan:32`, SURVEY.md §5) and the seed's scan
+kernels (`kernels/filtering.py`, `viterbi.py`, `ffbs.py`) inherit that
+T-step dependency chain. Särkkä & García-Fernández (2020) show the
+whole family is a prefix/suffix product in an associative semiring
+(`kernels/semiring.py`), so ``jax.lax.associative_scan`` evaluates it
+at O(log T) depth for O(K³ log T) work — worthwhile exactly when K is
+small and T long (the zig-zag windows; the measured crossover lives in
+`kernels/dispatch.py`, probed by `scripts/tpu_assoc_probe.py`):
 
-    alpha_t = alpha_{t-1} (x) M_t,   M_t[i, j] = log_A[i, j] + log_obs[t, j]
-
-with ``(P (x) Q)[i, j] = logsumexp_k(P[i, k] + Q[k, j])``. Matrix
-products are associative, so the whole filter is a prefix-product scan:
-
-- :func:`forward_filter_assoc` uses ``jax.lax.associative_scan`` —
-  O(K^3 log T) work at O(log T) depth instead of a T-step dependency
-  chain. Worthwhile exactly when K is small (K<=4 here: a per-step
-  operand is 16 floats) and T is long — the zig-zag windows.
-- :func:`forward_filter_seqshard` shards the time axis over a mesh axis
-  (``shard_map``): each device prefix-scans its local chunk, the
-  per-chunk total operators are combined across devices with one
-  ``all_gather`` over ICI, and local prefixes are corrected by the
-  exclusive cross-device product. This is the sequence-parallelism
-  analog for scan models (ring-attention's role for attention,
-  SURVEY.md §5) and composes with batch sharding on an orthogonal mesh
-  axis.
+- :func:`forward_filter_assoc` — prefix products of
+  ``M_t = log_A + log_obs[t]`` in (logsumexp, +); same contract as
+  :func:`hhmm_tpu.kernels.filtering.forward_filter`.
+- :func:`backward_assoc` — suffix products of the *same* operators;
+  ``beta[t] = logsumexp_j (M_{t+1} ⊗ … ⊗ M_{T-1})[i, j]``. Same
+  contract as :func:`~hhmm_tpu.kernels.filtering.backward_pass`.
+- :func:`smooth_assoc` — both passes + the guarded normalization;
+  same outputs as :func:`~hhmm_tpu.kernels.filtering.forward_backward`.
+- :func:`viterbi_assoc` — (max, +) prefix scan for delta, then the
+  per-step argmax backpointer maps are suffix-composed with ONE more
+  associative scan (map composition is associative), so the backtrack
+  is also O(log T) depth instead of a second sequential scan.
+- :func:`ffbs_assoc` — all T uniforms pre-drawn (the inverse-CDF
+  semantics of `kernels/pallas_ffbs.py` / `ffbs_invcdf_reference`);
+  each backward step becomes a K→K *sampling map* ``S_t[j] =
+  invcdf(alpha_t + log_A[:, j], u_t)`` computed for every possible
+  successor j in parallel, and the draw is the suffix composition of
+  the maps — the whole FFBS is two O(log T) passes, mask- and
+  gate-compatible with :func:`~hhmm_tpu.kernels.ffbs.ffbs_fused`.
+- :func:`forward_filter_seqshard` — shards the time axis over a mesh
+  axis (``shard_map``): each device prefix-scans its local chunk,
+  chunk totals are combined across devices with one ``all_gather``
+  over ICI, and local prefixes are corrected by the exclusive
+  cross-device product. Composes with batch sharding on an orthogonal
+  mesh axis via ``batch_axis_name`` (the ring-attention analog for
+  scan models, SURVEY.md §5; exercised by
+  ``__graft_entry__.dryrun_multichip``).
 
 Masked (padding) steps are semiring identities (0 diagonal, -inf off),
-reproducing the carry-copy semantics of the sequential kernel, so both
-variants accept the same ragged-batch masks.
+reproducing the carry-copy semantics of the sequential kernels, so
+every variant accepts the same ragged-batch masks. All-(−inf) rows
+(impossible evidence, fully gated columns) degrade like
+``safe_log_normalize`` — the combines route through the guarded
+``safe_logsumexp`` (statically enforced by `scripts/check_guards.py`).
 """
 
 from __future__ import annotations
@@ -37,18 +57,55 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from hhmm_tpu.core.lmath import logsumexp, log_vecmat
+from hhmm_tpu.core.compat import pcast_varying, shard_map
+from hhmm_tpu.core.lmath import safe_log_normalize, safe_logsumexp
+from hhmm_tpu.kernels.semiring import (
+    compose_maps,
+    identity_map,
+    logsumexp_matmul,
+    maxplus_matmul,
+    semiring_eye,
+    step_operators,
+)
 
-__all__ = ["forward_filter_assoc", "forward_filter_seqshard"]
+__all__ = [
+    "forward_filter_assoc",
+    "backward_assoc",
+    "smooth_assoc",
+    "viterbi_assoc",
+    "ffbs_assoc",
+    "ffbs_assoc_sample",
+    "forward_filter_seqshard",
+]
 
 
-def _semiring_matmul(Pm: jnp.ndarray, Qm: jnp.ndarray) -> jnp.ndarray:
-    """(P (x) Q)[..., i, j] = logsumexp_k(P[..., i, k] + Q[..., k, j])."""
-    return logsumexp(Pm[..., :, :, None] + Qm[..., None, :, :], axis=-2)
+def _validate_time_varying(log_A: jnp.ndarray, T: int) -> None:
+    if log_A.ndim == 3 and log_A.shape[0] != T - 1:
+        raise ValueError(
+            f"time-varying log_A must have T-1={T - 1} slices, got {log_A.shape[0]}"
+        )
 
 
-def _semiring_eye(K: int, dtype) -> jnp.ndarray:
-    return jnp.where(jnp.eye(K, dtype=bool), 0.0, -jnp.inf).astype(dtype)
+def _log_vecmat(log_x, log_M):
+    """Guarded log-space row-vector × matrix (the lmath ``log_vecmat``
+    with the safe reduction): prefix products of −inf-identity
+    operators create fully-(−inf) columns, and the raw logsumexp VJP
+    there is NaN — the sequential filter never sees such columns, so
+    the assoc kernels must guard this reduction too, not just the
+    semiring combines."""
+    return safe_logsumexp(log_x[..., :, None] + log_M, axis=-2)
+
+
+def _suffix_scan(combine, elems):
+    """Suffix products ``out[t] = elems[t] ⊗ elems[t+1] ⊗ … ⊗ elems[-1]``
+    in ORIGINAL operand order. ``associative_scan(reverse=True)`` flips
+    the sequence, so a non-commutative combine must itself be flipped —
+    passing ``combine`` directly would evaluate ``elems[-1] ⊗ … ⊗
+    elems[t]``, silently wrong for matrix semirings and map composition.
+    """
+    return lax.associative_scan(
+        lambda a, b: combine(b, a), elems, axis=0, reverse=True
+    )
 
 
 def _alpha0(log_pi, log_obs0, mask0):
@@ -69,27 +126,214 @@ def forward_filter_assoc(
     time-varying ``log_A``, optional mask), computed by an
     O(log T)-depth associative prefix scan."""
     T, K = log_obs.shape
-    if log_A.ndim == 3 and log_A.shape[0] != T - 1:
-        raise ValueError(
-            f"time-varying log_A must have T-1={T - 1} slices, got {log_A.shape[0]}"
-        )
     a0 = _alpha0(log_pi, log_obs[0], None if mask is None else mask[0])
     if T == 1:
-        return a0[None], logsumexp(a0)
-
-    lA = log_A if log_A.ndim == 3 else jnp.broadcast_to(log_A, (T - 1, K, K))
-    M = lA + log_obs[1:, None, :]
-    if mask is not None:
-        M = jnp.where(mask[1:, None, None] > 0, M, _semiring_eye(K, log_obs.dtype)[None])
-    prefix = lax.associative_scan(_semiring_matmul, M, axis=0)  # [T-1, K, K]
-    alpha_rest = log_vecmat(a0, prefix)
+        # early-return BEFORE the T-1 slice validation: a time-varying
+        # caller legitimately has zero transition slices here, and the
+        # shape check below would reject e.g. a [1, K, K] kernel built
+        # for a longer window before the degenerate case is handled
+        return a0[None], safe_logsumexp(a0)
+    _validate_time_varying(log_A, T)
+    M = step_operators(log_A, log_obs, mask)
+    prefix = lax.associative_scan(logsumexp_matmul, M, axis=0)  # [T-1, K, K]
+    alpha_rest = _log_vecmat(a0, prefix)
     log_alpha = jnp.concatenate([a0[None], alpha_rest], axis=0)
-    return log_alpha, logsumexp(log_alpha[-1])
+    return log_alpha, safe_logsumexp(log_alpha[-1])
 
 
-def _seqshard_body(axis_name, log_pi, log_A, log_obs, mask):
+def backward_assoc(
+    log_A: jnp.ndarray,
+    log_obs: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Same contract and outputs as
+    :func:`hhmm_tpu.kernels.filtering.backward_pass`: ``log_beta
+    [T, K]`` by an O(log T)-depth associative *suffix* scan.
+
+    The beta recursion uses the same per-step operators as the filter:
+    ``beta[t][i] = logsumexp_j (M_{t+1} ⊗ … ⊗ M_{T-1})[i, j]`` with
+    ``beta[T-1] = 0`` — one reverse ``associative_scan`` and a row
+    reduction."""
+    T, K = log_obs.shape
+    if T == 1:
+        return jnp.zeros((1, K), log_obs.dtype)
+    _validate_time_varying(log_A, T)
+    M = step_operators(log_A, log_obs, mask)
+    suffix = _suffix_scan(logsumexp_matmul, M)
+    beta_rest = safe_logsumexp(suffix, axis=-1)  # [T-1, K]
+    return jnp.concatenate(
+        [beta_rest, jnp.zeros((1, K), log_obs.dtype)], axis=0
+    )
+
+
+def smooth_assoc(
+    log_pi: jnp.ndarray,
+    log_A: jnp.ndarray,
+    log_obs: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+):
+    """Time-parallel forward-backward smoothing. Same outputs as
+    :func:`hhmm_tpu.kernels.filtering.forward_backward`:
+    ``(log_alpha, log_beta, log_gamma, loglik)`` — two O(log T) passes
+    plus the guarded normalization."""
+    log_alpha, loglik = forward_filter_assoc(log_pi, log_A, log_obs, mask)
+    log_beta = backward_assoc(log_A, log_obs, mask)
+    return log_alpha, log_beta, safe_log_normalize(log_alpha + log_beta), loglik
+
+
+def viterbi_assoc(
+    log_pi: jnp.ndarray,
+    log_A: jnp.ndarray,
+    log_obs: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Same contract and outputs as :func:`hhmm_tpu.kernels.viterbi.viterbi`
+    (``(path [T] int32, log_prob)``), with BOTH phases time-parallel:
+
+    1. delta by a (max, +) prefix ``associative_scan`` over the same
+       operators as the filter;
+    2. backtrack by suffix-composing the per-step argmax backpointer
+       maps ``back_t`` (computed for all t in one vectorized argmax)
+       with a second associative scan — map composition is associative,
+       so ``z_t = (back_{t+1} ∘ … ∘ back_{T-1})[z_{T-1}]``.
+    """
+    T, K = log_obs.shape
+    delta0 = log_pi + log_obs[0]
+    if T == 1:
+        return jnp.argmax(delta0)[None].astype(jnp.int32), jnp.max(delta0)
+    _validate_time_varying(log_A, T)
+    # the (max, +) pass shares the filter's operand builder; the bare
+    # broadcast lA is additionally needed for the backpointer scores
+    lA = log_A if log_A.ndim == 3 else jnp.broadcast_to(log_A, (T - 1, K, K))
+    M = step_operators(log_A, log_obs, mask)
+    prefix = lax.associative_scan(maxplus_matmul, M, axis=0)  # [T-1, K, K]
+    delta_rest = jnp.max(delta0[None, :, None] + prefix, axis=1)  # [T-1, K]
+    delta = jnp.concatenate([delta0[None], delta_rest], axis=0)  # [T, K]
+
+    # backpointers for steps 1..T-1, all at once: back[t][j] =
+    # argmax_i(delta[t-1, i] + A_t[i, j]); a masked step's map is the
+    # identity (copy the previous state), as in the sequential kernel
+    scores = delta[:-1][:, :, None] + lA  # [T-1, K, K]
+    back = jnp.argmax(scores, axis=1).astype(jnp.int32)  # [T-1, K]
+    if mask is not None:
+        back = jnp.where(mask[1:, None] > 0, back, identity_map(K)[None])
+
+    z_last = jnp.argmax(delta[-1]).astype(jnp.int32)
+    # suffix composition: comp[t] = back[t] ∘ back[t+1] ∘ … ∘ back[T-2]
+    comp = _suffix_scan(compose_maps, back)
+    path_rest = comp[:, z_last]  # [T-1]
+    path = jnp.concatenate([path_rest, z_last[None]], axis=0)
+    return path.astype(jnp.int32), jnp.max(delta[-1])
+
+
+def _invcdf_cols(logits: jnp.ndarray, u: jnp.ndarray) -> jnp.ndarray:
+    """Vectorized inverse-CDF draw along the state axis −2:
+    ``out[..., j] = #{i : cum_i <= u}`` over normalized
+    ``exp(logits[..., :, j])`` — identical math to
+    :func:`hhmm_tpu.kernels.ffbs._invcdf` applied per column."""
+    p = jax.nn.softmax(logits, axis=-2)
+    cum = jnp.cumsum(p[..., :-1, :], axis=-2)
+    return jnp.sum(u[..., None, None] >= cum, axis=-2).astype(jnp.int32)
+
+
+def ffbs_assoc(
+    log_pi: jnp.ndarray,
+    log_A: jnp.ndarray,
+    log_obs: jnp.ndarray,
+    mask: jnp.ndarray,
+    u: jnp.ndarray,
+    gate_key: Optional[jnp.ndarray] = None,
+    state_key: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Time-parallel FFBS with the exact draw semantics of
+    :func:`hhmm_tpu.kernels.ffbs.ffbs_invcdf_reference` (same pre-drawn
+    uniforms ``u [T]`` → same path, draw for draw): homogeneous
+    ``log_A``, optional ``gate_key [T]``/``state_key [K]`` gating
+    (`kernels/vg.py` semantics). Returns ``(z [T] int32, loglik)``.
+
+    Both passes are O(log T) depth: the forward filter is the
+    (logsumexp, +) prefix scan, and every backward draw ``z_t =
+    invcdf(alpha_t + log_A[:, z_{t+1}], u_t)`` is precomputed for all K
+    possible successors as a sampling map ``S_t : K→K``, whose suffix
+    composition (one more associative scan) yields the whole path.
+    """
+    if log_A.ndim != 2:
+        raise ValueError(
+            f"ffbs_assoc needs homogeneous log_A [K, K], got shape "
+            f"{log_A.shape}; use ffbs_sample for time-varying transitions"
+        )
+    if (gate_key is None) != (state_key is None):
+        raise ValueError("gate_key and state_key must be given together")
+    T, K = log_obs.shape
+    if gate_key is None:
+        log_alpha, ll = forward_filter_assoc(log_pi, log_A, log_obs, mask)
+    else:
+        # forward: per-destination gate on log_A, materialized [T-1,K,K]
+        # (same construction as the scan reference — a gate-inconsistent
+        # successor contributes a unit pairwise factor)
+        c = gate_key[:, None] == state_key[None, :]  # [T, K]
+        log_A_t = jnp.where(c[1:, None, :], log_A[None], 0.0)
+        log_alpha, ll = forward_filter_assoc(log_pi, log_A_t, log_obs, mask)
+    z_last = _invcdf_cols(log_alpha[T - 1][:, None], u[T - 1])[0]
+    if T == 1:
+        return z_last[None].astype(jnp.int32), ll
+
+    # sampling maps for t = 0..T-2: S[t][j] = the state drawn at t given
+    # z_{t+1} = j. A masked (or gate-inconsistent) successor carries no
+    # information — the draw falls back to the filter alone, exactly the
+    # sequential reference's g-clause.
+    if gate_key is None:
+        g = jnp.broadcast_to((mask[1:] > 0)[:, None], (T - 1, K))
+    else:
+        g = (mask[1:] > 0)[:, None] & (
+            gate_key[1:, None] == state_key[None, :]
+        )  # [T-1, K]
+    logits = jnp.where(
+        g[:, None, :],
+        log_alpha[:-1][:, :, None] + log_A[None, :, :],
+        log_alpha[:-1][:, :, None],
+    )  # [T-1, K(i), K(j)]
+    S = _invcdf_cols(logits, u[:-1])  # [T-1, K]
+
+    # suffix composition: z_t = (S_t ∘ S_{t+1} ∘ … ∘ S_{T-2})[z_{T-1}]
+    comp = _suffix_scan(compose_maps, S)
+    z = jnp.concatenate([comp[:, z_last], z_last[None]], axis=0).astype(jnp.int32)
+    # overwrite the padded tail with the last valid state (reference
+    # semantics)
+    T_last = jnp.sum(mask).astype(jnp.int32) - 1
+    z = jnp.where(jnp.arange(T) <= T_last, z, z[T_last])
+    return z, ll
+
+
+def ffbs_assoc_sample(
+    key: jax.Array,
+    log_pi: jnp.ndarray,
+    log_A: jnp.ndarray,
+    log_obs: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+    gate_key: Optional[jnp.ndarray] = None,
+    state_key: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Key-based convenience over :func:`ffbs_assoc` with the *same
+    uniform-draw convention* as :func:`hhmm_tpu.kernels.ffbs.ffbs_fused`
+    (``uniform(key, (T,), dtype)``), so the two are draw-for-draw
+    interchangeable under the dispatch layer (`kernels/dispatch.py`)."""
+    if (gate_key is None) != (state_key is None):
+        raise ValueError("gate_key and state_key must be given together")
+    T = log_obs.shape[0]
+    if mask is None:
+        mask = jnp.ones((T,), log_obs.dtype)
+    u = jax.random.uniform(key, (T,), log_obs.dtype)
+    return ffbs_assoc(log_pi, log_A, log_obs, mask, u, gate_key, state_key)
+
+
+# ---- sequence sharding (time axis over a mesh axis) ----
+
+
+def _seqshard_body(axis_name, D, log_pi, log_A, log_obs, mask):
     """Per-device body. ``log_obs``/``mask`` are the local time chunk;
-    ``log_pi``/``log_A`` replicated.
+    ``log_pi``/``log_A`` replicated; ``D`` the (static) axis size — the
+    pinned JAX predates ``lax.axis_size``.
 
     Uniform chunk algebra: the filter is ``alpha_t = a0 (x) M_1 ... M_t``.
     Chunk d owns operators M_t for its local time range; the global M_0
@@ -98,23 +342,22 @@ def _seqshard_body(axis_name, log_pi, log_A, log_obs, mask):
     ``excl`` is the product of all previous chunks' totals.
     """
     d = lax.axis_index(axis_name)
-    D = lax.axis_size(axis_name)
     Tl, K = log_obs.shape
-    eye = _semiring_eye(K, log_obs.dtype)
+    eye = semiring_eye(K, log_obs.dtype)
 
     M = log_A[None] + log_obs[:, None, :]  # [Tl, K, K]
     M = jnp.where(mask[:, None, None] > 0, M, eye[None])
     # device 0: global M_0 doesn't exist — replace with identity
     M = M.at[0].set(jnp.where(d == 0, eye, M[0]))
 
-    prefix = lax.associative_scan(_semiring_matmul, M, axis=0)  # [Tl, K, K]
+    prefix = lax.associative_scan(logsumexp_matmul, M, axis=0)  # [Tl, K, K]
     totals = lax.all_gather(prefix[-1], axis_name)  # [D, K, K]
 
     def fold(carry, i):
-        return jnp.where(i < d, _semiring_matmul(carry, totals[i]), carry), None
+        return jnp.where(i < d, logsumexp_matmul(carry, totals[i]), carry), None
 
     # the fold result varies per device (depends on d) — mark the init so
-    eye_v = lax.pcast(eye, (axis_name,), to="varying")
+    eye_v = pcast_varying(eye, axis_name)
     excl, _ = lax.scan(fold, eye_v, jnp.arange(D))
 
     # a0 lives on device 0 (needs global obs[0]/mask[0]); broadcast by
@@ -122,10 +365,10 @@ def _seqshard_body(axis_name, log_pi, log_A, log_obs, mask):
     a0_local = _alpha0(log_pi, log_obs[0], mask[0])
     a0 = lax.psum(jnp.where(d == 0, a0_local, jnp.zeros_like(a0_local)), axis_name)
 
-    carry_in = log_vecmat(a0, excl)
-    log_alpha = log_vecmat(carry_in, prefix)  # [Tl, K]
+    carry_in = _log_vecmat(a0, excl)
+    log_alpha = _log_vecmat(carry_in, prefix)  # [Tl, K]
 
-    ll_local = logsumexp(log_alpha[-1])
+    ll_local = safe_logsumexp(log_alpha[-1])
     ll = lax.psum(jnp.where(d == D - 1, ll_local, 0.0), axis_name)
     return log_alpha, ll
 
@@ -138,6 +381,7 @@ def forward_filter_seqshard(
     *,
     mesh: Mesh,
     axis_name: str = "sp",
+    batch_axis_name: Optional[str] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Sequence-parallel forward filter: the time axes of ``log_obs`` and
     ``mask`` are sharded over ``axis_name`` of ``mesh``; returns
@@ -145,20 +389,55 @@ def forward_filter_seqshard(
     divide evenly by the axis size. Homogeneous ``log_A`` only — the
     time-varying IOHMM case has T-1 operator slices that misalign with
     T-length chunks; shard the batch axis instead (SURVEY.md §2.9:
-    batching dominates at these sizes)."""
-    T, K = log_obs.shape
+    batching dominates at these sizes).
+
+    ``batch_axis_name`` composes sequence sharding with the existing
+    batch/chain mesh axes: inputs gain a leading series axis (``log_pi``
+    [B, K], ``log_A`` [B, K, K], ``log_obs`` [B, T, K], ``mask``
+    [B, T]) sharded over ``batch_axis_name`` while time shards over
+    ``axis_name`` — the per-device body is the identical chunk algebra
+    vmapped over its local series, with collectives only on the
+    sequence axis (exercised by ``__graft_entry__.dryrun_multichip``).
+    Returns ([B, T, K] sharded over both axes, loglik [B]).
+    """
+    batched = batch_axis_name is not None
+    if log_obs.ndim != (3 if batched else 2):
+        raise ValueError(
+            f"log_obs must be {'[B, T, K]' if batched else '[T, K]'}, "
+            f"got shape {log_obs.shape}"
+        )
+    T = log_obs.shape[1] if batched else log_obs.shape[0]
     D = mesh.shape[axis_name]
     if T % D != 0:
         raise ValueError(f"T={T} must be divisible by mesh axis {axis_name}={D}")
-    if log_A.ndim != 2:
-        raise ValueError("forward_filter_seqshard supports homogeneous log_A only")
+    if log_A.ndim != (3 if batched else 2):
+        raise ValueError(
+            "forward_filter_seqshard supports homogeneous (per-series) "
+            "log_A only: expected "
+            + ("[B, K, K] with batch_axis_name" if batched else "[K, K]")
+            + f", got shape {log_A.shape}"
+        )
     if mask is None:
-        mask = jnp.ones((T,), log_obs.dtype)
+        mask = jnp.ones(log_obs.shape[:-1], log_obs.dtype)
 
-    fn = jax.shard_map(
-        partial(_seqshard_body, axis_name),
-        mesh=mesh,
-        in_specs=(P(), P(), P(axis_name, None), P(axis_name)),
-        out_specs=(P(axis_name, None), P()),
-    )
+    body = partial(_seqshard_body, axis_name, D)
+    if batched:
+        fn = shard_map(
+            jax.vmap(body),
+            mesh=mesh,
+            in_specs=(
+                P(batch_axis_name, None),
+                P(batch_axis_name, None, None),
+                P(batch_axis_name, axis_name, None),
+                P(batch_axis_name, axis_name),
+            ),
+            out_specs=(P(batch_axis_name, axis_name, None), P(batch_axis_name)),
+        )
+    else:
+        fn = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(), P(), P(axis_name, None), P(axis_name)),
+            out_specs=(P(axis_name, None), P()),
+        )
     return fn(log_pi, log_A, log_obs, mask)

@@ -1,0 +1,211 @@
+"""``time_parallel=`` dispatch: sequential scan vs associative-scan kernels.
+
+The sequential ``lax.scan`` kernels are O(T) depth with O(T·K²) work;
+the time-parallel kernels (`kernels/assoc.py`) are O(log T) depth with
+O(T·K³) work (semiring matrix products). Which wins is a measured
+(K, T) question, not a principle:
+
+- **small T**: the scan's dependency chain is short; the assoc kernels
+  pay K× more work plus scan-tree overheads for nothing;
+- **large K**: O(K³) work grows faster than the depth saving — the
+  crossover T rises steeply with K and beyond K≈8 the scan wins at any
+  realistic T;
+- **small K, long T** (the zig-zag tick windows): the assoc form turns
+  the longest serial dependency in the system into log-depth work.
+
+``scripts/tpu_assoc_probe.py`` measures the crossover per backend and
+writes `results/assoc_crossover.json`; the table below records the
+measured values (methodology and the full grids are in
+`docs/parallel_scan.md`). Every consumer takes ``time_parallel=`` —
+``"auto"`` (table lookup, the default), ``True`` (force assoc), or
+``False`` (force scan) — so callers can override per call. Shapes are
+static under ``jit``, so dispatch is plain Python with zero trace cost.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from hhmm_tpu.kernels.assoc import (
+    backward_assoc,
+    ffbs_assoc_sample,
+    forward_filter_assoc,
+    smooth_assoc,
+    viterbi_assoc,
+)
+from hhmm_tpu.kernels.ffbs import backward_sample, ffbs_fused
+from hhmm_tpu.kernels.filtering import backward_pass, forward_backward, forward_filter
+from hhmm_tpu.kernels.viterbi import viterbi
+
+__all__ = [
+    "ASSOC_CROSSOVER",
+    "use_assoc",
+    "forward_filter_dispatch",
+    "backward_dispatch",
+    "smooth_dispatch",
+    "viterbi_dispatch",
+    "ffbs_dispatch",
+]
+
+TimeParallel = Union[bool, str]
+
+# Measured crossover table: ``platform -> ((K_max, T_min), ...)`` — the
+# assoc kernel is dispatched when K <= K_max of some row and T >= that
+# row's T_min (first matching row wins; K above every row never
+# dispatches assoc; an empty tuple means the scan wins everywhere).
+#
+# CPU row: MEASURED by ``scripts/tpu_assoc_probe.py --cpu`` on the CI
+# host (results/assoc_crossover.json, K ∈ {2,4,8} × T ∈ {128..2048},
+# B=64 batched + single-series): the sequential scan won every batched
+# point by 2-20x — XLA:CPU retires the tiny per-step mat-vec in ~1 µs
+# while the O(K³) scan tree is pure overhead on a machine the vmapped
+# batch already saturates — so the table is empty and "auto" on CPU
+# always picks the scan. (A few single-series long-T Viterbi/FFBS
+# points did favor assoc, but the recorded rule is the batched
+# filter+viterbi pair; force time_parallel=True for those paths.)
+#
+# TPU row: also empty UNTIL `scripts/tpu_assoc_probe.py` runs on
+# hardware — the dispatch defaults only to MEASURED winners. Theory
+# says the log-depth form should win where the chip is latency-bound
+# on scan glue (K ≤ 4, T ≥ 1024, the zig-zag windows), but shipping
+# theory rows would route every generic TPU decode into per-draw
+# [T-1, K, K] operator materialization — the round-4 HBM regression —
+# on an unmeasured bet. `time_parallel=True` is the explicit opt-in;
+# a stale table is visible, not silent: `bench.py --assoc-sweep`
+# records `winner` next to `dispatch_auto` per (K, T) point.
+ASSOC_CROSSOVER = {
+    "cpu": (),
+    "tpu": (),
+    "default": (),
+}
+
+
+def _platform() -> str:
+    return jax.default_backend()
+
+
+def use_assoc(
+    K: int,
+    T: int,
+    time_parallel: TimeParallel = "auto",
+    platform: Optional[str] = None,
+) -> bool:
+    """Resolve a ``time_parallel`` setting to a concrete choice for a
+    (K, T) shape: explicit ``True``/``False`` pass through; ``"auto"``
+    consults the measured crossover table for the active backend."""
+    if time_parallel is True or time_parallel is False:
+        return time_parallel
+    if time_parallel != "auto":
+        raise ValueError(
+            f"time_parallel must be True, False, or 'auto', got {time_parallel!r}"
+        )
+    table = ASSOC_CROSSOVER.get(
+        platform or _platform(), ASSOC_CROSSOVER["default"]
+    )
+    for k_max, t_min in table:
+        if K <= k_max:
+            return T >= t_min
+    return False
+
+
+def forward_filter_dispatch(
+    log_pi, log_A, log_obs, mask=None, *, time_parallel: TimeParallel = "auto"
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """:func:`~hhmm_tpu.kernels.filtering.forward_filter` contract,
+    routed to the sequential scan or the associative-scan kernel by the
+    measured (K, T) crossover."""
+    T, K = log_obs.shape
+    if use_assoc(K, T, time_parallel):
+        return forward_filter_assoc(log_pi, log_A, log_obs, mask)
+    return forward_filter(log_pi, log_A, log_obs, mask)
+
+
+def backward_dispatch(
+    log_A, log_obs, mask=None, *, time_parallel: TimeParallel = "auto"
+) -> jnp.ndarray:
+    """:func:`~hhmm_tpu.kernels.filtering.backward_pass` contract with
+    crossover routing."""
+    T, K = log_obs.shape
+    if use_assoc(K, T, time_parallel):
+        return backward_assoc(log_A, log_obs, mask)
+    return backward_pass(log_A, log_obs, mask)
+
+
+def smooth_dispatch(
+    log_pi, log_A, log_obs, mask=None, *, time_parallel: TimeParallel = "auto"
+):
+    """:func:`~hhmm_tpu.kernels.filtering.forward_backward` contract
+    (``log_alpha, log_beta, log_gamma, loglik``) with crossover
+    routing — both passes take the same branch."""
+    T, K = log_obs.shape
+    if use_assoc(K, T, time_parallel):
+        return smooth_assoc(log_pi, log_A, log_obs, mask)
+    return forward_backward(log_pi, log_A, log_obs, mask)
+
+
+def viterbi_dispatch(
+    log_pi, log_A, log_obs, mask=None, *, time_parallel: TimeParallel = "auto"
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """:func:`~hhmm_tpu.kernels.viterbi.viterbi` contract with
+    crossover routing."""
+    T, K = log_obs.shape
+    if use_assoc(K, T, time_parallel):
+        return viterbi_assoc(log_pi, log_A, log_obs, mask)
+    return viterbi(log_pi, log_A, log_obs, mask)
+
+
+def _fused_ffbs_likely(log_pi, log_A, log_obs) -> bool:
+    """Single-series analog of `kernels/vg.py`'s batched Pallas
+    eligibility: on TPU the fused FFBS kernel (one launch per draw,
+    recursion state in VMEM) beats the assoc form wherever it applies —
+    the measured ladder in `bench.py` has it 6.5× the scan path, while
+    assoc's win over the scan is bounded by the depth saving."""
+    if _platform() != "tpu":
+        return False
+    if log_A.ndim != 2:
+        return False
+    return all(a.dtype == jnp.float32 for a in (log_pi, log_A, log_obs))
+
+
+def ffbs_dispatch(
+    key,
+    log_pi,
+    log_A,
+    log_obs,
+    mask=None,
+    gate_key=None,
+    state_key=None,
+    *,
+    time_parallel: TimeParallel = "auto",
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """FFBS draw ``(z [T] int32, loglik)`` with crossover routing.
+
+    ``"auto"`` prefers :func:`~hhmm_tpu.kernels.ffbs.ffbs_fused`
+    wherever the fused Pallas kernel is in play (TPU, homogeneous f32 —
+    it dominates both scan and assoc there), the associative-scan FFBS
+    past the (K, T) crossover otherwise, and the sequential scan below
+    it. The same pre-drawn-uniform convention everywhere means the
+    routes are draw-for-draw interchangeable. Time-varying ``log_A``
+    (no gate-key form) always takes the sequential forward filter +
+    :func:`~hhmm_tpu.kernels.ffbs.backward_sample` (Gumbel draws —
+    identical to :func:`~hhmm_tpu.kernels.ffbs.ffbs_sample`).
+    """
+    if log_A.ndim == 3:
+        if gate_key is not None:
+            raise ValueError("gate keys require homogeneous log_A")
+        log_alpha, ll = forward_filter(log_pi, log_A, log_obs, mask)
+        return backward_sample(key, log_alpha, log_A, mask), ll
+    T, K = log_obs.shape
+    tp = time_parallel
+    if tp == "auto" and _fused_ffbs_likely(log_pi, log_A, log_obs):
+        tp = False
+    if use_assoc(K, T, tp):
+        return ffbs_assoc_sample(
+            key, log_pi, log_A, log_obs, mask, gate_key, state_key
+        )
+    if gate_key is None:
+        return ffbs_fused(key, log_pi, log_A, log_obs, mask)
+    return ffbs_fused(key, log_pi, log_A, log_obs, mask, gate_key, state_key)

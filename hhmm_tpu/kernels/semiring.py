@@ -1,0 +1,118 @@
+"""Semiring algebra for time-parallel HMM kernels.
+
+Särkkä & García-Fernández (2020) show the whole Bayesian
+filter/smoother family is a prefix product in an associative semiring;
+Blelloch (1990) prefix sums evaluate any such product at O(log T)
+depth. Every recursion in :mod:`hhmm_tpu.kernels` is an instance, each
+with its own semiring — this module owns the three algebras so the
+scan kernels (`kernels/assoc.py`) share one audited implementation:
+
+========================  =====================  ==========================
+recursion                 semiring               element
+========================  =====================  ==========================
+forward filter / beta     (logsumexp, +)         [K, K] log-potential matrix
+Viterbi delta             (max, +)               [K, K] log-potential matrix
+backtrack / FFBS draws    (∘) map composition    [K] int K→K index map
+========================  =====================  ==========================
+
+The (logsumexp, +) and (max, +) products share the same operand layout:
+``M_t[i, j] = log_A_t[i, j] + log_obs[t, j]``, built once by
+:func:`step_operators`. Masked (padding) steps substitute the semiring
+identity (0 diagonal, −inf off-diagonal), reproducing the carry-copy
+semantics of the sequential kernels, so the time-parallel kernels accept
+the same ragged-batch masks.
+
+Impossible-evidence hygiene: an all-(−inf) row/column (fully gated
+transition, impossible observation) must degrade to a −inf result like
+``safe_log_normalize`` — not NaN. The risk spot is exactly the semiring
+combine: a plain logsumexp of an all-(−inf) fiber has NaN cotangents
+(softmax of −inf is 0/0), and its max-shift can produce NaN *values* in
+naive implementations. Every (logsumexp, +) combine therefore routes
+through the guarded :func:`hhmm_tpu.core.lmath.safe_logsumexp`;
+`scripts/check_guards.py` statically enforces that no raw
+``jnp.logaddexp``/``jax.nn.logsumexp`` sneaks into this module or
+`kernels/assoc.py`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from hhmm_tpu.core.lmath import safe_logsumexp
+
+__all__ = [
+    "logsumexp_matmul",
+    "maxplus_matmul",
+    "semiring_eye",
+    "compose_maps",
+    "identity_map",
+    "step_operators",
+]
+
+
+def logsumexp_matmul(Pm: jnp.ndarray, Qm: jnp.ndarray) -> jnp.ndarray:
+    """(logsumexp, +) matrix product:
+    ``(P ⊗ Q)[..., i, j] = logsumexp_k(P[..., i, k] + Q[..., k, j])``.
+
+    Associative; its prefix products evaluate the forward filter, its
+    suffix products the backward (beta) recursion. The combine is the
+    guarded reduction: an all-(−inf) fiber (impossible evidence / fully
+    gated column) yields −inf with zero — not NaN — cotangents.
+    """
+    return safe_logsumexp(Pm[..., :, :, None] + Qm[..., None, :, :], axis=-2)
+
+
+def maxplus_matmul(Pm: jnp.ndarray, Qm: jnp.ndarray) -> jnp.ndarray:
+    """(max, +) matrix product:
+    ``(P ⊗ Q)[..., i, j] = max_k(P[..., i, k] + Q[..., k, j])`` — the
+    Viterbi delta recursion's combine. −inf entries stay −inf (no NaN:
+    max has no normalizing shift)."""
+    return jnp.max(Pm[..., :, :, None] + Qm[..., None, :, :], axis=-2)
+
+
+def semiring_eye(K: int, dtype) -> jnp.ndarray:
+    """Multiplicative identity of both log-space semirings: 0 diagonal,
+    −inf off-diagonal (⊗ by it is a copy — the masked-step no-op)."""
+    return jnp.where(jnp.eye(K, dtype=bool), 0.0, -jnp.inf).astype(dtype)
+
+
+def compose_maps(Fm: jnp.ndarray, Gm: jnp.ndarray) -> jnp.ndarray:
+    """K-ary index-map composition ``(F ∘ G)[..., j] = F[..., G[..., j]]``.
+
+    A [K] int array is a map K→K; composition is associative, so a
+    (reverse) associative scan over per-step backpointer/sampling maps
+    evaluates every suffix composition — the parallel backtrack of
+    `viterbi_assoc` and the parallel backward draw of `ffbs_assoc` — at
+    O(log T) depth.
+    """
+    return jnp.take_along_axis(Fm, Gm, axis=-1)
+
+
+def identity_map(K: int) -> jnp.ndarray:
+    """Identity of map composition: ``arange(K)`` (the masked-step
+    backpointer of the sequential Viterbi kernel)."""
+    return jnp.arange(K, dtype=jnp.int32)
+
+
+def step_operators(
+    log_A: jnp.ndarray,
+    log_obs: jnp.ndarray,
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Per-step semiring operands ``M[t-1][i, j] = log_A_t[i, j] +
+    log_obs[t, j]`` for t = 1..T−1 (shape [T−1, K, K]; ``log_A`` may be
+    homogeneous [K, K] or time-varying [T−1, K, K]). Masked steps are
+    replaced by the semiring identity so ⊗-ing them copies the carry —
+    identical to the sequential kernels' masked no-op. Shared by the
+    (logsumexp, +) and (max, +) kernels, which use the same operands.
+    """
+    T, K = log_obs.shape
+    lA = log_A if log_A.ndim == 3 else jnp.broadcast_to(log_A, (T - 1, K, K))
+    M = lA + log_obs[1:, None, :]
+    if mask is not None:
+        M = jnp.where(
+            mask[1:, None, None] > 0, M, semiring_eye(K, log_obs.dtype)[None]
+        )
+    return M

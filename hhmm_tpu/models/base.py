@@ -28,12 +28,11 @@ import numpy as np
 
 from hhmm_tpu.core.bijectors import Bijector
 from hhmm_tpu.kernels import (
+    ffbs_dispatch,
     ffbs_sample,
-    forward_filter,
     forward_loglik,
-    backward_pass,
-    smooth,
-    viterbi,
+    smooth_dispatch,
+    viterbi_dispatch,
 )
 
 __all__ = ["BaseHMMModel", "semisup_gate"]
@@ -222,22 +221,35 @@ class BaseHMMModel:
         inits mirroring the reference drivers)."""
         return 0.5 * jax.random.normal(key, (self.n_free,))
 
-    def generated(self, theta_draws: jnp.ndarray, data: Data) -> Dict[str, jnp.ndarray]:
+    def generated(
+        self,
+        theta_draws: jnp.ndarray,
+        data: Data,
+        time_parallel="auto",
+    ) -> Dict[str, jnp.ndarray]:
         """Per-draw generated quantities, vmapped over posterior draws.
 
         Returns ``alpha`` (filtered probs, normalized per t), ``gamma``
         (smoothed probs), ``zstar`` (Viterbi path), ``logp_zstar`` —
         the reference's ``alpha_tk / gamma_tk / zstar_t`` outputs
         (`hmm/stan/hmm.stan:48-130`).
+
+        ``time_parallel`` routes the forward/backward/Viterbi recursions
+        through the (K, T) crossover dispatch (`kernels/dispatch.py`):
+        ``"auto"`` picks sequential scan or the O(log T)-depth
+        associative-scan kernels from the measured table; ``True`` /
+        ``False`` force a branch.
         """
 
         def one(theta):
             params, _ = self.unpack(theta)
             log_pi, log_A, log_obs, mask = self.build(params, data)
-            log_alpha, ll = forward_filter(log_pi, log_A, log_obs, mask)
-            log_beta = backward_pass(log_A, log_obs, mask)
-            log_gamma = smooth(log_alpha, log_beta)
-            zstar, logp_zstar = viterbi(log_pi, log_A, log_obs, mask)
+            log_alpha, _, log_gamma, ll = smooth_dispatch(
+                log_pi, log_A, log_obs, mask, time_parallel=time_parallel
+            )
+            zstar, logp_zstar = viterbi_dispatch(
+                log_pi, log_A, log_obs, mask, time_parallel=time_parallel
+            )
             alpha = jax.nn.softmax(log_alpha, axis=-1)
             return {
                 "alpha": alpha,
@@ -250,7 +262,11 @@ class BaseHMMModel:
         return _vmap_over_draws(one, theta_draws)
 
     def state_draws(
-        self, key: jax.Array, theta_draws: jnp.ndarray, data: Data
+        self,
+        key: jax.Array,
+        theta_draws: jnp.ndarray,
+        data: Data,
+        time_parallel="auto",
     ) -> jnp.ndarray:
         """Exact joint posterior draws of the state path: one FFBS
         (forward-filter backward-sample) path per posterior parameter
@@ -260,6 +276,12 @@ class BaseHMMModel:
         (SURVEY.md §7.1 item 2); this is the explicit TPU-native path.
 
         ``theta_draws`` [..., dim]; returns int32 paths [..., T].
+
+        ``time_parallel`` follows :meth:`generated`: homogeneous models
+        route through the FFBS crossover dispatch (fused Pallas kernel /
+        O(log T) associative form / sequential scan); time-varying
+        models keep the sequential Gumbel-based :func:`ffbs_sample`
+        (identical target distribution on every route).
         """
         n_draws = int(np.prod(theta_draws.shape[:-1], dtype=np.int64))
         keys = jax.random.split(key, n_draws)
@@ -268,7 +290,12 @@ class BaseHMMModel:
         def one(theta, k):
             params, _ = self.unpack(theta)
             log_pi, log_A, log_obs, mask = self.build(params, data)
-            return ffbs_sample(k, log_pi, log_A, log_obs, mask)
+            if log_A.ndim == 3:
+                return ffbs_sample(k, log_pi, log_A, log_obs, mask)
+            z, _ = ffbs_dispatch(
+                k, log_pi, log_A, log_obs, mask, time_parallel=time_parallel
+            )
+            return z
 
         return _vmap_over_draws(one, theta_draws, keys)
 

@@ -50,7 +50,11 @@ import numpy as np
 
 from hhmm_tpu.core.bijectors import Bijector, Simplex, UnitInterval
 from hhmm_tpu.core.lmath import safe_log, MASK_NEG
-from hhmm_tpu.kernels import forward_filter, viterbi
+from hhmm_tpu.kernels import (
+    forward_filter_assoc,
+    use_assoc,
+    viterbi_dispatch,
+)
 from hhmm_tpu.models.base import BaseHMMModel
 
 __all__ = ["TayalHHMM", "TayalHHMMLite", "UP", "DOWN"]
@@ -256,12 +260,27 @@ class TayalHHMMLite(TayalHHMM):
     materialized scan (its consumer reads only the short OOS segment,
     and XLA dead-code-eliminates it from the decode's median-α jit)."""
 
-    def _seg_alpha(self, params, x, sign, mask):
+    def _seg_alpha(self, params, x, sign, mask, time_parallel="auto"):
         """Filtered log-alpha for one segment through the canonical
         hot-loop contract (build_vg + gate_keys — the same pair the
-        training path uses, so the decode cannot drift from it)."""
+        training path uses, so the decode cannot drift from it).
+
+        ``time_parallel``: past the measured (K, T) crossover the
+        O(log T)-depth associative filter takes over — but only where
+        the fused Pallas forward is NOT in play (``"auto"`` on TPU
+        keeps ``forward_alpha``: its chunked kernel streams alpha
+        through VMEM, whereas the assoc path re-materializes the
+        [T-1, K, K] gated kernel per draw, the round-4 HBM regression
+        this decode was rebuilt to avoid)."""
         from hhmm_tpu.kernels.alpha_fused import forward_alpha
 
+        tp = time_parallel
+        if tp == "auto" and jax.default_backend() == "tpu":
+            tp = False
+        if use_assoc(self.K, int(jnp.asarray(x).shape[0]), tp):
+            log_pi, log_A_t, log_obs = self._gated(params, x, sign)
+            la, _ = forward_filter_assoc(log_pi, log_A_t, log_obs, mask)
+            return la
         seg = {"x": x, "sign": sign}
         log_pi, log_A, log_obs, _ = self.build_vg(params, seg)
         gk = self.gate_keys(seg)
@@ -270,20 +289,24 @@ class TayalHHMMLite(TayalHHMM):
         )
         return la
 
-    def generated(self, theta_draws, data):
+    def generated(self, theta_draws, data, time_parallel="auto"):
         mask, mask_o = data.get("mask"), data.get("mask_oos")
 
         def one(theta):
             params, _ = self.unpack(theta)
             # in-sample + OOS filtered probabilities (OOS restarts from pi)
-            log_alpha = self._seg_alpha(params, data["x"], data["sign"], mask)
+            log_alpha = self._seg_alpha(
+                params, data["x"], data["sign"], mask, time_parallel
+            )
             log_alpha_o = self._seg_alpha(
-                params, data["x_oos"], data["sign_oos"], mask_o
+                params, data["x_oos"], data["sign_oos"], mask_o, time_parallel
             )
             log_pi_o, log_A_o, log_obs_o = self._gated(
                 params, data["x_oos"], data["sign_oos"]
             )
-            zstar_o, _ = viterbi(log_pi_o, log_A_o, log_obs_o, mask_o)
+            zstar_o, _ = viterbi_dispatch(
+                log_pi_o, log_A_o, log_obs_o, mask_o, time_parallel=time_parallel
+            )
             return {
                 "alpha": jax.nn.softmax(log_alpha, axis=-1),
                 "alpha_oos": jax.nn.softmax(log_alpha_o, axis=-1),

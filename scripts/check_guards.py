@@ -22,10 +22,20 @@ depend on:
    with a bare ``log_normalize`` would turn impossible evidence into
    NaN state instead of the −inf floor the scheduler's quarantine mask
    detects (`serve/scheduler.py`).
+4. **Semiring combines use the guarded reduction**: the time-parallel
+   kernels (`kernels/semiring.py`, `kernels/assoc.py`) must import
+   ``safe_logsumexp`` from ``hhmm_tpu.core.lmath`` and call it, and
+   must NOT touch any raw logsumexp — no ``jnp.logaddexp`` /
+   ``jax.nn.logsumexp`` attribute access, no un-guarded ``logsumexp``
+   import. Semiring *identity elements are −inf by construction*, so
+   an all-identity fiber (masked run, impossible evidence) hits the
+   all-(−inf) reduction edge case on every combine; a raw logsumexp
+   there has NaN cotangents and, in naive forms, NaN values
+   (docs/parallel_scan.md).
 
 Exit 0 when clean, 1 with one line per violation. Run by
-``tests/test_robust.py`` (and re-asserted by ``tests/test_serve.py``)
-so the pass is enforced in tier-1.
+``tests/test_robust.py`` (and re-asserted by ``tests/test_serve.py``
+and ``tests/test_assoc.py``) so the pass is enforced in tier-1.
 """
 
 from __future__ import annotations
@@ -50,6 +60,21 @@ SERVE_MODULES = {
     "hhmm_tpu/serve/online.py": ("safe_log_normalize",),
 }
 LMATH_MODULES = ("hhmm_tpu.core.lmath", "hhmm_tpu.core")
+
+# time-parallel kernel modules: every semiring combine must be the
+# guarded reduction (invariant 4 in the module docstring)
+SEMIRING_MODULES = (
+    "hhmm_tpu/kernels/semiring.py",
+    "hhmm_tpu/kernels/assoc.py",
+)
+# attribute names whose access anywhere in a semiring module means a
+# raw (unguarded) log-space reduction slipped in
+RAW_LSE_ATTRS = ("logaddexp", "logsumexp")
+# lmath helpers that WRAP the raw reduction (NaN cotangents on the
+# all-(−inf) columns the −inf semiring identities create) — importing
+# them into a semiring module is the loophole the attribute scan above
+# cannot see
+RAW_LSE_WRAPPERS = ("logsumexp", "log_vecmat", "log_matvec", "log_normalize")
 
 
 def _bare_excepts(path: pathlib.Path, rel: str, problems: List[str]) -> None:
@@ -120,6 +145,49 @@ def check(root: pathlib.Path) -> List[str]:
         "guarded normalization",
         "the online step is unguarded",
     )
+
+    # invariant 4: semiring combines use the guarded logsumexp only
+    for rel in SEMIRING_MODULES:
+        path = root / rel
+        if not path.is_file():
+            problems.append(f"{rel}: time-parallel kernel module missing")
+            continue
+        tree = ast.parse(path.read_text(), filename=str(path))
+        imported = _imported_symbols(tree, LMATH_MODULES)
+        if "safe_logsumexp" not in imported:
+            problems.append(
+                f"{rel}: does not import safe_logsumexp from "
+                f"{LMATH_MODULES[0]} — semiring combines would be unguarded"
+            )
+        elif "safe_logsumexp" not in _called_names(tree):
+            problems.append(
+                f"{rel}: imports safe_logsumexp but never calls it — "
+                "semiring combines are unguarded"
+            )
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr in RAW_LSE_ATTRS
+            ):
+                problems.append(
+                    f"{rel}:{node.lineno}: raw `.{node.attr}` — semiring "
+                    "combines must use the guarded safe_logsumexp from "
+                    "hhmm_tpu.core.lmath"
+                )
+            if isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    if (
+                        alias.name in RAW_LSE_ATTRS
+                        and node.module not in LMATH_MODULES
+                    ) or (
+                        alias.name in RAW_LSE_WRAPPERS
+                        and node.module in LMATH_MODULES
+                    ):
+                        problems.append(
+                            f"{rel}:{node.lineno}: imports raw "
+                            f"`{alias.name}` from {node.module} — use "
+                            "safe_logsumexp from hhmm_tpu.core.lmath"
+                        )
     return problems
 
 
@@ -137,7 +205,7 @@ def main(argv: List[str]) -> int:
         return 1
     print(
         "check_guards: ok (no bare excepts; all samplers guarded; "
-        "online serve step guarded)"
+        "online serve step guarded; semiring combines guarded)"
     )
     return 0
 

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Measure the sequential-scan vs associative-scan crossover (K, T)
+grid that `kernels/dispatch.py` dispatches on (mirrors
+`tpu_pack2_probe.py`'s discipline: the dispatcher only adopts assoc
+where this measurement says it wins).
+
+Grid: K ∈ {2, 4, 8} × T ∈ {128, 256, 512, 1024, 2048, 4096}, three
+kernels per point — forward filter, Viterbi, FFBS — timed twice each:
+single-series jitted (the latency-bound decode path) and vmapped over a
+B=64 batch (the throughput path; batching already fills the machine, so
+the assoc win shrinks and the batched crossover is the honest one for
+dispatch defaults). Fresh pre-generated device inputs per timed call
+(host RNG + H2D outside the window), ``block_until_ready`` + host
+reduction — the tunnel-discipline rules of `tpu_pack2_probe.py`.
+
+Writes `results/assoc_crossover.json`: per-point ms/call for both
+branches plus a derived ``crossover`` block — for each K, the smallest
+grid T where assoc wins both the filter and Viterbi timings (batched) —
+in the exact ``(K_max, T_min)`` row shape of
+``kernels/dispatch.ASSOC_CROSSOVER``, ready to paste. Run with
+``--cpu`` on a CI host (records the cpu table) or on TPU hardware
+(records the tpu table). Wall target < 4 min.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:  # runnable as `python scripts/tpu_assoc_probe.py`
+    sys.path.insert(0, _ROOT)
+
+OUT = os.path.join(
+    os.path.dirname(__file__), "..", "results", "assoc_crossover.json"
+)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true", help="probe the CPU backend")
+    ap.add_argument("--reps", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument(
+        "--Ts", nargs="*", type=int, default=[128, 256, 512, 1024, 2048, 4096]
+    )
+    ap.add_argument("--Ks", nargs="*", type=int, default=[2, 4, 8])
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    if not args.cpu:
+        assert jax.default_backend() == "tpu", jax.default_backend()
+
+    from hhmm_tpu.kernels import (
+        ffbs_assoc_sample,
+        ffbs_fused,
+        forward_filter,
+        forward_filter_assoc,
+        viterbi,
+        viterbi_assoc,
+    )
+
+    backend = jax.default_backend()
+    rng = np.random.default_rng(7)
+    B, reps = args.batch, args.reps
+
+    def timed(fn, arg_sets):
+        """Mean seconds/call over ``reps`` calls with fresh inputs each
+        (arg_sets pre-staged on device; compile on set -1)."""
+        out = fn(*arg_sets[-1])
+        jax.block_until_ready(out)
+        t0 = time.time()
+        for r in range(reps):
+            jax.block_until_ready(fn(*arg_sets[r]))
+        return (time.time() - t0) / reps
+
+    def inputs(K, T, batch=None):
+        shp = () if batch is None else (batch,)
+        log_pi = jnp.asarray(
+            np.log(rng.dirichlet(np.ones(K), shp or None)), jnp.float32
+        )
+        log_A = jnp.asarray(
+            np.log(rng.dirichlet(np.ones(K), shp + (K,))), jnp.float32
+        )
+        log_obs = jnp.asarray(rng.normal(size=shp + (T, K)) - 1.0, jnp.float32)
+        mask = jnp.ones(shp + (T,), jnp.float32)
+        return log_pi, log_A, log_obs, mask
+
+    rec = {
+        "device": str(jax.devices()[0]),
+        "backend": backend,
+        "ts": time.strftime("%F %T"),
+        "reps": reps,
+        "batch": B,
+        "points": [],
+    }
+    kernels = {
+        "filter": (
+            lambda lp, lA, lo, m: forward_filter(lp, lA, lo, m)[1],
+            lambda lp, lA, lo, m: forward_filter_assoc(lp, lA, lo, m)[1],
+        ),
+        "viterbi": (
+            lambda lp, lA, lo, m: viterbi(lp, lA, lo, m)[0],
+            lambda lp, lA, lo, m: viterbi_assoc(lp, lA, lo, m)[0],
+        ),
+        "ffbs": (
+            lambda lp, lA, lo, m: ffbs_fused(
+                jax.random.PRNGKey(0), lp, lA, lo, m
+            )[0],
+            lambda lp, lA, lo, m: ffbs_assoc_sample(
+                jax.random.PRNGKey(0), lp, lA, lo, m
+            )[0],
+        ),
+    }
+    for K in args.Ks:
+        for T in args.Ts:
+            point = {"K": K, "T": T}
+            for name, (seq_fn, assoc_fn) in kernels.items():
+                for tag, batch in (("", None), ("_b", B)):
+                    sets = [inputs(K, T, batch) for _ in range(reps + 1)]
+                    jax.block_until_ready(sets)
+                    f_seq = jax.jit(
+                        jax.vmap(seq_fn) if batch else seq_fn
+                    )
+                    f_assoc = jax.jit(
+                        jax.vmap(assoc_fn) if batch else assoc_fn
+                    )
+                    t_seq = timed(f_seq, sets)
+                    t_assoc = timed(f_assoc, sets)
+                    point[f"{name}{tag}_seq_ms"] = round(t_seq * 1e3, 3)
+                    point[f"{name}{tag}_assoc_ms"] = round(t_assoc * 1e3, 3)
+                    point[f"{name}{tag}_speedup"] = round(t_seq / t_assoc, 3)
+            rec["points"].append(point)
+            print(json.dumps(point), flush=True)
+
+    # derived dispatch rows: per K, smallest grid T where assoc wins
+    # BOTH the batched filter and batched viterbi (the decode pair the
+    # sweep gate tracks); None = never within the grid
+    crossover = []
+    for K in args.Ks:
+        t_min = None
+        for p in sorted(
+            (p for p in rec["points"] if p["K"] == K), key=lambda p: p["T"]
+        ):
+            if p["filter_b_speedup"] > 1.0 and p["viterbi_b_speedup"] > 1.0:
+                t_min = p["T"]
+                break
+        crossover.append({"K_max": K, "T_min": t_min})
+    rec["crossover"] = {
+        "rows": crossover,
+        "note": "paste non-null rows into kernels/dispatch.ASSOC_CROSSOVER"
+        f"[{backend!r}] as ((K_max, T_min), ...)",
+    }
+    print(json.dumps(rec["crossover"]))
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w") as f:
+        json.dump(rec, f, indent=1)
+    print("wrote", OUT)
+
+
+if __name__ == "__main__":
+    main()

@@ -118,6 +118,7 @@ class TestCrossSamplerAgreement:
 
 
 class TestRaggedChunk:
+    @pytest.mark.slow
     def test_ragged_final_chunk_runs_and_pools_weighted(self, rng):
         """B not divisible by chunk_size: the final chunk is padded by
         repeating the last series; those duplicates carry zero weight in
@@ -191,6 +192,7 @@ class TestAppHarnesses:
 
 class TestSBCChEES:
     @pytest.mark.parametrize("max_leapfrogs", [256, 16])
+    @pytest.mark.slow
     def test_rank_uniformity_multinomial(self, rng, max_leapfrogs):
         """SBC through the batched engine with the ChEES sampler: ranks
         of prior draws among posterior draws must be uniform (the same

@@ -20,6 +20,7 @@ def test_all_examples_compile():
         py_compile.compile(os.path.join(_EXAMPLES, f), doraise=True)
 
 
+@pytest.mark.slow
 def test_hmm_main_quick_runs():
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     out = subprocess.run(

@@ -63,6 +63,7 @@ class TestGuards:
 
 
 class TestCrossSamplerAgreement:
+    @pytest.mark.slow
     def test_matches_nuts_on_multinomial_hmm(self):
         """Gibbs and NUTS target the identical flat-prior posterior;
         pooled canonicalized posterior means must agree to MC error."""
@@ -99,6 +100,7 @@ class TestCrossSamplerAgreement:
         assert np.isfinite(np.asarray(sg["logp"])).all()
         np.testing.assert_allclose(canon(qg), canon(qn), atol=0.05)
 
+    @pytest.mark.slow
     def test_matches_nuts_on_gaussian_hmm(self):
         """NIG-prior Gaussian HMM: Gibbs (FFBS + joint NIG block with
         ordered-cone accept step) and NUTS with the same ``log_prior``
@@ -360,6 +362,7 @@ class TestStanGateConjugacy:
                 emp[a, b] = np.mean((paths[:, 2] == a) & (paths[:, 3] == b))
         np.testing.assert_allclose(emp, pair, atol=0.03)
 
+    @pytest.mark.slow
     def test_semisup_gibbs_matches_nuts_on_stan_gate(self, rng):
         """Cross-sampler agreement for the semisup soft gate: the
         consistency-weighted conjugate block must target the same
@@ -418,6 +421,7 @@ class TestStanGateConjugacy:
         assert np.isfinite(np.asarray(sg["logp"])).all()
         np.testing.assert_allclose(canon(qg), canon(qn), atol=0.06)
 
+    @pytest.mark.slow
     def test_gibbs_matches_chees_on_stan_gate(self, rng):
         """Cross-sampler agreement on the soft-gate density with
         non-alternating data — the pair (z|θ exact FFBS, θ|z conjugate)
@@ -460,6 +464,7 @@ class TestStanGateConjugacy:
 
 
 class TestSBCGibbs:
+    @pytest.mark.slow
     def test_rank_uniformity_tayal(self, rng):
         """SBC through fit_batched with the Gibbs sampler on the Tayal
         hard-gate model (the bench.py --sampler gibbs path): ranks of
@@ -522,6 +527,7 @@ class TestSBCGibbs:
 
 
 class TestWalkForwardGibbs:
+    @pytest.mark.slow
     def test_tayal_wf_trade_with_gibbs(self, tmp_path, tayal_wf_tasks):
         """The Tayal walk-forward harness runs end-to-end with the Gibbs
         sampler: TayalHHMMLite inherits the conjugate block, hard gate

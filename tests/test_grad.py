@@ -101,6 +101,7 @@ def test_vmap_grad(rng):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_model_logp_grad_unchanged(rng):
     """End-to-end: TayalHHMM make_logp gradient equals the pre-VJP path."""
     from hhmm_tpu.models import TayalHHMM

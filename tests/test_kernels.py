@@ -104,6 +104,7 @@ def test_forward_loglik_gradient_finite(rng):
     np.testing.assert_allclose(np.sum(np.asarray(g), axis=1), 1.0, rtol=5e-4)
 
 
+@pytest.mark.slow
 def test_ffbs_marginals_match_smoothing(rng):
     """FFBS empirical state frequencies converge to the smoothed marginals."""
     log_pi, log_A, log_obs = oracle.random_hmm(rng, 3, 12)
@@ -119,6 +120,7 @@ def test_ffbs_marginals_match_smoothing(rng):
     np.testing.assert_allclose(freq, np.exp(lg), atol=0.03)
 
 
+@pytest.mark.slow
 def test_ffbs_pairwise_consistency(rng):
     """FFBS joint (z_t, z_{t+1}) frequencies match brute-force pairwise posterior."""
     from itertools import product

@@ -67,6 +67,7 @@ def test_gaussian_hmm_recovery():
     assert acc > 0.9
 
 
+@pytest.mark.slow
 def test_multinomial_hmm_recovery():
     A = np.array([[0.85, 0.15], [0.25, 0.75]])
     p1 = np.array([0.5, 0.5])
@@ -301,6 +302,7 @@ def test_tayal_stan_parity_oracle():
     np.testing.assert_allclose(ll - float(ldj), ll_oracle, rtol=5e-4, atol=5e-3)
 
 
+@pytest.mark.slow
 def test_tayal_lite_oos_outputs():
     A, p1, phi, z, x, sign = _simulate_tayal(jax.random.PRNGKey(13), T=400)
     model = TayalHHMMLite(L=9, gate_mode="hard")

@@ -126,6 +126,7 @@ class TestDispatcher:
 
 
 class TestSamplerVgPath:
+    @pytest.mark.slow
     def test_vg_matches_logp_path(self, rng):
         """sample_nuts(vg_fn=...) reproduces the logp path exactly on CPU
         (identical numerics -> identical chains)."""
@@ -147,6 +148,7 @@ class TestSamplerVgPath:
             np.asarray(qs_a), np.asarray(qs_b), rtol=1e-4, atol=1e-5
         )
 
+    @pytest.mark.slow
     def test_vg_vmapped_over_series(self, rng):
         """The bench structure: vmap over series around sample_nuts."""
         from hhmm_tpu.infer import SamplerConfig, sample_nuts

@@ -102,6 +102,7 @@ class TestGuardHelpers:
 
 
 class TestNutsGuard:
+    @pytest.mark.slow
     def test_nan_grad_mid_scan_quarantines_exactly_one_chain(self):
         """The acceptance-criteria scenario: NaN into one chain's
         gradient mid-scan -> all other chains bit-identical, exactly
@@ -124,6 +125,7 @@ class TestNutsGuard:
         # pre-fault draws of the injected chain match the control
         np.testing.assert_array_equal(qs1[1, :5], qs0[1, :5])
 
+    @pytest.mark.slow
     def test_noop_plan_is_bitwise_control(self):
         """A never-firing plan traces the same program as no plan at
         all AND produces identical draws — the control is honest."""
@@ -454,6 +456,7 @@ def multinom_setup():
 
 
 class TestFitCrashResume:
+    @pytest.mark.slow
     def test_crash_between_chunks_resumes_bitwise(self, multinom_setup, tmp_path, capsys):
         """Satellite: chunked dispatch resuming after a simulated crash
         between chunks — completed chunks are cache hits, and the

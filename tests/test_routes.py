@@ -88,6 +88,7 @@ class TestRouteIdentity:
 
 
 class TestTreeGibbs:
+    @pytest.mark.slow
     def test_agreement_with_chees_hier2x2(self):
         """Posterior means agree with ChEES on the identical model —
         exactness evidence for the route-augmented conjugate block."""
@@ -124,6 +125,7 @@ class TestTreeGibbs:
                 mg[k], mc[k], atol=0.1, err_msg=f"param {k}"
             )
 
+    @pytest.mark.slow
     def test_jangmin_single_chain_ess(self):
         """The bench workload (semisup hard gate, T=100) at the zoo's
         single-fit convention: ESS(lp) must clear the >= 50 bar."""
@@ -145,6 +147,7 @@ class TestTreeGibbs:
         assert float(ess(lp)) >= 50.0
         assert float(split_rhat(lp)) < 1.05  # within-chain stationarity
 
+    @pytest.mark.slow
     def test_categorical_tree_recovers(self):
         """Categorical-leaf branch of the tree Gibbs (Dirichlet emission
         rows): free transition slots of the Tayal 2x2 tree recovered

@@ -373,6 +373,7 @@ class TestPipeline:
 
 
 class TestPerDrawRelabel:
+    @pytest.mark.slow
     def test_matches_chainwise_analytics_per_draw(self):
         """`per_draw_relabel_stats` must reproduce, draw by draw, the
         numpy analytics chain (topstate_runs + relabel_by_return) run on
@@ -428,6 +429,7 @@ class TestPerDrawRelabel:
 
 
 class TestDeviceMedianDecode:
+    @pytest.mark.slow
     def test_device_reduction_equals_host_median_argmax(self):
         """The wf decode's device-side median-α hard classification
         (shipped as [G, T] int32 instead of [G, D, T, K] f32 — the

@@ -106,6 +106,7 @@ class TestGradients:
             np.asarray(g_ref), np.asarray(g_vg), rtol=3e-4, atol=3e-5
         )
 
+    @pytest.mark.slow
     def test_jangmin_builds_and_differentiates(self):
         tree = jangmin2004_tree()
         m = TreeHMM(tree, order_mu="none")
