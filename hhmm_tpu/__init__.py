@@ -18,6 +18,8 @@ Layer map (see SURVEY.md §7):
   adaptation, Rhat/ESS diagnostics, k-means inits, relabeling.
 - ``parallel`` — mesh sharding for many-series scale-out, result caching.
 - ``robust``   — chain-health guards, self-healing retry, fault injection.
+- ``obs``      — observability: span tracing (``HHMM_TPU_TRACE=1``),
+  compile/memory telemetry, run manifests (`docs/observability.md`).
 - ``serve``    — streaming inference service: online forward-filter core,
   posterior snapshot registry, micro-batching tick scheduler, metrics.
 - ``apps``     — Hassan (2005) forecasting and Tayal (2009) trading

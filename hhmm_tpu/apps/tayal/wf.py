@@ -151,12 +151,12 @@ def wf_trade(
     if key is None:
         key = jax.random.PRNGKey(0)
     tm = phase_timings if phase_timings is not None else {}
-    t_phase = _time.time()
+    t_phase = _time.perf_counter()
 
     def _mark(name):
         nonlocal t_phase
-        tm[name] = round(tm.get(name, 0.0) + _time.time() - t_phase, 2)
-        t_phase = _time.time()
+        tm[name] = round(tm.get(name, 0.0) + _time.perf_counter() - t_phase, 2)
+        t_phase = _time.perf_counter()
 
     model = TayalHHMMLite(gate_mode=gate_mode)
 
@@ -338,7 +338,7 @@ def wf_trade(
         )
 
     sub = defaultdict(float)  # raw-float sub-profile; rounded once below
-    t_sel = _time.time()
+    t_sel = _time.perf_counter()
     leg_states: List[Optional[np.ndarray]] = [None] * B
     meta = []  # per-task (n_ins, n_oos, b_ins, b_oos, keep, draws_thin, dk, n_uniq)
     pend: Dict[tuple, List[int]] = {}
@@ -375,15 +375,15 @@ def wf_trade(
                 {"n_ins": n_ins, "n_uniq": n_uniq},
                 draws_t,
             )
-            t_rd = _time.time()
+            t_rd = _time.perf_counter()
             hit = dcache.get(dk)
-            sub["decode.cache_read"] += _time.time() - t_rd
+            sub["decode.cache_read"] += _time.perf_counter() - t_rd
             if hit is not None:
                 leg_states[i] = np.asarray(hit["leg_state"])
         meta.append((n_ins, n_oos, b_ins, b_oos, keep, draws_t, dk, n_uniq))
         if leg_states[i] is None:
             pend.setdefault((b_ins, b_oos), []).append(i)
-    sub["decode.select"] = _time.time() - t_sel - sub["decode.cache_read"]
+    sub["decode.select"] = _time.perf_counter() - t_sel - sub["decode.cache_read"]
 
     # Device-side median-α classification: the generated pass's full
     # probability stacks ([G, D, T, K] f32 ≈ 250 MB/dispatch) dominated
@@ -410,14 +410,14 @@ def wf_trade(
     # shape (compile+run) vs steady-state dispatches vs host reduction
     # vs cache IO, plus shape/dispatch counts — in the same phase dict
     def _acc(name, t0):
-        sub[name] += _time.time() - t0
-        return _time.time()
+        sub[name] += _time.perf_counter() - t0
+        return _time.perf_counter()
 
     seen_shapes: set = set()
     tm["decode.dispatches"] = 0
     for (b_ins, b_oos), idxs in pend.items():
         for c0 in range(0, len(idxs), G_DEC):
-            t_sub = _time.time()
+            t_sub = _time.perf_counter()
             grp = idxs[c0 : c0 + G_DEC]
             pad_n = G_DEC - len(grp)
             grp_fit = grp + [grp[-1]] * pad_n  # repeat-pad: one compile

@@ -31,6 +31,7 @@ import numpy as np
 
 from hhmm_tpu.batch.cache import ResultCache, digest_key
 from hhmm_tpu.infer.api import sample
+from hhmm_tpu.obs.trace import span
 from hhmm_tpu.infer.chees import ChEESConfig, make_lp_bc, sample_chees_batched
 from hhmm_tpu.infer.gibbs import GibbsConfig, sample_gibbs
 from hhmm_tpu.infer.run import SamplerConfig
@@ -317,9 +318,13 @@ def fit_batched(
             stats = {k: jnp.asarray(v) for k, v in hit.items()}
             print(f"# fit_batched {chunk_label}: cache hit", flush=True)
         else:
-            qs, stats = run_with_device_retry(
-                runner_for(config), chunk_data, chunk_init, chunk_keys, chunk_w
-            )
+            # span boundary (obs/trace.py): the retry wrapper blocks on
+            # the result, so the span covers the device execution
+            with span("batch.fit.chunk") as sp_c:
+                sp_c.annotate(chunk=chunk_label, series=n)
+                qs, stats = run_with_device_retry(
+                    runner_for(config), chunk_data, chunk_init, chunk_keys, chunk_w
+                )
             qs, stats = faults.corrupt_chunk_result(qs, stats, s, n, attempt=0)
 
             # ---- self-healing: re-dispatch series whose chains were
@@ -364,13 +369,15 @@ def fit_batched(
                     + ("" if cfg_r == config else " (escalated config)"),
                     flush=True,
                 )
-                qs2, stats2 = run_with_device_retry(
-                    runner_for(cfg_r),
-                    chunk_data,
-                    jnp.asarray(init_r),
-                    jnp.asarray(keys_r),
-                    chunk_w,
-                )
+                with span("batch.fit.heal") as sp_h:
+                    sp_h.annotate(chunk=chunk_label, attempt=heal_attempt)
+                    qs2, stats2 = run_with_device_retry(
+                        runner_for(cfg_r),
+                        chunk_data,
+                        jnp.asarray(init_r),
+                        jnp.asarray(keys_r),
+                        chunk_w,
+                    )
                 qs2, stats2 = faults.corrupt_chunk_result(
                     qs2, stats2, s, n, attempt=heal_attempt
                 )

@@ -56,6 +56,7 @@ import numpy as np
 from jax import lax
 
 from hhmm_tpu.infer.nuts import find_reasonable_step_size
+from hhmm_tpu.obs.trace import span
 from hhmm_tpu.infer.run import (
     _da_init,
     _da_update,
@@ -482,9 +483,12 @@ def sample_chees_batched(
     fn = run
     if jit:
         fn = jax.jit(run)
-    if fault is None:
-        return fn(key, init_q)
-    return fn(key, init_q, *fault)
+    # host-boundary span (obs/trace.py): sync only while tracing is on
+    with span("infer.chees.sample") as sp:
+        sp.annotate(warmup=config.num_warmup, samples=config.num_samples)
+        if fault is None:
+            return sp.sync(fn(key, init_q))
+        return sp.sync(fn(key, init_q, *fault))
 
 
 def sample_chees(

@@ -51,6 +51,7 @@ from jax import lax
 from hhmm_tpu.kernels.dispatch import ffbs_dispatch
 from hhmm_tpu.kernels.ffbs import backward_sample
 from hhmm_tpu.kernels.filtering import forward_filter
+from hhmm_tpu.obs.trace import span
 from hhmm_tpu.robust import faults
 from hhmm_tpu.robust.guards import all_finite, guard_where
 
@@ -237,7 +238,11 @@ def sample_gibbs(
         args = (jax.random.split(key, C), init_q, *fault)
     if jit:
         fn = jax.jit(fn)
-    qs, lls, healthy, q_step = fn(*args)
+    # host-boundary span (obs/trace.py): device time attributed to the
+    # gibbs sampler while tracing is on; disabled mode stays async
+    with span("infer.gibbs.sample") as sp:
+        sp.annotate(chains=C, warmup=config.num_warmup, samples=config.num_samples)
+        qs, lls, healthy, q_step = sp.sync(fn(*args))
     stats = {
         "logp": lls,
         "diverging": jnp.zeros_like(lls, bool),

@@ -29,6 +29,7 @@ import numpy as np
 from jax import lax
 
 from hhmm_tpu.infer.nuts import nuts_step, find_reasonable_step_size, NUTSInfo
+from hhmm_tpu.obs.trace import span
 from hhmm_tpu.robust import faults
 from hhmm_tpu.robust.guards import finite_mask, guard_update, guard_where
 
@@ -300,4 +301,9 @@ def sample_nuts(
         args = (keys, init_q, *fault)
     if jit:
         fn = jax.jit(fn)
-    return fn(*args)
+    # host-boundary span (obs/trace.py): syncing pins device time to
+    # the span while tracing is enabled; disabled mode never blocks,
+    # preserving async dispatch for callers that pipeline
+    with span("infer.nuts.sample") as sp:
+        sp.annotate(chains=C, warmup=config.num_warmup, samples=config.num_samples)
+        return sp.sync(fn(*args))

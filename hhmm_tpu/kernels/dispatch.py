@@ -39,6 +39,7 @@ from hhmm_tpu.kernels.assoc import (
 from hhmm_tpu.kernels.ffbs import backward_sample, ffbs_fused
 from hhmm_tpu.kernels.filtering import backward_pass, forward_backward, forward_filter
 from hhmm_tpu.kernels.viterbi import viterbi
+from hhmm_tpu.obs.trace import span
 
 __all__ = [
     "ASSOC_CROSSOVER",
@@ -51,6 +52,19 @@ __all__ = [
 ]
 
 TimeParallel = Union[bool, str]
+
+
+def _branch_span(name: str, branch: str, K: int, T: int):
+    """Observability hook (obs/trace.py): one span per dispatch with
+    the RESOLVED branch in the name — ``kernels.dispatch.ffbs[fused]``
+    — so the span table shows which kernel actually ran per (K, T).
+    Inside a ``jit`` trace this fires once per specialization and times
+    the trace; called eagerly it times the (async) dispatch. Either
+    way the branch record is exact: dispatch is plain Python on static
+    shapes. No-op singleton when tracing is disabled."""
+    sp = span(f"kernels.dispatch.{name}[{branch}]")
+    sp.annotate(K=K, T=T)
+    return sp
 
 # Measured crossover table: ``platform -> ((K_max, T_min), ...)`` — the
 # assoc kernel is dispatched when K <= K_max of some row and T >= that
@@ -119,8 +133,10 @@ def forward_filter_dispatch(
     measured (K, T) crossover."""
     T, K = log_obs.shape
     if use_assoc(K, T, time_parallel):
-        return forward_filter_assoc(log_pi, log_A, log_obs, mask)
-    return forward_filter(log_pi, log_A, log_obs, mask)
+        with _branch_span("forward_filter", "assoc", K, T):
+            return forward_filter_assoc(log_pi, log_A, log_obs, mask)
+    with _branch_span("forward_filter", "seq", K, T):
+        return forward_filter(log_pi, log_A, log_obs, mask)
 
 
 def backward_dispatch(
@@ -130,8 +146,10 @@ def backward_dispatch(
     crossover routing."""
     T, K = log_obs.shape
     if use_assoc(K, T, time_parallel):
-        return backward_assoc(log_A, log_obs, mask)
-    return backward_pass(log_A, log_obs, mask)
+        with _branch_span("backward", "assoc", K, T):
+            return backward_assoc(log_A, log_obs, mask)
+    with _branch_span("backward", "seq", K, T):
+        return backward_pass(log_A, log_obs, mask)
 
 
 def smooth_dispatch(
@@ -142,8 +160,10 @@ def smooth_dispatch(
     routing — both passes take the same branch."""
     T, K = log_obs.shape
     if use_assoc(K, T, time_parallel):
-        return smooth_assoc(log_pi, log_A, log_obs, mask)
-    return forward_backward(log_pi, log_A, log_obs, mask)
+        with _branch_span("smooth", "assoc", K, T):
+            return smooth_assoc(log_pi, log_A, log_obs, mask)
+    with _branch_span("smooth", "seq", K, T):
+        return forward_backward(log_pi, log_A, log_obs, mask)
 
 
 def viterbi_dispatch(
@@ -153,8 +173,10 @@ def viterbi_dispatch(
     crossover routing."""
     T, K = log_obs.shape
     if use_assoc(K, T, time_parallel):
-        return viterbi_assoc(log_pi, log_A, log_obs, mask)
-    return viterbi(log_pi, log_A, log_obs, mask)
+        with _branch_span("viterbi", "assoc", K, T):
+            return viterbi_assoc(log_pi, log_A, log_obs, mask)
+    with _branch_span("viterbi", "seq", K, T):
+        return viterbi(log_pi, log_A, log_obs, mask)
 
 
 def _fused_ffbs_likely(log_pi, log_A, log_obs) -> bool:
@@ -196,16 +218,20 @@ def ffbs_dispatch(
     if log_A.ndim == 3:
         if gate_key is not None:
             raise ValueError("gate keys require homogeneous log_A")
-        log_alpha, ll = forward_filter(log_pi, log_A, log_obs, mask)
-        return backward_sample(key, log_alpha, log_A, mask), ll
+        T, K = log_obs.shape
+        with _branch_span("ffbs", "seq_tv", K, T):
+            log_alpha, ll = forward_filter(log_pi, log_A, log_obs, mask)
+            return backward_sample(key, log_alpha, log_A, mask), ll
     T, K = log_obs.shape
     tp = time_parallel
     if tp == "auto" and _fused_ffbs_likely(log_pi, log_A, log_obs):
         tp = False
     if use_assoc(K, T, tp):
-        return ffbs_assoc_sample(
-            key, log_pi, log_A, log_obs, mask, gate_key, state_key
-        )
-    if gate_key is None:
-        return ffbs_fused(key, log_pi, log_A, log_obs, mask)
-    return ffbs_fused(key, log_pi, log_A, log_obs, mask, gate_key, state_key)
+        with _branch_span("ffbs", "assoc", K, T):
+            return ffbs_assoc_sample(
+                key, log_pi, log_A, log_obs, mask, gate_key, state_key
+            )
+    with _branch_span("ffbs", "fused", K, T):
+        if gate_key is None:
+            return ffbs_fused(key, log_pi, log_A, log_obs, mask)
+        return ffbs_fused(key, log_pi, log_A, log_obs, mask, gate_key, state_key)

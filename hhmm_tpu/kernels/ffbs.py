@@ -25,6 +25,7 @@ from jax import lax
 from jax.custom_batching import custom_vmap
 
 from hhmm_tpu.kernels.filtering import forward_filter, _split_A
+from hhmm_tpu.obs.trace import span
 
 __all__ = ["backward_sample", "ffbs_fused", "ffbs_invcdf_reference", "ffbs_sample"]
 
@@ -275,11 +276,15 @@ def ffbs_fused(
     if (gate_key is None) != (state_key is None):
         raise ValueError("gate_key and state_key must be given together")
     T = log_obs.shape[0]
-    if mask is None:
-        mask = jnp.ones((T,), log_obs.dtype)
-    u = jax.random.uniform(key, (T,), log_obs.dtype)
-    if gate_key is None:
-        return _ffbs_fused_single(u, log_pi, log_A, log_obs, mask)
-    return _ffbs_fused_single_gated(
-        u, log_pi, log_A, log_obs, mask, gate_key, state_key
-    )
+    # observability span (obs/trace.py): fires once per jit trace,
+    # marking FFBS presence + trace cost in the span table; no-op when
+    # tracing is disabled
+    with span("kernels.ffbs"):
+        if mask is None:
+            mask = jnp.ones((T,), log_obs.dtype)
+        u = jax.random.uniform(key, (T,), log_obs.dtype)
+        if gate_key is None:
+            return _ffbs_fused_single(u, log_pi, log_A, log_obs, mask)
+        return _ffbs_fused_single_gated(
+            u, log_pi, log_A, log_obs, mask, gate_key, state_key
+        )

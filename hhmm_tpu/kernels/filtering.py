@@ -39,6 +39,7 @@ from hhmm_tpu.core.lmath import (
     safe_log_normalize,
     safe_logsumexp,
 )
+from hhmm_tpu.obs.trace import span
 
 __all__ = [
     "filter_step",
@@ -97,32 +98,36 @@ def forward_filter(
     unpadded sequence.
     """
     T = log_obs.shape[0]
-    A_t = _split_A(log_A, T)
+    # observability span (obs/trace.py): inside a jit this fires once
+    # per trace (attributing trace cost and presence per kernel); no-op
+    # singleton when tracing is disabled
+    with span("kernels.forward_filter"):
+        A_t = _split_A(log_A, T)
 
-    alpha0 = log_pi + log_obs[0]
-    if mask is not None:
-        # An all-masked series would be degenerate; t=0 is assumed valid.
-        alpha0 = jnp.where(mask[0] > 0, alpha0, log_pi)
+        alpha0 = log_pi + log_obs[0]
+        if mask is not None:
+            # An all-masked series would be degenerate; t=0 is assumed valid.
+            alpha0 = jnp.where(mask[0] > 0, alpha0, log_pi)
 
-    def step(carry, xs):
-        if A_t is None:
-            obs_t, m_t = xs
-            lA = log_A
-        else:
-            obs_t, m_t, lA = xs
-        new = filter_step(carry, lA, obs_t, m_t if mask is not None else None)
-        return new, new
+        def step(carry, xs):
+            if A_t is None:
+                obs_t, m_t = xs
+                lA = log_A
+            else:
+                obs_t, m_t, lA = xs
+            new = filter_step(carry, lA, obs_t, m_t if mask is not None else None)
+            return new, new
 
-    m = jnp.ones((T,), log_obs.dtype) if mask is None else mask
-    xs = (log_obs[1:], m[1:]) if A_t is None else (log_obs[1:], m[1:], A_t)
-    alpha_last, alpha_rest = lax.scan(step, alpha0, xs)
-    log_alpha = jnp.concatenate([alpha0[None], alpha_rest], axis=0)
-    # guarded reduction: an all--inf final filter (impossible evidence /
-    # fully-gated series) keeps loglik = -inf (likelihood ORDERING stays
-    # honest for model-comparison consumers) but with zero — not NaN —
-    # gradients, so one degenerate series rejects/quarantines instead of
-    # poisoning its whole vmap lane; bitwise identical otherwise
-    return log_alpha, safe_logsumexp(alpha_last)
+        m = jnp.ones((T,), log_obs.dtype) if mask is None else mask
+        xs = (log_obs[1:], m[1:]) if A_t is None else (log_obs[1:], m[1:], A_t)
+        alpha_last, alpha_rest = lax.scan(step, alpha0, xs)
+        log_alpha = jnp.concatenate([alpha0[None], alpha_rest], axis=0)
+        # guarded reduction: an all--inf final filter (impossible evidence /
+        # fully-gated series) keeps loglik = -inf (likelihood ORDERING stays
+        # honest for model-comparison consumers) but with zero — not NaN —
+        # gradients, so one degenerate series rejects/quarantines instead of
+        # poisoning its whole vmap lane; bitwise identical otherwise
+        return log_alpha, safe_logsumexp(alpha_last)
 
 
 def backward_pass(

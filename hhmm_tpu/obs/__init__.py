@@ -1,0 +1,57 @@
+"""Observability: span tracing, compile/memory telemetry, run manifests.
+
+The cross-cutting layer that answers, for any run of the engine,
+*where did the time go and what exactly ran*:
+
+- `obs/trace.py` — ``span("gibbs.z_update")`` context manager /
+  decorator over ``time.perf_counter()``, thread-safe and nestable,
+  near-zero overhead when disabled; JSONL event stream + aggregated
+  per-span table. Enabled process-wide by ``HHMM_TPU_TRACE=1``.
+- `obs/telemetry.py` — process-wide XLA compile counting (a
+  ``jax.monitoring`` listener + a registry of named jitted entry
+  points) and device-memory watermarks where the backend exposes
+  ``memory_stats()``.
+- `obs/manifest.py` — run manifests (git rev, jax/jaxlib versions,
+  backend + device kind, config/model digests, seed, span table,
+  compile counts, peak memory) written atomically next to results;
+  the provenance record `scripts/bench_diff.py` gates regressions on.
+
+See `docs/observability.md`.
+"""
+
+from hhmm_tpu.obs import manifest, telemetry, trace
+from hhmm_tpu.obs.manifest import (
+    MANIFEST_VERSION,
+    collect_manifest,
+    load_manifest,
+    manifest_stanza,
+    write_manifest,
+)
+from hhmm_tpu.obs.telemetry import (
+    CompileRegistry,
+    install_listeners,
+    register_jit,
+    telemetry_snapshot,
+)
+from hhmm_tpu.obs.trace import Tracer, event, perf_counter, span, traced, tracer
+
+__all__ = [
+    "manifest",
+    "telemetry",
+    "trace",
+    "MANIFEST_VERSION",
+    "collect_manifest",
+    "load_manifest",
+    "manifest_stanza",
+    "write_manifest",
+    "CompileRegistry",
+    "install_listeners",
+    "register_jit",
+    "telemetry_snapshot",
+    "Tracer",
+    "event",
+    "perf_counter",
+    "span",
+    "traced",
+    "tracer",
+]

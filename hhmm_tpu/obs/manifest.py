@@ -1,0 +1,315 @@
+"""Run manifests: persisted provenance for every expensive run.
+
+The reference's R workflow kept provenance implicitly — one machine,
+one BLAS, Stan's own sampler output embedded in the RDS files. The
+TPU-native engine runs on heterogeneous hosts (v5e in a tunnel, CI
+CPU, laptops) where a bare ``{"value": 1295.4}`` throughput record is
+uninterpretable a week later (the BENCH_r0*.json trajectory proved it:
+records differ 30× across rounds with the explanation living only in
+commit messages). A manifest pins, for one run:
+
+- **code**: git revision (+dirty flag), hhmm_tpu version;
+- **stack**: jax/jaxlib/python versions;
+- **hardware**: backend, device kind and count, optional mesh shape;
+- **workload**: digests of the model fingerprint and the run config
+  (plus a combined ``workload_digest`` — the comparability key
+  `scripts/bench_diff.py` gates on), the seed;
+- **telemetry**: the span table (`obs/trace.py` aggregate), compile
+  counts/seconds (`obs/telemetry.py`), device-memory watermarks.
+
+Files follow the `batch/cache.py` conventions: a ``version`` field,
+atomic writes (temp + fsync + ``os.replace``), and corrupt-tolerant
+reads (a torn/garbage manifest is quarantined aside as ``.corrupt``
+and reads as ``None``, never as an exception wedging a sweep resume).
+
+Digesting here is self-contained (sha256 over a canonical-JSON/array
+encoding) rather than importing ``batch.cache.digest_key``: the obs
+layer sits below ``batch/`` in the import graph (``batch/fit.py``
+imports `obs/trace.py`) and must not create a cycle.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import platform
+import socket
+import subprocess
+import sys
+from typing import Any, Dict, Optional
+
+from hhmm_tpu.obs import telemetry, trace
+
+__all__ = [
+    "MANIFEST_VERSION",
+    "git_revision",
+    "stack_versions",
+    "device_info",
+    "config_digest",
+    "collect_manifest",
+    "manifest_stanza",
+    "write_manifest",
+    "load_manifest",
+]
+
+MANIFEST_VERSION = 1
+
+
+def _digest_update(h, obj) -> None:
+    """Canonical recursive hash — dict keys sorted, arrays by
+    dtype/shape/bytes (mirrors `batch/cache.py` semantics without the
+    import)."""
+    if isinstance(obj, dict):
+        for k in sorted(obj):
+            h.update(str(k).encode())
+            _digest_update(h, obj[k])
+    elif isinstance(obj, (list, tuple)):
+        for v in obj:
+            _digest_update(h, v)
+    elif hasattr(obj, "dtype") and hasattr(obj, "tobytes"):
+        import numpy as np
+
+        arr = np.ascontiguousarray(obj)
+        h.update(str(arr.dtype).encode() + str(arr.shape).encode())
+        h.update(arr.tobytes())
+    elif hasattr(obj, "tolist"):  # jax arrays / numpy scalars
+        import numpy as np
+
+        _digest_update(h, np.asarray(obj))
+    else:
+        h.update(json.dumps(obj, sort_keys=True, default=str).encode())
+
+
+def config_digest(*parts: Any) -> str:
+    """Short stable digest of a nested config/fingerprint structure."""
+    h = hashlib.sha256()
+    for p in parts:
+        _digest_update(h, p)
+    return h.hexdigest()[:16]
+
+
+# per-process cache: the revision and dirty flag cannot change inside
+# one run, and `git status` costs real time on a large tree — a bench
+# sweep stamping every record must not pay it per record
+_GIT_CACHE: Dict[str, Optional[Dict[str, Any]]] = {}
+
+
+def git_revision(root: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    """``{"rev": <sha>, "dirty": bool}`` for the repo containing
+    ``root`` (default: this package's checkout), or ``None`` when git
+    or the repo is unavailable — provenance is best-effort, never a
+    crash. Cached per (process, root)."""
+    cwd = root or os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
+    if cwd in _GIT_CACHE:
+        return _GIT_CACHE[cwd]
+    _GIT_CACHE[cwd] = out = _git_revision_uncached(cwd)
+    return out
+
+
+def _git_revision_uncached(cwd: str) -> Optional[Dict[str, Any]]:
+    try:
+        rev = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=cwd, capture_output=True, text=True, timeout=10,
+        )
+        if rev.returncode != 0:
+            return None
+        status = subprocess.run(
+            ["git", "status", "--porcelain"],
+            cwd=cwd, capture_output=True, text=True, timeout=10,
+        )
+        return {
+            "rev": rev.stdout.strip(),
+            "dirty": bool(status.stdout.strip()) if status.returncode == 0 else None,
+        }
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def stack_versions() -> Dict[str, str]:
+    out = {"python": platform.python_version()}
+    try:
+        import hhmm_tpu
+
+        out["hhmm_tpu"] = getattr(hhmm_tpu, "__version__", "unknown")
+    except ImportError:
+        pass
+    try:
+        import jax
+
+        out["jax"] = jax.__version__
+    except ImportError:
+        pass
+    try:
+        import jaxlib
+
+        out["jaxlib"] = jaxlib.__version__
+    except ImportError:
+        pass
+    return out
+
+
+def device_info(mesh=None) -> Dict[str, Any]:
+    """Backend + device kind/count (+ mesh axis sizes when a
+    ``jax.sharding.Mesh`` is in play). Tolerant of a dead backend —
+    the BENCH_r05 failure mode is exactly when provenance matters."""
+    out: Dict[str, Any] = {}
+    try:
+        import jax
+
+        out["backend"] = jax.default_backend()
+        devices = jax.devices()
+        out["device_count"] = len(devices)
+        out["device_kind"] = devices[0].device_kind if devices else None
+        out["platform_version"] = getattr(devices[0], "platform_version", None) if devices else None
+    except Exception as e:  # backend init failure — record it
+        out["backend"] = None
+        out["backend_error"] = f"{type(e).__name__}: {e}"
+    if mesh is not None:
+        try:
+            out["mesh_shape"] = dict(mesh.shape)
+        except (AttributeError, TypeError):
+            out["mesh_shape"] = str(mesh)
+    return out
+
+
+def collect_manifest(
+    *,
+    config: Any = None,
+    model: Any = None,
+    seed: Any = None,
+    mesh=None,
+    workload_config: Any = None,
+    extra: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Assemble the full manifest dict for the current process state.
+
+    ``config``: the run's config (dict / argparse namespace vars / a
+    dataclass's ``vars()``) — digested AND embedded. ``model``: any
+    object; scalar/array attributes form its fingerprint digest (same
+    attribute discipline as `batch/fit.py`'s cache keys). ``seed``:
+    whatever identifies the PRNG stream. ``workload_config``: when the
+    full config carries knobs that CANNOT affect the measured workload
+    (output paths, profiler flags), pass the workload-relevant subset
+    here — ``config_digest``/``workload_digest`` are computed from it
+    so an observability flag can never fork the comparability key and
+    fail the `scripts/bench_diff.py` gate open. ``extra``: caller
+    stanzas merged in at the top level (e.g. the bench's metric name).
+    """
+    cfg = dict(config) if isinstance(config, dict) else (
+        vars(config) if hasattr(config, "__dict__") else config
+    )
+    digest_src = workload_config if workload_config is not None else cfg
+    model_fp = None
+    if model is not None:
+        attrs: Dict[str, Any] = {"class": type(model).__name__}
+        for k, v in sorted(vars(model).items()):
+            if isinstance(v, (int, float, str, bool, tuple, list)):
+                attrs[k] = v
+            elif hasattr(v, "dtype"):
+                attrs[k] = v
+        model_fp = {"class": attrs["class"], "digest": config_digest(attrs)}
+    dev = device_info(mesh)
+    cfg_digest = config_digest(digest_src) if digest_src is not None else None
+    man: Dict[str, Any] = {
+        "version": MANIFEST_VERSION,
+        "hostname": socket.gethostname(),
+        "argv": list(sys.argv),
+        "versions": stack_versions(),
+        "git": git_revision(),
+        **dev,
+        "seed": None if seed is None else int(seed) if isinstance(seed, (int, bool)) else str(seed),
+        "config": cfg,
+        "config_digest": cfg_digest,
+        "model": model_fp,
+        # the bench_diff comparability key: same code-independent
+        # workload identity (config + model + device kind) means two
+        # records' throughputs are comparable
+        "workload_digest": config_digest(
+            {
+                "config": cfg_digest,
+                "model": model_fp["digest"] if model_fp else None,
+                "device_kind": dev.get("device_kind"),
+            }
+        ),
+        "spans": trace.aggregate(),
+        "trace_enabled": trace.enabled(),
+        **telemetry.telemetry_snapshot(),
+    }
+    if extra:
+        man.update(extra)
+    return man
+
+
+def manifest_stanza(
+    *,
+    config: Any = None,
+    model: Any = None,
+    seed: Any = None,
+    mesh=None,
+    workload_config: Any = None,
+) -> Dict[str, Any]:
+    """Compact manifest for embedding into an emitted JSON record (the
+    `bench.py` ``"manifest"`` stanza): full provenance identity, but
+    the span table collapsed to its size and hottest entry so one-line
+    records stay one line. Write :func:`collect_manifest` to a file for
+    the full table."""
+    man = collect_manifest(
+        config=config,
+        model=model,
+        seed=seed,
+        mesh=mesh,
+        workload_config=workload_config,
+    )
+    spans = man.pop("spans")
+    compile_st = man.pop("compile")
+    man.pop("argv", None)
+    man.pop("config", None)  # the records already carry their config
+    hottest = next(iter(spans), None)
+    man["span_count"] = sum(t["count"] for t in spans.values())
+    man["span_names"] = len(spans)
+    man["hottest_span"] = (
+        {"name": hottest, **spans[hottest]} if hottest else None
+    )
+    man["backend_compiles"] = compile_st["backend_compiles"]
+    man["compile_listener"] = compile_st["listening"]
+    return man
+
+
+def write_manifest(path: str, manifest: Dict[str, Any]) -> None:
+    """Atomic JSON write (`obs/trace.py`'s ``atomic_write_text``) so a
+    reader can never observe a half-written manifest — the
+    `batch/cache.py` discipline applied to JSON."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    trace.atomic_write_text(
+        path, json.dumps(manifest, indent=2, sort_keys=False, default=str) + "\n"
+    )
+
+
+def load_manifest(path: str) -> Optional[Dict[str, Any]]:
+    """Corrupt-tolerant read: a missing file is ``None``; a torn or
+    garbage one is ALSO ``None`` — quarantined aside as ``.corrupt``
+    (so a re-write under the same name works) with one stderr line,
+    never an exception."""
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            man = json.load(f)
+        if not isinstance(man, dict) or "version" not in man:
+            raise ValueError("not a manifest (no version field)")
+        return man
+    except (OSError, ValueError) as e:
+        print(
+            f"# manifest: dropping corrupt file {os.path.basename(path)} "
+            f"({type(e).__name__}: {e})",
+            file=sys.stderr,
+            flush=True,
+        )
+        try:
+            os.replace(path, path + ".corrupt")
+        except OSError:
+            pass
+        return None

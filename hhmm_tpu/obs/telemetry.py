@@ -1,0 +1,318 @@
+"""Process-wide compile-event and device-memory telemetry.
+
+Two questions the span tracer (`obs/trace.py`) cannot answer on its
+own:
+
+1. **How many times did XLA compile, and how long did it spend?**
+   Recompiles are the serving layer's cardinal sin (`serve/scheduler.py`
+   exists to keep the post-warmup compile count flat) and the dominant
+   cold-start cost everywhere else. This module counts them at two
+   levels:
+
+   - a ``jax.monitoring`` duration listener on the
+     ``/jax/core/compile/*`` events — the process-wide ground truth
+     (every ``backend_compile`` anywhere in the process, regardless of
+     which ``jit`` triggered it), with total seconds per phase
+     (jaxpr trace / lowering / backend compile);
+   - a **registry of named jitted entry points**
+     (:func:`register_jit`) — each registered function's
+     ``_cache_size()`` is the number of distinct traced signatures it
+     holds, the per-entry-point attribution the global counter lacks.
+     This generalizes the signature accounting `serve/metrics.py`
+     hand-rolled; the scheduler now registers its kernels here.
+
+2. **How close did we get to device memory limits?** Where the backend
+   exposes ``Device.memory_stats()`` (TPU does; CPU returns ``None``),
+   :func:`sample_memory` reads ``bytes_in_use``/``peak_bytes_in_use``
+   per device and folds them into a high-watermark that
+   :func:`peak_memory` reports for the run manifest.
+
+Everything is importable without side effects: the monitoring listener
+installs only on :func:`install_listeners` (idempotent), and every
+reader degrades to empty dicts when jax is absent or the backend hides
+the stats.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "CompileRegistry",
+    "CompileScope",
+    "registry",
+    "install_listeners",
+    "uninstall_listeners",
+    "register_jit",
+    "backend_compiles",
+    "compile_seconds",
+    "jit_cache_sizes",
+    "new_scope",
+    "scope_counts",
+    "sample_memory",
+    "peak_memory",
+    "telemetry_snapshot",
+    "reset",
+]
+
+# the jax.monitoring event that fires once per actual XLA backend
+# compilation (retraces that hit the lowering cache don't reach it)
+_BACKEND_COMPILE = "/jax/core/compile/backend_compile_duration"
+_COMPILE_PREFIX = "/jax/core/compile/"
+
+
+class CompileScope:
+    """A named externally-set compile counter — for components that
+    compute their own signature count (the `serve/metrics.py`
+    contract: the scheduler audits its four kernels' cache sizes and
+    publishes one number). Scopes register with the
+    :class:`CompileRegistry` so run manifests see every component's
+    count without knowing the components."""
+
+    __slots__ = ("label", "_value", "__weakref__")
+
+    def __init__(self, label: str):
+        self.label = label
+        self._value = 0
+
+    def set(self, n: int) -> None:
+        self._value = int(n)
+
+    def get(self) -> int:
+        return self._value
+
+
+class CompileRegistry:
+    """See module docstring. One process-wide instance
+    (:data:`registry`); tests may construct their own."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._event_counts: Dict[str, int] = {}
+        self._event_secs: Dict[str, float] = {}
+        self._listener = None
+        # name -> list of weakrefs to jitted callables (several
+        # instances of a component may register under one name)
+        self._jits: Dict[str, List[weakref.ref]] = {}
+        self._scopes: List[weakref.ref] = []
+
+    # ---- jax.monitoring listener ----
+
+    def _on_event(self, name: str, secs: float, **kw) -> None:
+        if not name.startswith(_COMPILE_PREFIX):
+            return
+        with self._lock:
+            self._event_counts[name] = self._event_counts.get(name, 0) + 1
+            self._event_secs[name] = self._event_secs.get(name, 0.0) + secs
+
+    def install_listeners(self) -> bool:
+        """Register the compile-duration listener (idempotent). Returns
+        True when listening (now or already)."""
+        if self._listener is not None:
+            return True
+        try:
+            from jax import monitoring
+        except ImportError:
+            return False
+        listener = self._on_event
+        monitoring.register_event_duration_secs_listener(listener)
+        self._listener = listener
+        return True
+
+    def uninstall_listeners(self) -> None:
+        """Best-effort removal (the public API has no unregister; the
+        private one is version-dependent). Tests use this to avoid
+        cross-test counter bleed."""
+        if self._listener is None:
+            return
+        try:
+            from jax._src import monitoring as _m
+
+            _m._unregister_event_duration_listener_by_callback(self._listener)
+        except (ImportError, AttributeError, ValueError):
+            pass
+        self._listener = None
+
+    def backend_compiles(self) -> int:
+        """Process-wide XLA backend compilations observed since
+        :meth:`install_listeners` (0 if never installed)."""
+        with self._lock:
+            return self._event_counts.get(_BACKEND_COMPILE, 0)
+
+    def compile_seconds(self) -> Dict[str, float]:
+        """Total seconds per compile phase, keyed by the short event
+        name (``backend_compile_duration`` etc.)."""
+        with self._lock:
+            return {
+                k[len(_COMPILE_PREFIX) :]: round(v, 4)
+                for k, v in self._event_secs.items()
+            }
+
+    # ---- named jit entry points ----
+
+    def register_jit(self, name: str, fn):
+        """Register a jitted callable under ``name`` and return it
+        unchanged (decorator-friendly:
+        ``run = register_jit("bench.run", jax.jit(run_chunk))``).
+        The registry holds a weakref only — registration never extends
+        the function's lifetime or its compile cache. Dead refs are
+        pruned on registration and on every read, so a long-lived
+        process (serving host, pytest session) re-creating components
+        does not accumulate registrations without bound."""
+        with self._lock:
+            refs = self._jits.setdefault(name, [])
+            refs[:] = [r for r in refs if r() is not None]
+            refs.append(weakref.ref(fn))
+        return fn
+
+    def jit_cache_sizes(self) -> Dict[str, int]:
+        """Per-name sum of live registered functions' ``_cache_size()``
+        — the number of distinct traced signatures each entry point
+        holds. Names whose functions were all collected are pruned
+        (absent from the result, not reported as 0 forever)."""
+        out: Dict[str, int] = {}
+        with self._lock:
+            for name in list(self._jits):
+                refs = self._jits[name]
+                refs[:] = [r for r in refs if r() is not None]
+                if not refs:
+                    del self._jits[name]
+            items = [(name, list(refs)) for name, refs in self._jits.items()]
+        for name, refs in items:
+            n = 0
+            for ref in refs:
+                fn = ref()
+                if fn is None:
+                    continue
+                cache_size = getattr(fn, "_cache_size", None)
+                if callable(cache_size):
+                    try:
+                        n += int(cache_size())
+                    except TypeError:
+                        pass
+            out[name] = n
+        return out
+
+    # ---- externally-set scopes ----
+
+    def new_scope(self, label: str) -> CompileScope:
+        scope = CompileScope(label)
+        with self._lock:
+            self._scopes[:] = [r for r in self._scopes if r() is not None]
+            self._scopes.append(weakref.ref(scope))
+        return scope
+
+    def scope_counts(self) -> Dict[str, int]:
+        """Live scopes' published counts. Several scopes under one
+        label (e.g. two schedulers) sum — the label is a component,
+        not an instance. Dead scopes are pruned on read."""
+        out: Dict[str, int] = {}
+        with self._lock:
+            self._scopes[:] = [r for r in self._scopes if r() is not None]
+            refs = list(self._scopes)
+        for ref in refs:
+            scope = ref()
+            if scope is not None:
+                out[scope.label] = out.get(scope.label, 0) + scope.get()
+        return out
+
+    # ---- lifecycle ----
+
+    def reset(self) -> None:
+        """Zero event counters and drop registrations (scopes included).
+        For tests; production code never needs it."""
+        with self._lock:
+            self._event_counts.clear()
+            self._event_secs.clear()
+            self._jits.clear()
+            self._scopes.clear()
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-ready compile-telemetry stanza for the run manifest."""
+        return {
+            "backend_compiles": self.backend_compiles(),
+            "compile_seconds": self.compile_seconds(),
+            "jit_cache_sizes": self.jit_cache_sizes(),
+            "scopes": self.scope_counts(),
+            "listening": self._listener is not None,
+        }
+
+
+registry = CompileRegistry()
+
+install_listeners = registry.install_listeners
+uninstall_listeners = registry.uninstall_listeners
+register_jit = registry.register_jit
+backend_compiles = registry.backend_compiles
+compile_seconds = registry.compile_seconds
+jit_cache_sizes = registry.jit_cache_sizes
+new_scope = registry.new_scope
+scope_counts = registry.scope_counts
+
+
+# ---- device memory watermarks ----
+
+_MEM_LOCK = threading.Lock()
+_MEM_PEAK: Dict[str, Dict[str, int]] = {}
+
+# the stats worth persisting, where the allocator exposes them
+_MEM_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+
+
+def sample_memory() -> Dict[str, Dict[str, int]]:
+    """Read ``memory_stats()`` from every device that exposes it
+    (``{}`` on backends that don't — XLA:CPU returns ``None``) and fold
+    the reads into the process high-watermark. Call at phase boundaries
+    (the bench does: after compile, after the timed region)."""
+    try:
+        import jax
+
+        devices = jax.devices()
+    except Exception:  # no backend at all — telemetry must not raise
+        return {}
+    out: Dict[str, Dict[str, int]] = {}
+    for d in devices:
+        stats_fn = getattr(d, "memory_stats", None)
+        if stats_fn is None:
+            continue
+        try:
+            stats = stats_fn()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        rec = {k: int(stats[k]) for k in _MEM_KEYS if k in stats}
+        if not rec:
+            continue
+        key = str(d.id)
+        out[key] = rec
+        with _MEM_LOCK:
+            peak = _MEM_PEAK.setdefault(key, {})
+            for k, v in rec.items():
+                if k == "bytes_limit":
+                    peak[k] = v
+                else:
+                    peak[k] = max(peak.get(k, 0), v)
+    return out
+
+
+def peak_memory() -> Dict[str, Dict[str, int]]:
+    """High-watermark across every :func:`sample_memory` call so far,
+    per device id. Empty where the backend hides the stats."""
+    with _MEM_LOCK:
+        return {k: dict(v) for k, v in _MEM_PEAK.items()}
+
+
+def telemetry_snapshot() -> Dict[str, Any]:
+    """The full telemetry stanza (compile + memory) for manifests."""
+    sample_memory()
+    return {"compile": registry.snapshot(), "peak_memory": peak_memory()}
+
+
+def reset() -> None:
+    """Test hook: zero the global registry and memory watermarks."""
+    registry.reset()
+    with _MEM_LOCK:
+        _MEM_PEAK.clear()

@@ -9,6 +9,14 @@ traced signatures across the scheduler's jitted entry points (read from
 jit's own specialization cache), and ``tests/test_serve.py`` plus
 ``bench.py --serve`` assert it stays flat over a sustained tick replay.
 
+The counter itself lives in a named :class:`~hhmm_tpu.obs.telemetry.
+CompileScope` of the process-wide compile registry
+(`hhmm_tpu/obs/telemetry.py`) rather than a private attribute, so run
+manifests (`obs/manifest.py`) see the serving compile count alongside
+the global ``jax.monitoring`` compile events without knowing about this
+class. The ``summary()`` schema is unchanged — consumers
+(``tests/test_serve.py``, ``bench.py --serve``) read the same keys.
+
 The latency histogram uses fixed log-spaced bucket edges (constant
 memory, mergeable across processes); quantiles are read from the
 cumulative counts at the conservative upper edge of the containing
@@ -20,6 +28,8 @@ from __future__ import annotations
 from typing import Dict, Optional, Sequence
 
 import numpy as np
+
+from hhmm_tpu.obs import telemetry
 
 __all__ = ["ServeMetrics"]
 
@@ -41,7 +51,9 @@ class ServeMetrics:
         self.superseded_responses = 0
         self.flushes = 0
         self.busy_seconds = 0.0
-        self.compile_count = 0
+        # the compile counter is a registered telemetry scope (one per
+        # metrics instance; the registry sums same-label scopes)
+        self._compile_scope = telemetry.new_scope("serve.compile_count")
 
     # ---- recording ----
 
@@ -80,8 +92,12 @@ class ServeMetrics:
         (latest-wins); the filter state still folded that tick."""
         self.superseded_responses += 1
 
+    @property
+    def compile_count(self) -> int:
+        return self._compile_scope.get()
+
     def set_compile_count(self, n: int) -> None:
-        self.compile_count = int(n)
+        self._compile_scope.set(int(n))
 
     # ---- reading ----
 

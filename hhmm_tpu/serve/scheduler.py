@@ -46,6 +46,8 @@ import jax.numpy as jnp
 
 from hhmm_tpu.batch.pad import pad_ragged
 from hhmm_tpu.core.lmath import safe_log_normalize
+from hhmm_tpu.obs.telemetry import register_jit
+from hhmm_tpu.obs.trace import span, traced
 from hhmm_tpu.robust.guards import finite_mask, guard_update
 from hhmm_tpu.serve.metrics import ServeMetrics
 from hhmm_tpu.serve.online import StreamState, filter_scan, stream_init, stream_step
@@ -96,10 +98,16 @@ class MicroBatchScheduler:
         self._undelivered: List[TickResponse] = []
         self._draws_cache: Dict[Tuple[str, ...], jnp.ndarray] = {}
         self._obs_dtypes: Dict[str, Any] = {}
-        self._init_j = jax.jit(self._init_impl)
-        self._update_j = jax.jit(self._update_impl)
-        self._replay_j = jax.jit(self._replay_impl)
-        self._unpack_j = jax.jit(jax.vmap(lambda t: model.unpack(t)[0]))
+        # every jitted serving kernel is registered with the process
+        # compile registry (obs/telemetry.py): run manifests attribute
+        # specialization counts per entry point, and check_guards
+        # invariant 5 enforces that serve-layer jits stay registered
+        self._init_j = register_jit("serve.tick_init", jax.jit(self._init_impl))
+        self._update_j = register_jit("serve.tick_update", jax.jit(self._update_impl))
+        self._replay_j = register_jit("serve.replay", jax.jit(self._replay_impl))
+        self._unpack_j = register_jit(
+            "serve.unpack", jax.jit(jax.vmap(lambda t: model.unpack(t)[0]))
+        )
         try:
             # serving-model identity, checked against every attached
             # snapshot's stored spec (None for models whose constructor
@@ -213,6 +221,7 @@ class MicroBatchScheduler:
         ``attach_many`` batch are padded with `batch/pad.py`)."""
         self.attach_many([(series_id, snapshot, history)])
 
+    @traced("serve.attach")
     def attach_many(self, items) -> None:
         """Attach a batch of series in one padded replay dispatch.
         ``items``: iterable of ``(series_id, snapshot, history_or_None)``.
@@ -350,9 +359,11 @@ class MicroBatchScheduler:
                 mask = m
             data_b["mask"] = jnp.asarray(mask)
             draws_b = jnp.stack([d for _, d, _, _ in lanes])
-            alpha, ll, okd = jax.block_until_ready(
-                self._replay_j(draws_b, data_b)
-            )
+            with span("serve.replay") as sp:
+                sp.annotate(bucket=bn, T_pad=T_pad)
+                alpha, ll, okd = jax.block_until_ready(
+                    self._replay_j(draws_b, data_b)
+                )
             self._note_signature(
                 "replay",
                 bn,
@@ -400,6 +411,7 @@ class MicroBatchScheduler:
             out[r.series_id] = r
         return out
 
+    @traced("serve.flush")
     def flush(self) -> List[TickResponse]:
         """Dispatch all pending ticks in bucketed micro-batches.
 
@@ -517,14 +529,16 @@ class MicroBatchScheduler:
                 self._draws_cache.clear()
             draws_b = jnp.stack([self._series[s]["draws"] for s in lane_key])
             self._draws_cache[lane_key] = draws_b
-        if kernel == "init":
-            out = self._init_j(draws_b, obs_b)
-        else:
-            alpha_b = jnp.stack([self._series[s]["alpha"] for s, _, _ in lanes])
-            ll_b = jnp.stack([self._series[s]["ll"] for s, _, _ in lanes])
-            ok_b = jnp.stack([self._series[s]["ok"] for s, _, _ in lanes])
-            out = self._update_j(draws_b, alpha_b, ll_b, ok_b, obs_b)
-        alpha, ll, okd, probs, mean_ll = jax.block_until_ready(out)
+        with span(f"serve.dispatch.{kernel}") as sp:
+            sp.annotate(bucket=bn)
+            if kernel == "init":
+                out = self._init_j(draws_b, obs_b)
+            else:
+                alpha_b = jnp.stack([self._series[s]["alpha"] for s, _, _ in lanes])
+                ll_b = jnp.stack([self._series[s]["ll"] for s, _, _ in lanes])
+                ok_b = jnp.stack([self._series[s]["ok"] for s, _, _ in lanes])
+                out = self._update_j(draws_b, alpha_b, ll_b, ok_b, obs_b)
+            alpha, ll, okd, probs, mean_ll = jax.block_until_ready(out)
         self._obs_dtypes.update(dtype_locks)  # dispatch succeeded
         # dtype-aware signature: the fallback compile audit (no
         # _cache_size on the jitted fn) must see dtype-promotion

@@ -1,0 +1,297 @@
+#!/usr/bin/env python
+"""Bench-regression gate over the ``BENCH_*.json`` trajectory.
+
+Loads every round's bench record, matches records by metric key, prints
+a per-metric delta table, and exits non-zero when a **comparable** pair
+regresses by more than the threshold (default 10% throughput).
+
+Comparability is the whole point. The trajectory spans different hosts,
+backends, and sampler budgets — r01's 1295 series/s and r04's 41
+series/s differ because the gibbs draw budget grew 64× for the ESS
+gate, not because the code got slower. A naive latest-vs-previous gate
+over raw values would be permanently red (or, tuned loose enough to
+pass, permanently useless). So the gate only binds between records that
+carry a ``manifest`` stanza (`hhmm_tpu/obs/manifest.py`, emitted by
+`bench.py` since the observability PR) with matching
+
+    (metric, workload_digest, backend, device_kind, jax_version,
+     trace_enabled)
+
+— same workload on the same stack under the same measurement regime
+(a traced run pays sync boundaries and span bookkeeping the untraced
+run doesn't; comparing across that flag would gate observability
+overhead as a perf regression). Everything else still appears in
+the delta table, marked ungated, with the reason. Pre-manifest records
+(r01–r05) are therefore visible but never gate: exactly the "not
+comparable across hosts without out-of-band knowledge" gap the stamps
+close going forward.
+
+Further gate rules:
+
+- only higher-is-better metrics gate (unit ends in ``/sec``; latency
+  and counter fields ride along in the table only);
+- a crashed round (rc != 0, no parsed record) is reported and skipped —
+  crash-robustness is `bench.py`'s own job (`ensure_backend`), not this
+  gate's;
+- a degraded record (``degraded_cpu_smoke`` / ``backend_fallback``)
+  never gates in either direction — a CPU fallback run regressing
+  against a TPU run is a backend change, not a perf change.
+
+Exit codes: 0 clean (or nothing comparable), 1 regression, 2 usage/IO
+error. No jax import — this runs in CI guards and pre-push hooks.
+
+Usage::
+
+    python scripts/bench_diff.py                 # repo BENCH_*.json
+    python scripts/bench_diff.py --dir /path --threshold 5
+    python scripts/bench_diff.py --metric tayal_serve_tick_throughput
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+GATED_UNIT_RE = re.compile(r"/s(ec)?$")
+
+
+def _last_json_line(text: str) -> Optional[Dict[str, Any]]:
+    """Fallback extraction of a metric record from a round's captured
+    tail when the driver's own ``parsed`` stanza is null."""
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            return rec
+    return None
+
+
+def load_rounds(paths: List[str]) -> List[Dict[str, Any]]:
+    """One entry per bench round file, ordered by round number ``n``:
+    ``{n, file, rc, record}`` where ``record`` is the metric JSON (or
+    None for a crashed round). Files may be either the driver wrapper
+    shape (``{"n", "rc", "tail", "parsed"}``) or a bare metric record
+    (fixture / future direct-emission form)."""
+    rounds = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"# bench_diff: skipping unreadable {path} ({e})", file=sys.stderr)
+            continue
+        if "metric" in d:  # bare record
+            m = re.search(r"(\d+)", os.path.basename(path))
+            rounds.append(
+                {"n": int(m.group(1)) if m else 0, "file": path, "rc": 0, "record": d}
+            )
+            continue
+        rec = d.get("parsed")
+        if rec is None and isinstance(d.get("tail"), str):
+            rec = _last_json_line(d["tail"])
+        rounds.append(
+            {
+                "n": int(d.get("n", 0)),
+                "file": path,
+                "rc": int(d.get("rc", 0)),
+                "record": rec if isinstance(rec, dict) else None,
+            }
+        )
+    rounds.sort(key=lambda r: (r["n"], r["file"]))
+    return rounds
+
+
+def comparability_key(rec: Dict[str, Any]) -> Tuple[Optional[Tuple], Optional[str]]:
+    """``(key, why_not)``: the full comparability key for a record, or
+    ``(None, reason)`` when it cannot gate."""
+    unit = str(rec.get("unit", ""))
+    if not GATED_UNIT_RE.search(unit):
+        return None, f"unit {unit!r} not a throughput"
+    if rec.get("degraded_cpu_smoke") or rec.get("backend_fallback"):
+        return None, "degraded/fallback run"
+    man = rec.get("manifest")
+    if not isinstance(man, dict):
+        return None, "no manifest stanza (pre-observability record)"
+    parts = {
+        "workload_digest": man.get("workload_digest"),
+        "backend": rec.get("backend") or man.get("backend"),
+        "device_kind": man.get("device_kind"),
+        "jax": (man.get("versions") or {}).get("jax"),
+    }
+    missing = [k for k, v in parts.items() if not v]
+    if missing:
+        return None, f"manifest missing {missing}"
+    return (
+        rec["metric"],
+        parts["workload_digest"],
+        parts["backend"],
+        parts["device_kind"],
+        parts["jax"],
+        # measurement regime: traced runs carry sync + span overhead and
+        # must only ever compare against other traced runs
+        bool(man.get("trace_enabled")),
+    ), None
+
+
+def diff(
+    rounds: List[Dict[str, Any]],
+    threshold_pct: float,
+    metric_filter: Optional[str] = None,
+) -> Tuple[List[Dict[str, Any]], int]:
+    """Build the delta table and count gate failures."""
+    rows: List[Dict[str, Any]] = []
+    last_by_metric: Dict[str, Dict[str, Any]] = {}
+    last_by_key: Dict[Tuple, Dict[str, Any]] = {}
+    failures = 0
+    for rnd in rounds:
+        rec = rnd["record"]
+        if rec is None:
+            if metric_filter:
+                # a crashed round has no metric: it belongs to the full
+                # report, not to a single-metric table
+                continue
+            rows.append(
+                {
+                    "n": rnd["n"],
+                    "metric": "-",
+                    "value": None,
+                    "unit": "",
+                    "delta_pct": None,
+                    "gated": False,
+                    "status": f"CRASHED (rc={rnd['rc']})",
+                }
+            )
+            continue
+        metric = str(rec.get("metric", "?"))
+        if metric_filter and metric != metric_filter:
+            continue
+        value = rec.get("value")
+        row: Dict[str, Any] = {
+            "n": rnd["n"],
+            "metric": metric,
+            "value": value,
+            "unit": str(rec.get("unit", "")),
+            "delta_pct": None,
+            "gated": False,
+            "status": "",
+        }
+        prev_any = last_by_metric.get(metric)
+        if (
+            prev_any is not None
+            and isinstance(value, (int, float))
+            and isinstance(prev_any.get("value"), (int, float))
+            and prev_any["value"]
+        ):
+            row["delta_pct"] = 100.0 * (value - prev_any["value"]) / prev_any["value"]
+        key, why_not = comparability_key(rec)
+        if key is None:
+            row["status"] = f"ungated: {why_not}"
+        else:
+            prev = last_by_key.get(key)
+            if prev is None:
+                row["status"] = "baseline for its workload/stack key"
+            elif not isinstance(value, (int, float)):
+                row["status"] = "ungated: non-numeric value"
+            elif not prev["value"]:
+                row["status"] = f"ungated: zero baseline (round {prev['n']})"
+            else:
+                gated_delta = 100.0 * (value - prev["value"]) / prev["value"]
+                row["gated"] = True
+                row["delta_pct"] = gated_delta
+                if gated_delta < -threshold_pct:
+                    failures += 1
+                    row["status"] = (
+                        f"REGRESSION: {gated_delta:+.1f}% vs round {prev['n']} "
+                        f"(threshold -{threshold_pct:g}%)"
+                    )
+                else:
+                    row["status"] = f"ok vs round {prev['n']}"
+            if isinstance(value, (int, float)):
+                last_by_key[key] = {"n": rnd["n"], "value": value}
+        if isinstance(value, (int, float)):
+            last_by_metric[metric] = {"n": rnd["n"], "value": value}
+        rows.append(row)
+    return rows, failures
+
+
+def print_table(rows: List[Dict[str, Any]], out=sys.stdout) -> None:
+    headers = ("round", "metric", "value", "unit", "Δ%", "gate", "status")
+    cells = [
+        (
+            f"r{r['n']:02d}",
+            r["metric"],
+            "-"
+            if r["value"] is None
+            else f"{r['value']:g}"
+            if isinstance(r["value"], (int, float))
+            else str(r["value"]),
+            r["unit"],
+            "-" if r["delta_pct"] is None else f"{r['delta_pct']:+.1f}",
+            "*" if r["gated"] else "",
+            r["status"],
+        )
+        for r in rows
+    ]
+    widths = [
+        max(len(headers[i]), *(len(c[i]) for c in cells)) if cells else len(headers[i])
+        for i in range(len(headers))
+    ]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    print(fmt.format(*headers), file=out)
+    print(fmt.format(*("-" * w for w in widths)), file=out)
+    for c in cells:
+        print(fmt.format(*c), file=out)
+
+
+def main(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--dir",
+        default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding the bench records (default: repo root)",
+    )
+    ap.add_argument(
+        "--glob", default="BENCH_*.json", help="record filename pattern"
+    )
+    ap.add_argument(
+        "--threshold",
+        type=float,
+        default=10.0,
+        metavar="PCT",
+        help="max tolerated throughput regression between comparable "
+        "records, in percent (default 10)",
+    )
+    ap.add_argument("--metric", default=None, help="gate only this metric key")
+    args = ap.parse_args(argv[1:])
+
+    paths = sorted(glob.glob(os.path.join(args.dir, args.glob)))
+    if not paths:
+        print(f"bench_diff: no records match {args.glob} under {args.dir}")
+        return 2
+    rounds = load_rounds(paths)
+    if not rounds:
+        print("bench_diff: no readable records")
+        return 2
+    rows, failures = diff(rounds, args.threshold, args.metric)
+    print_table(rows)
+    n_gated = sum(r["gated"] for r in rows)
+    print(
+        f"\nbench_diff: {len(rows)} record(s), {n_gated} gated pair "
+        f"comparison(s), {failures} regression(s) beyond "
+        f"{args.threshold:g}%"
+    )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

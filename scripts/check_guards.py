@@ -32,10 +32,22 @@ depend on:
    all-(−inf) reduction edge case on every combine; a raw logsumexp
    there has NaN cotangents and, in naive forms, NaN values
    (docs/parallel_scan.md).
+5. **Observability invariants** (`docs/observability.md`): (a) no raw
+   ``time.time()`` call anywhere under ``hhmm_tpu/`` or in
+   ``bench.py`` — durations must come from the monotonic
+   ``time.perf_counter()`` (directly or via the `hhmm_tpu/obs/trace.py`
+   helpers); a wall-clock step (NTP slew, suspend/resume) under
+   ``time.time()`` silently corrupts every throughput record built on
+   it. (b) Every serve/bench module that creates a ``jax.jit`` entry
+   point (``hhmm_tpu/serve/*.py``, ``bench.py``) must import a
+   registration hook from ``hhmm_tpu.obs.telemetry`` and call it —
+   otherwise run manifests lose per-entry-point compile attribution
+   and the no-recompile audits go dark for that module.
 
 Exit 0 when clean, 1 with one line per violation. Run by
-``tests/test_robust.py`` (and re-asserted by ``tests/test_serve.py``
-and ``tests/test_assoc.py``) so the pass is enforced in tier-1.
+``tests/test_robust.py`` (and re-asserted by ``tests/test_serve.py``,
+``tests/test_assoc.py``, and ``tests/test_obs.py``) so the pass is
+enforced in tier-1.
 """
 
 from __future__ import annotations
@@ -76,9 +88,17 @@ RAW_LSE_ATTRS = ("logaddexp", "logsumexp")
 # cannot see
 RAW_LSE_WRAPPERS = ("logsumexp", "log_vecmat", "log_matvec", "log_normalize")
 
+# invariant 5b: registration hooks a jax.jit-creating serve/bench module
+# must import from the telemetry module and call. Only register_jit
+# counts: install_listeners alone turns on the global compile listener
+# without attributing any entry point, so accepting it would let a
+# module's jits stay invisible to jit_cache_sizes()/run manifests —
+# exactly the condition the invariant exists to prevent.
+TELEMETRY_MODULES = ("hhmm_tpu.obs.telemetry", "hhmm_tpu.obs")
+TELEMETRY_HOOKS = ("register_jit",)
 
-def _bare_excepts(path: pathlib.Path, rel: str, problems: List[str]) -> None:
-    tree = ast.parse(path.read_text(), filename=str(path))
+
+def _bare_excepts(tree: ast.Module, rel: str, problems: List[str]) -> None:
     for node in ast.walk(tree):
         if isinstance(node, ast.ExceptHandler) and node.type is None:
             problems.append(f"{rel}:{node.lineno}: bare `except:` (name the exception types)")
@@ -103,13 +123,145 @@ def _called_names(tree: ast.Module) -> set:
     return calls
 
 
+def _check_raw_time(tree: ast.Module, rel: str, problems: List[str]) -> None:
+    """Invariant 5a: flag every ``<time-module-alias>.time()`` call and
+    every ``from time import time`` binding. ``perf_counter`` /
+    ``monotonic`` reads (and the `obs/trace.py` helpers built on them)
+    are the sanctioned clocks."""
+    aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "time":
+                    aliases.add(alias.asname or alias.name)
+        elif isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name == "time":
+                    problems.append(
+                        f"{rel}:{node.lineno}: imports raw `time.time` — "
+                        "use time.perf_counter (or hhmm_tpu.obs.trace)"
+                    )
+    if not aliases:
+        return
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "time"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in aliases
+        ):
+            problems.append(
+                f"{rel}:{node.lineno}: raw `{node.func.value.id}.time()` "
+                "timing read — wall-clock steps corrupt throughput "
+                "records; use time.perf_counter (or hhmm_tpu.obs.trace)"
+            )
+
+
+_JIT_MAKERS = ("jit", "pjit", "pmap")
+
+
+def _uses_jax_jit(tree: ast.Module) -> bool:
+    """True when the module creates jit entry points — either the
+    attribute form (``jax.jit``/``jax.pjit``/``jax.pmap``) or names
+    imported from jax (``from jax import jit``); both spellings must
+    trip invariant 5b or the check is trivially evaded."""
+    jitted_names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module in (
+            "jax",
+            "jax.experimental.pjit",
+        ):
+            for alias in node.names:
+                if alias.name in _JIT_MAKERS:
+                    jitted_names.add(alias.asname or alias.name)
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Attribute)
+            and node.attr in _JIT_MAKERS
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "jax"
+        ):
+            return True
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in jitted_names
+        ):
+            return True
+    return False
+
+
+def _check_telemetry_registration(
+    tree: ast.Module, rel: str, problems: List[str]
+) -> None:
+    """Invariant 5b: a serve/bench module creating jax.jit entry points
+    must import a telemetry hook (directly or via the telemetry module)
+    and call it."""
+    if not _uses_jax_jit(tree):
+        return
+    direct = _imported_symbols(tree, TELEMETRY_MODULES) & set(TELEMETRY_HOOKS)
+    module_aliases = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "hhmm_tpu.obs":
+            for alias in node.names:
+                if alias.name == "telemetry":
+                    module_aliases.add(alias.asname or alias.name)
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "hhmm_tpu.obs.telemetry":
+                    module_aliases.add(
+                        alias.asname or "hhmm_tpu.obs.telemetry"
+                    )
+    called = bool(direct & _called_names(tree))
+    if not called and module_aliases:
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in TELEMETRY_HOOKS
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in module_aliases
+            ):
+                called = True
+                break
+    if not (direct or module_aliases):
+        problems.append(
+            f"{rel}: creates jax.jit entry points but never imports a "
+            f"telemetry hook from {TELEMETRY_MODULES[0]} (expected one "
+            f"of {TELEMETRY_HOOKS}) — compile counts would be "
+            "unattributable in run manifests"
+        )
+    elif not called:
+        problems.append(
+            f"{rel}: imports telemetry but never calls a registration "
+            f"hook ({TELEMETRY_HOOKS}) — jit entry points are "
+            "unregistered"
+        )
+
+
 def check(root: pathlib.Path) -> List[str]:
     problems: List[str] = []
     pkg = root / "hhmm_tpu"
     if not pkg.is_dir():
         return [f"{root}: no hhmm_tpu/ package to check"]
+    # one parse per package file, shared by every tree-walking invariant
+    serve_dir = pkg / "serve"
     for py in sorted(pkg.rglob("*.py")):
-        _bare_excepts(py, str(py.relative_to(root)), problems)
+        rel = str(py.relative_to(root))
+        tree = ast.parse(py.read_text(), filename=str(py))
+        _bare_excepts(tree, rel, problems)
+        # invariant 5a: monotonic clocks only, package-wide
+        _check_raw_time(tree, rel, problems)
+        # invariant 5b over the serving layer: every module with a
+        # jax.jit entry point registers it with the telemetry registry
+        if py.parent == serve_dir:
+            _check_telemetry_registration(tree, rel, problems)
+    bench = root / "bench.py"
+    if bench.is_file():
+        btree = ast.parse(bench.read_text(), filename=str(bench))
+        _check_raw_time(btree, "bench.py", problems)
+        _check_telemetry_registration(btree, "bench.py", problems)
 
     def check_guarded(spec, source_modules, kind, noun, what):
         for rel, guard_fns in sorted(spec.items()):
@@ -205,7 +357,8 @@ def main(argv: List[str]) -> int:
         return 1
     print(
         "check_guards: ok (no bare excepts; all samplers guarded; "
-        "online serve step guarded; semiring combines guarded)"
+        "online serve step guarded; semiring combines guarded; "
+        "monotonic clocks only; serve/bench jits telemetry-registered)"
     )
     return 0
 

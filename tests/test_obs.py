@@ -1,0 +1,587 @@
+"""Observability subsystem tests (`hhmm_tpu/obs/`, `scripts/bench_diff.py`).
+
+Covers the contracts the rest of the stack leans on:
+
+- span nesting + aggregation determinism (injectable clock — the same
+  event multiset must aggregate to the same table, percentiles by
+  exact order statistic);
+- the disabled-mode fast path (shared no-op singleton, nothing
+  recorded, ``sync`` never blocks);
+- compile-counter flatness on a re-jitted-twice toy kernel (warm calls
+  add zero backend compiles; a new shape adds exactly one trace to the
+  registered entry point's cache);
+- `serve/metrics.py` routing its compile counter through the telemetry
+  registry with the ``summary()`` schema unchanged;
+- manifest round-trip + corrupt-file tolerance (`batch/cache.py`
+  discipline: quarantine aside, read as miss);
+- `scripts/bench_diff.py` pass/fail fixtures AND exit 0 over the
+  checked-in BENCH_*.json trajectory;
+- `scripts/check_guards.py` invariant 5 (raw ``time.time()`` and
+  unregistered serve/bench jits are flagged; the repo passes).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hhmm_tpu.obs import manifest as obs_manifest
+from hhmm_tpu.obs import telemetry, trace
+from hhmm_tpu.obs.trace import Tracer, _NULL_SPAN
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _FakeClock:
+    """Deterministic clock: +1.0 per read."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        self.t += 1.0
+        return self.t
+
+
+class TestSpans:
+    def test_nesting_paths_and_aggregate_determinism(self):
+        def run_once():
+            t = Tracer(clock=_FakeClock())
+            t.enable()
+            with t.span("outer"):
+                with t.span("inner"):
+                    pass
+                with t.span("inner"):
+                    pass
+            return t
+
+        t1, t2 = run_once(), run_once()
+        evs = t1.events()
+        # completion order: inner, inner, outer; nested paths recorded
+        assert [e["name"] for e in evs] == ["inner", "inner", "outer"]
+        assert [e["path"] for e in evs] == [
+            "outer/inner",
+            "outer/inner",
+            "outer",
+        ]
+        agg1, agg2 = t1.aggregate(), t2.aggregate()
+        assert agg1 == agg2  # fully deterministic under the fake clock
+        assert agg1["inner"]["count"] == 2
+        assert agg1["outer"]["count"] == 1
+        # fake clock: every span body costs exactly one tick except
+        # outer, which spans its children's reads too
+        assert agg1["inner"]["total_s"] == pytest.approx(2.0)
+
+    def test_percentiles_exact_order_statistic(self):
+        clock = _FakeClock()
+        t = Tracer(clock=clock)
+        t.enable()
+        # 100 spans with durations 1..100 (each __exit__ adds one extra
+        # clock read inside _record? no — enter reads once, exit reads
+        # once: duration == 1 tick unless we stretch it manually)
+        for i in range(100):
+            sp = t.span("s")
+            sp.__enter__()
+            clock.t += i  # stretch: durations 1, 2, ..., 100
+            sp.__exit__(None, None, None)
+        agg = t.aggregate()["s"]
+        durs = sorted(e["dur_s"] for e in t.events())
+        assert durs == [float(i) for i in range(1, 101)]
+        assert agg["p50_ms"] == pytest.approx(50 * 1e3)
+        assert agg["p99_ms"] == pytest.approx(99 * 1e3)
+        assert agg["max_ms"] == pytest.approx(100 * 1e3)
+
+    def test_disabled_fast_path_shared_singleton(self):
+        t = Tracer()
+        t.disable()
+        assert t.span("a") is t.span("b") is _NULL_SPAN
+        with t.span("a"):
+            pass
+        t.event("e")
+        assert t.events() == []
+        # sync on the null span is identity — never blocks, never touches jax
+        obj = object()
+        assert t.span("x").sync(obj) is obj
+
+    def test_env_flag(self, monkeypatch):
+        t = Tracer()
+        monkeypatch.delenv("HHMM_TPU_TRACE", raising=False)
+        assert not t.enabled()
+        # the env read is cached (the disabled fast path must not pay
+        # an os.environ lookup per span site): a mid-process change is
+        # only seen through use_env()
+        monkeypatch.setenv("HHMM_TPU_TRACE", "1")
+        assert not t.enabled()
+        t.use_env()
+        assert t.enabled()
+        monkeypatch.setenv("HHMM_TPU_TRACE", "0")
+        t.use_env()
+        assert not t.enabled()
+        # every common falsy spelling DISABLES (a misread would flip
+        # the samplers to blocking sync boundaries)
+        for v in ("off", "OFF", "FALSE", "No", " 0 "):
+            monkeypatch.setenv("HHMM_TPU_TRACE", v)
+            t.use_env()
+            assert not t.enabled(), v
+        t.enable()  # explicit override beats the env
+        assert t.enabled()
+
+    def test_bounded_event_log_and_streaming_aggregate(self):
+        # a traced serving host emits spans per tick indefinitely: the
+        # raw event window is bounded, the aggregate stays exact on
+        # count/total/max with a decimated percentile sample
+        clock = _FakeClock()
+        t = Tracer(clock=clock, max_events=16, sample_cap=8)
+        t.enable()
+        for i in range(100):
+            sp = t.span("tick")
+            sp.__enter__()
+            clock.t += i  # durations 1, 2, ..., 100
+            sp.__exit__(None, None, None)
+        assert len(t.events()) == 16  # window, oldest evicted
+        assert t.dropped() == 100 - 16
+        agg = t.aggregate()["tick"]
+        assert agg["count"] == 100  # exact despite eviction
+        assert agg["total_s"] == pytest.approx(sum(range(1, 101)))
+        assert agg["max_ms"] == pytest.approx(100 * 1e3)
+        # percentiles come from the bounded stride sample — within it
+        assert 0 < agg["p50_ms"] <= agg["p99_ms"] <= agg["max_ms"]
+        # deterministic: an identical run aggregates identically
+        clock2 = _FakeClock()
+        t2 = Tracer(clock=clock2, max_events=16, sample_cap=8)
+        t2.enable()
+        for i in range(100):
+            sp = t2.span("tick")
+            sp.__enter__()
+            clock2.t += i
+            sp.__exit__(None, None, None)
+        assert t2.aggregate() == t.aggregate()
+        t.reset()
+        assert t.events() == [] and t.dropped() == 0 and t.aggregate() == {}
+
+    def test_traced_decorator_and_annotate(self):
+        t = Tracer(clock=_FakeClock())
+        t.enable()
+
+        @t.traced("work")
+        def f(x):
+            return x + 1
+
+        assert f(1) == 2
+        with t.span("s") as sp:
+            sp.annotate(K=4, branch="seq")
+        evs = {e["name"]: e for e in t.events()}
+        assert evs["work"]["dur_s"] > 0
+        assert evs["s"]["meta"] == {"K": 4, "branch": "seq"}
+
+    def test_jsonl_export_roundtrip(self, tmp_path):
+        t = Tracer(clock=_FakeClock())
+        t.enable()
+        with t.span("a"):
+            pass
+        path = str(tmp_path / "spans.jsonl")
+        n = t.export_jsonl(path)
+        lines = [json.loads(line) for line in open(path)]
+        assert n == len(lines) == 1
+        assert lines[0]["name"] == "a"
+
+    def test_thread_safety_independent_nesting(self):
+        import threading
+
+        t = Tracer()
+        t.enable()
+        errs = []
+
+        def worker(name):
+            try:
+                for _ in range(50):
+                    with t.span(name):
+                        with t.span(name + ".in"):
+                            pass
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=worker, args=(f"w{i}",)) for i in range(4)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert not errs
+        agg = t.aggregate()
+        for i in range(4):
+            assert agg[f"w{i}"]["count"] == 50
+            assert agg[f"w{i}.in"]["count"] == 50
+        # nesting never crossed threads: every inner path is its own parent's
+        for e in t.events():
+            if e["name"].endswith(".in"):
+                assert e["path"] == e["name"].replace(".in", "") + "/" + e["name"]
+
+
+class TestCompileTelemetry:
+    def test_compile_counter_flat_on_warm_rejit(self):
+        reg = telemetry.CompileRegistry()
+        assert reg.install_listeners()
+        try:
+            f = reg.register_jit("toy", jax.jit(lambda x: x * 2 + 1))
+            f(jnp.ones(4)).block_until_ready()
+            c_warm = reg.backend_compiles()
+            assert c_warm >= 1
+            # warm replay, twice: the counter must be FLAT
+            f(jnp.ones(4)).block_until_ready()
+            f(jnp.ones(4)).block_until_ready()
+            assert reg.backend_compiles() == c_warm
+            assert reg.jit_cache_sizes()["toy"] == 1
+            # a new shape is one new traced signature and >= 1 compile
+            f(jnp.ones(8)).block_until_ready()
+            assert reg.backend_compiles() > c_warm
+            assert reg.jit_cache_sizes()["toy"] == 2
+            secs = reg.compile_seconds()
+            assert secs.get("backend_compile_duration", 0.0) > 0.0
+        finally:
+            reg.uninstall_listeners()
+
+    def test_registry_holds_weakrefs_and_prunes_dead(self):
+        reg = telemetry.CompileRegistry()
+        f = reg.register_jit("gone", jax.jit(lambda x: x))
+        f(jnp.ones(2)).block_until_ready()
+        assert reg.jit_cache_sizes()["gone"] == 1
+        del f
+        import gc
+
+        gc.collect()
+        # all-dead names are pruned from reads, not reported 0 forever
+        assert "gone" not in reg.jit_cache_sizes()
+        # re-registering under the same name does not grow the ref list
+        for _ in range(5):
+            g = reg.register_jit("churn", jax.jit(lambda x: x + 1))
+        g(jnp.ones(2)).block_until_ready()
+        assert reg.jit_cache_sizes()["churn"] == 1
+
+    def test_serve_metrics_routes_through_scope(self):
+        from hhmm_tpu.serve.metrics import ServeMetrics
+
+        m = ServeMetrics()
+        m.set_compile_count(7)
+        assert m.compile_count == 7
+        # the registry sees the serving counter without knowing the class
+        assert telemetry.scope_counts().get("serve.compile_count", 0) >= 7
+        # summary schema keys unchanged (bench.py --serve / test_serve.py
+        # consumers)
+        s = m.summary()
+        assert s["compile_count"] == 7
+        assert set(s) == {
+            "requests",
+            "ticks",
+            "flushes",
+            "ticks_per_sec",
+            "latency_p50_ms",
+            "latency_p90_ms",
+            "latency_p99_ms",
+            "degraded_responses",
+            "degraded_attaches",
+            "superseded_responses",
+            "compile_count",
+        }
+
+    def test_sample_memory_tolerant(self):
+        # CPU backend hides memory_stats: must be {} (not an exception),
+        # and the peak watermark stays a dict
+        out = telemetry.sample_memory()
+        assert isinstance(out, dict)
+        assert isinstance(telemetry.peak_memory(), dict)
+
+
+class TestDispatchSpans:
+    def test_branch_recorded_in_span_table(self):
+        from hhmm_tpu.kernels.dispatch import (
+            ffbs_dispatch,
+            forward_filter_dispatch,
+        )
+
+        K, T = 3, 16
+        log_pi = jnp.log(jnp.full((K,), 1.0 / K))
+        log_A = jnp.log(jnp.full((K, K), 1.0 / K))
+        log_obs = jnp.zeros((T, K))
+        trace.tracer.enable()
+        base = trace.events()
+        try:
+            forward_filter_dispatch(log_pi, log_A, log_obs)
+            forward_filter_dispatch(
+                log_pi, log_A, log_obs, time_parallel=True
+            )
+            ffbs_dispatch(jax.random.PRNGKey(0), log_pi, log_A, log_obs)
+            names = {e["name"] for e in trace.events()[len(base) :]}
+        finally:
+            trace.tracer.use_env()
+            trace.reset()
+        assert "kernels.dispatch.forward_filter[seq]" in names
+        assert "kernels.dispatch.forward_filter[assoc]" in names
+        assert "kernels.dispatch.ffbs[fused]" in names
+        # the kernels themselves contribute spans nested under dispatch
+        assert "kernels.forward_filter" in names
+        assert "kernels.ffbs" in names
+
+
+class TestManifest:
+    def test_roundtrip_atomic(self, tmp_path):
+        man = obs_manifest.collect_manifest(
+            config={"series": 8, "T": 128}, seed=42
+        )
+        assert man["version"] == obs_manifest.MANIFEST_VERSION
+        assert man["versions"]["jax"] == jax.__version__
+        assert man["workload_digest"]
+        assert man["backend"] == "cpu"
+        path = str(tmp_path / "manifest.json")
+        obs_manifest.write_manifest(path, man)
+        man2 = obs_manifest.load_manifest(path)
+        # round-trip through JSON: identity up to JSON-representable types
+        assert man2 == json.loads(json.dumps(man, default=str))
+
+    def test_workload_digest_tracks_config(self):
+        m1 = obs_manifest.collect_manifest(config={"T": 128}, seed=1)
+        m2 = obs_manifest.collect_manifest(config={"T": 128}, seed=1)
+        m3 = obs_manifest.collect_manifest(config={"T": 256}, seed=1)
+        assert m1["workload_digest"] == m2["workload_digest"]
+        assert m1["workload_digest"] != m3["workload_digest"]
+
+    def test_observability_flags_do_not_fork_workload_digest(self):
+        """The bench_diff comparability key must be blind to output
+        paths/profiler flags — otherwise adding --manifest-out in CI
+        makes every record its own baseline and the gate fails open."""
+        import argparse
+
+        import bench
+
+        def ns(**over):
+            base = {
+                "series": 256, "T": 1024, "sampler": "gibbs",
+                "manifest_out": None, "profile": None,
+            }
+            base.update(over)
+            return argparse.Namespace(**base)
+
+        a1, a2 = ns(), ns(manifest_out="/tmp/m.json", profile="/tmp/prof")
+        m1 = obs_manifest.collect_manifest(
+            config=vars(a1), workload_config=bench.workload_config(a1)
+        )
+        m2 = obs_manifest.collect_manifest(
+            config=vars(a2), workload_config=bench.workload_config(a2)
+        )
+        assert m1["workload_digest"] == m2["workload_digest"]
+        a3 = ns(T=2048)  # a REAL workload change still forks the key
+        m3 = obs_manifest.collect_manifest(
+            config=vars(a3), workload_config=bench.workload_config(a3)
+        )
+        assert m1["workload_digest"] != m3["workload_digest"]
+
+    def test_missing_and_corrupt_tolerated(self, tmp_path, capsys):
+        assert obs_manifest.load_manifest(str(tmp_path / "nope.json")) is None
+        bad = tmp_path / "torn.json"
+        bad.write_bytes(b'{"version": 1, "half-writ')
+        assert obs_manifest.load_manifest(str(bad)) is None
+        # quarantined aside so a re-write under the same name works
+        assert not bad.exists()
+        assert (tmp_path / "torn.json.corrupt").exists()
+        # a JSON file that isn't a manifest is corrupt too
+        notman = tmp_path / "not_manifest.json"
+        notman.write_text('{"hello": "world"}')
+        assert obs_manifest.load_manifest(str(notman)) is None
+
+    def test_manifest_stanza_compact(self):
+        st = obs_manifest.manifest_stanza(config={"T": 64})
+        assert "spans" not in st and "argv" not in st
+        assert {"workload_digest", "span_count", "backend_compiles"} <= set(st)
+
+
+def _run_bench_diff(*argv):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "bench_diff.py"), *argv],
+        capture_output=True,
+        text=True,
+    )
+
+
+def _write_fixture_rounds(d, values, stamped=True, traced=None):
+    for n, v in enumerate(values, start=1):
+        rec = {
+            "metric": "fixture_throughput",
+            "value": v,
+            "unit": "series/sec",
+            "backend": "cpu",
+        }
+        if stamped:
+            rec["manifest"] = {
+                "workload_digest": "wfix",
+                "device_kind": "cpu",
+                "versions": {"jax": "0.0-test"},
+                "trace_enabled": bool(traced[n - 1]) if traced else False,
+            }
+        (d / f"BENCH_r{n:02d}.json").write_text(
+            json.dumps({"n": n, "rc": 0, "parsed": rec})
+        )
+
+
+class TestBenchDiff:
+    def test_checked_in_trajectory_exits_zero(self):
+        proc = _run_bench_diff("--dir", REPO)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        # a readable per-metric delta table
+        assert "tayal_batched_posterior_throughput" in proc.stdout
+        assert "Δ%" in proc.stdout
+
+    def test_regression_fails(self, tmp_path):
+        _write_fixture_rounds(tmp_path, [100.0, 80.0])
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 1, proc.stdout
+        assert "REGRESSION" in proc.stdout
+
+    def test_within_threshold_passes(self, tmp_path):
+        _write_fixture_rounds(tmp_path, [100.0, 95.0])
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 0, proc.stdout
+        assert "ok vs round" in proc.stdout
+
+    def test_improvement_passes(self, tmp_path):
+        _write_fixture_rounds(tmp_path, [100.0, 140.0])
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 0, proc.stdout
+
+    def test_unstamped_records_never_gate(self, tmp_path):
+        _write_fixture_rounds(tmp_path, [100.0, 10.0], stamped=False)
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 0, proc.stdout
+        assert "ungated" in proc.stdout
+
+    def test_crashed_round_reported_not_fatal(self, tmp_path):
+        _write_fixture_rounds(tmp_path, [100.0, 99.0])
+        (tmp_path / "BENCH_r03.json").write_text(
+            json.dumps({"n": 3, "rc": 1, "tail": "Traceback ...", "parsed": None})
+        )
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 0, proc.stdout
+        assert "CRASHED" in proc.stdout
+
+    def test_threshold_flag(self, tmp_path):
+        _write_fixture_rounds(tmp_path, [100.0, 95.0])
+        proc = _run_bench_diff("--dir", str(tmp_path), "--threshold", "2")
+        assert proc.returncode == 1, proc.stdout
+
+    def test_trace_regime_never_gates_across(self, tmp_path):
+        # a traced run pays sync + span overhead: it must not gate
+        # against an untraced baseline of the same workload (each
+        # regime is its own comparability key)
+        _write_fixture_rounds(
+            tmp_path, [100.0, 10.0], traced=[False, True]
+        )
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 0, proc.stdout
+        assert proc.stdout.count("baseline for its workload/stack key") == 2
+
+    def test_trace_regime_gates_within(self, tmp_path):
+        _write_fixture_rounds(
+            tmp_path, [100.0, 80.0], traced=[True, True]
+        )
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 1, proc.stdout
+        assert "REGRESSION" in proc.stdout
+
+
+class TestCheckGuardsInvariant5:
+    def test_repo_passes(self):
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "check_guards.py")],
+            capture_output=True,
+            text=True,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "monotonic clocks" in proc.stdout
+
+    def _run_on(self, tmp_path):
+        return subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "scripts", "check_guards.py"),
+                str(tmp_path),
+            ],
+            capture_output=True,
+            text=True,
+        )
+
+    def test_raw_time_time_flagged(self, tmp_path):
+        pkg = tmp_path / "hhmm_tpu"
+        pkg.mkdir()
+        (pkg / "slow.py").write_text(
+            "import time as _t\n\ndef f():\n    return _t.time()\n"
+        )
+        proc = self._run_on(tmp_path)
+        assert proc.returncode == 1
+        assert "_t.time()" in proc.stdout
+
+    def test_raw_time_in_bench_flagged(self, tmp_path):
+        (tmp_path / "hhmm_tpu").mkdir()
+        (tmp_path / "bench.py").write_text(
+            "import time\n\ndef f():\n    return time.time()\n"
+        )
+        proc = self._run_on(tmp_path)
+        assert proc.returncode == 1
+        assert "bench.py" in proc.stdout and "time.time()" in proc.stdout
+
+    def test_unregistered_serve_jit_flagged(self, tmp_path):
+        serve = tmp_path / "hhmm_tpu" / "serve"
+        serve.mkdir(parents=True)
+        (serve / "fast.py").write_text(
+            "import jax\n\nf = jax.jit(lambda x: x)\n"
+        )
+        proc = self._run_on(tmp_path)
+        assert proc.returncode == 1
+        assert "telemetry" in proc.stdout
+
+    def test_from_jax_import_jit_flagged(self, tmp_path):
+        # the bare-name spelling must trip invariant 5b too, or the
+        # check is trivially evaded
+        serve = tmp_path / "hhmm_tpu" / "serve"
+        serve.mkdir(parents=True)
+        (serve / "fast.py").write_text(
+            "from jax import jit\n\nf = jit(lambda x: x)\n"
+        )
+        proc = self._run_on(tmp_path)
+        assert proc.returncode == 1
+        assert "telemetry" in proc.stdout
+
+    def test_install_listeners_alone_insufficient(self, tmp_path):
+        # only register_jit attributes an entry point; the global
+        # listener must not satisfy the serve-module invariant
+        serve = tmp_path / "hhmm_tpu" / "serve"
+        serve.mkdir(parents=True)
+        (serve / "fast.py").write_text(
+            "import jax\n"
+            "from hhmm_tpu.obs.telemetry import install_listeners\n\n"
+            "install_listeners()\n"
+            "f = jax.jit(lambda x: x)\n"
+        )
+        proc = self._run_on(tmp_path)
+        assert proc.returncode == 1
+        assert "telemetry" in proc.stdout
+
+    def test_registered_serve_jit_passes(self, tmp_path):
+        serve = tmp_path / "hhmm_tpu" / "serve"
+        serve.mkdir(parents=True)
+        (serve / "fast.py").write_text(
+            "import jax\n"
+            "from hhmm_tpu.obs.telemetry import register_jit\n\n"
+            "f = register_jit('fast', jax.jit(lambda x: x))\n"
+        )
+        proc = self._run_on(tmp_path)
+        # the toy repo trips OTHER invariants (missing sampler modules);
+        # the telemetry registration itself must be clean
+        assert "telemetry" not in proc.stdout, proc.stdout
