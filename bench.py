@@ -73,6 +73,7 @@ import jax
 import jax.numpy as jnp
 
 from hhmm_tpu.obs import manifest as obs_manifest
+from hhmm_tpu.obs import metrics as obs_metrics
 from hhmm_tpu.obs import telemetry, trace
 # the project's canonical timing read (obs/trace.py): perf_counter is
 # monotonic — a wall-clock step (NTP, suspend) under a time.time read
@@ -218,6 +219,17 @@ def emit_manifest(args, mode: str, record: dict, model=None) -> None:
     )
     obs_manifest.write_manifest(path, man)
     print(f"# run manifest written to {path}", file=sys.stderr, flush=True)
+    # the statistical-health plane rides along: the same snapshot is
+    # embedded in the manifest's "metrics" stanza, but the JSONL export
+    # is the scrape-friendly form (one instrument per line, atomic)
+    if obs_metrics.snapshot():
+        mpath = os.path.splitext(path)[0] + ".metrics.jsonl"
+        n = obs_metrics.export_jsonl(mpath)
+        print(
+            f"# metrics export ({n} instruments) written to {mpath}",
+            file=sys.stderr,
+            flush=True,
+        )
 
 
 def serve_bench(args, backend, degraded) -> None:
@@ -242,7 +254,9 @@ def serve_bench(args, backend, degraded) -> None:
     from hhmm_tpu.serve import (
         MicroBatchScheduler,
         ServeMetrics,
+        SLOSpec,
         SnapshotRegistry,
+        evaluate_slo,
         snapshot_from_fit,
     )
 
@@ -329,6 +343,21 @@ def serve_bench(args, backend, degraded) -> None:
     compiles_after_warmup = metrics.compile_count - compiles_warm
     n_timed = (ticks - warm_n) * B
     summary = metrics.summary()
+    # SLO attainment (serve/metrics.py): the explicit serving objectives
+    # — p99 tick latency, snapshot staleness, recompile budget — judged
+    # over the steady-state window and embedded in the manifest stanza
+    # so scripts/bench_diff.py gates an attained->unmet transition the
+    # same way it gates a throughput drop
+    slo = evaluate_slo(
+        SLOSpec(
+            p99_latency_ms=args.slo_p99_ms,
+            max_staleness_s=args.slo_staleness_s,
+            max_post_warmup_recompiles=args.slo_recompiles,
+        ),
+        p99_latency_ms=summary["latency_p99_ms"],
+        staleness_s=metrics.peak_staleness_seconds(),
+        post_warmup_recompiles=compiles_after_warmup,
+    )
     print(
         json.dumps(
             {
@@ -358,6 +387,7 @@ def serve_bench(args, backend, degraded) -> None:
             "degraded_responses": summary["degraded_responses"],
             "compile_count": summary["compile_count"],
             "compiles_after_warmup": compiles_after_warmup,
+            "slo_attained": slo["attained"],
             "backend": backend["backend"],
             "backend_fallback": backend["fallback"],
             "degraded_cpu_smoke": degraded,
@@ -365,7 +395,20 @@ def serve_bench(args, backend, degraded) -> None:
         args,
         model=model,
     )
+    # the stanza is the bench_diff-visible surface: attainment plus the
+    # per-check verdicts ride inside it (stamp_record built the stanza)
+    serve_record["manifest"]["slo"] = slo
     print(json.dumps(serve_record))
+    print(
+        "# serve SLO "
+        + ("ATTAINED" if slo["attained"] else "UNMET")
+        + ": "
+        + ", ".join(
+            f"{k}={c['observed']}/{c['limit']}{'' if c['ok'] else ' FAIL'}"
+            for k, c in slo["checks"].items()
+        ),
+        file=sys.stderr,
+    )
     emit_manifest(args, "serve", serve_record, model=model)
     if compiles_after_warmup != 0:
         print(
@@ -591,6 +634,31 @@ def main() -> None:
         default=32,
         help="serve: thinned posterior draws per snapshot (fixed across "
         "series for compile stability)",
+    )
+    ap.add_argument(
+        "--slo-p99-ms",
+        type=float,
+        default=250.0,
+        help="serve SLO: max p99 tick latency (ms) the serve bench must "
+        "attain; the verdict is embedded in the record's manifest "
+        "stanza and gated by scripts/bench_diff.py (serve/metrics.py "
+        "SLOSpec). A gate definition, not workload — excluded from the "
+        "workload digest",
+    )
+    ap.add_argument(
+        "--slo-staleness-s",
+        type=float,
+        default=900.0,
+        help="serve SLO: max snapshot staleness (seconds since the "
+        "oldest serving posterior was attached) observed in the "
+        "measurement window",
+    )
+    ap.add_argument(
+        "--slo-recompiles",
+        type=int,
+        default=0,
+        help="serve SLO: max post-warmup XLA recompiles (0 = the "
+        "scheduler's compile-stability contract)",
     )
     ap.add_argument("--quick", action="store_true", help="tiny config for smoke tests")
     ap.add_argument(

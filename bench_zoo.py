@@ -28,6 +28,18 @@ fits on an accelerator are latency-bound, not throughput-bound — the
 batched configs in `bench.py` are where the hardware pays off; these
 numbers exist to show *every* reference workload still beats its CPU
 wall-clock without batching.
+
+Provenance (observability PR discipline): every row is stamped with
+the same jax/jaxlib/device-kind fields and compact manifest stanza
+`bench.py` emits, so zoo records are `scripts/bench_diff.py`-gateable
+instead of permanently ungated. The row's own unit (``sec/fit``) is
+latency-shaped and therefore never throughput-gated; each row is
+accompanied by a ``<metric>_ess_rate`` record (``ess/sec`` — the
+quality-normalized throughput BASELINE.md ranks by), which IS gated
+between manifest-comparable rounds. The final (post-re-budget) draw
+count is part of the workload digest: rows fitted at different budgets
+are different workloads and must never gate against each other — the
+exact r01-vs-r04 trap the manifest stanza exists to close.
 """
 
 from __future__ import annotations
@@ -35,12 +47,15 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-import time
+from time import perf_counter
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+from hhmm_tpu.obs import manifest as obs_manifest
+from hhmm_tpu.obs.telemetry import install_listeners, register_jit
 
 
 def _time_fit(model, data, config, key, fused_traj=False):
@@ -133,11 +148,14 @@ def _time_fit(model, data, config, key, fused_traj=False):
             qs, stats = jax.vmap(one)(theta0[None], key[None])
             return qs[0], {k: v[0] for k, v in stats.items()}
 
-    runj = jax.jit(run)
+    # registered entry point (check_guards invariant 5b): run manifests
+    # attribute the zoo's compile counts like every other bench jit
+    runj = register_jit("bench_zoo.run", jax.jit(run))
     jax.block_until_ready(runj(jax.random.PRNGKey(999)))  # compile
-    t0 = time.time()
+    # monotonic clock only (check_guards invariant 5a)
+    t0 = perf_counter()
     _, stats = jax.block_until_ready(runj(key))
-    dt = time.time() - t0
+    dt = perf_counter() - t0
     div = float(np.asarray(stats["diverging"]).mean())
     lp = np.asarray(stats["logp"])
     ess_lp = float(ess(lp.reshape(-1, lp.shape[-1])))
@@ -265,6 +283,10 @@ def main() -> None:
     ap.add_argument("--max-samples", type=int, default=16_000)
     ap.add_argument("--cpu", action="store_true", help="force the CPU backend")
     args = ap.parse_args()
+    # compile telemetry before the first jit (the bench.py discipline):
+    # the manifest stanzas stamped onto every row then carry the run's
+    # real backend-compile counts instead of a dead listener
+    install_listeners()
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
 
@@ -317,6 +339,31 @@ def main() -> None:
             # at least doubling
             factor = max(2.0, 1.5 * args.min_ess / max(ess_lp, 1e-3))
             samples = min(args.max_samples, int(samples * factor))
+        # stamp + manifest stanza (obs/manifest.py): the bench.py record
+        # discipline — host/stack identity on the record itself, and the
+        # workload-digest comparability key bench_diff gates on. The
+        # digest is computed from the ROW's measured workload (config
+        # name, sampler, chains, budgets, FINAL re-budgeted draw count)
+        # — not argparse state like output flags
+        versions = obs_manifest.stack_versions()
+        wl = {
+            "config": name,
+            "sampler": args.sampler,
+            "chains": args.chains,
+            "warmup": args.warmup,
+            "samples": samples,
+            "max_treedepth": args.max_treedepth,
+            "max_leapfrogs": args.max_leapfrogs,
+            "cpu": args.cpu,
+        }
+        stanza = obs_manifest.manifest_stanza(
+            config=vars(args), seed=7, workload_config=wl
+        )
+        stamp = {
+            "jax_version": versions.get("jax"),
+            "jaxlib_version": versions.get("jaxlib"),
+            "device_kind": obs_manifest.device_info().get("device_kind"),
+        }
         row = {
             "metric": metric,
             "value": round(dt, 3),
@@ -326,6 +373,8 @@ def main() -> None:
             "ess_lp": round(ess_lp, 1),
             "ess_lp_per_sec": round(ess_lp / dt, 1),
             "samples": samples,
+            **stamp,
+            "manifest": stanza,
         }
         if len(trail) > 1:
             row["rebudget_trail"] = trail
@@ -334,6 +383,22 @@ def main() -> None:
         # print each row AS IT COMPLETES: a crash in a later config
         # (device fault, OOM) must not lose the finished rows
         print(json.dumps(row), flush=True)
+        # the GATEABLE companion: quality-normalized throughput in a
+        # /sec unit, same manifest identity — bench_diff binds on it
+        if "quality_flag" not in row:
+            print(
+                json.dumps(
+                    {
+                        "metric": f"{metric}_ess_rate",
+                        "value": row["ess_lp_per_sec"],
+                        "unit": "ess/sec",
+                        "samples": samples,
+                        **stamp,
+                        "manifest": stanza,
+                    }
+                ),
+                flush=True,
+            )
         rows.append(row)
     # ESS/sec ranking of the finished ladder — the quality-normalized
     # ordering (BASELINE.md "ESS/sec vs Stan NUTS baseline")

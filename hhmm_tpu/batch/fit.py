@@ -23,6 +23,7 @@ Key design points:
 
 from __future__ import annotations
 
+import sys
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -31,6 +32,8 @@ import numpy as np
 
 from hhmm_tpu.batch.cache import ResultCache, digest_key
 from hhmm_tpu.infer.api import sample
+from hhmm_tpu.infer.diagnostics import ess_many, split_rhat_many
+from hhmm_tpu.obs import metrics as obs_metrics
 from hhmm_tpu.obs.trace import span
 from hhmm_tpu.infer.chees import ChEESConfig, make_lp_bc, sample_chees_batched
 from hhmm_tpu.infer.gibbs import GibbsConfig, sample_gibbs
@@ -42,6 +45,67 @@ __all__ = ["default_init", "fit_batched"]
 
 # base backoff between chunk retries on device faults (tests zero this)
 _RETRY_SLEEP_S = 15.0
+
+# bound on the (series × parameter) rows fed to the interim per-chunk
+# convergence estimators — a 512-series × 100-dim chunk must not pay a
+# 51k-row FFT per chunk for telemetry; rows beyond the cap are
+# stride-decimated deterministically (the obs/trace.py sample discipline)
+_INTERIM_MAX_ROWS = 4096
+
+
+def _record_chunk_health(chunk_idx: int, n_chunks: int, qs, stats, n: int) -> None:
+    """Interim statistical health of one completed chunk, onto the
+    shared metrics plane (`hhmm_tpu/obs/metrics.py`): worst split-R̂ /
+    ESS across (series × parameter) rows via the batched estimators
+    (`infer/diagnostics.py`), the chunk divergence rate (the NUTS
+    ΔH > 1000 counts — all-False for Gibbs), and quarantine counts.
+
+    This is what makes a *failing* sweep visible while it runs
+    (``HHMM_TPU_TRACE=1``) instead of after the final summary: each
+    per-chunk gauge is labeled ``chunk=<i>``, so the exported series is
+    the live convergence trajectory `scripts/obs_report.py` renders.
+    No-op (one attribute read + branch) while the plane is disabled;
+    everything here is host-side numpy on already-materialized chunk
+    results — never inside the jitted program."""
+    if not obs_metrics.registry.enabled():
+        return
+    label = str(chunk_idx + 1)
+    arr = np.asarray(qs)[:n]  # [n, C, S, dim] — padding dropped
+    C, S = arr.shape[1], arr.shape[2]
+    rhat_max = ess_min = float("nan")
+    if S >= 4:  # the split-chain estimators need >= 2 draws per half
+        rows = np.moveaxis(arr, -1, 1).reshape(-1, C, S)  # [n*dim, C, S]
+        if rows.shape[0] > _INTERIM_MAX_ROWS:
+            step = -(-rows.shape[0] // _INTERIM_MAX_ROWS)
+            rows = rows[::step]
+        rhat_max = float(np.max(split_rhat_many(rows)))
+        ess_min = float(np.min(ess_many(rows)))
+        obs_metrics.gauge("fit.interim.rhat_max", chunk=label).set(rhat_max)
+        obs_metrics.gauge("fit.interim.ess_min", chunk=label).set(ess_min)
+    div = stats.get("diverging")
+    n_div = 0
+    div_rate = 0.0
+    if div is not None:
+        div = np.asarray(div)[:n]
+        n_div = int(div.sum())
+        div_rate = float(div.mean()) if div.size else 0.0
+        obs_metrics.gauge("fit.interim.divergence_rate", chunk=label).set(div_rate)
+        obs_metrics.counter("fit.divergences").inc(n_div)
+    n_quar = 0
+    ch = stats.get("chain_healthy")
+    if ch is not None:
+        ch = np.asarray(ch)[:n]
+        n_quar = int((~ch.reshape(ch.shape[0], -1).all(axis=1)).sum())
+        obs_metrics.gauge("fit.interim.quarantined_series", chunk=label).set(n_quar)
+        obs_metrics.counter("fit.quarantined_series").inc(n_quar)
+    obs_metrics.counter("fit.chunks").inc()
+    print(
+        f"# fit_batched chunk {label}/{n_chunks} health: "
+        f"rhat_max={rhat_max:.3f} ess_min={ess_min:.1f} "
+        f"divergences={n_div} ({div_rate:.4f}) quarantined={n_quar}",
+        file=sys.stderr,
+        flush=True,
+    )
 
 
 def _model_fingerprint(model) -> Dict[str, Any]:
@@ -381,7 +445,9 @@ def fit_batched(
                 qs2, stats2 = faults.corrupt_chunk_result(
                     qs2, stats2, s, n, attempt=heal_attempt
                 )
+                obs_metrics.counter("fit.heal_attempts").inc()
                 healed = sick & ~sick_series(stats2)
+                obs_metrics.counter("fit.healed_series").inc(int(healed.sum()))
                 if healed.any():
                     hm = jnp.asarray(healed)
 
@@ -397,6 +463,7 @@ def fit_batched(
             if sick.any():
                 # graceful degradation: the quarantine mask stays down
                 # in the returned stats instead of the sweep dying
+                obs_metrics.counter("fit.unhealed_series").inc(int(sick.sum()))
                 print(
                     f"# fit_batched {chunk_label}: {int(sick.sum())} series "
                     f"still quarantined after {policy.max_heal_attempts} "
@@ -409,6 +476,10 @@ def fit_batched(
             # fault-injection hook: simulated process death between
             # chunks (the cached chunks above make the rerun resume)
             faults.note_chunk_complete()
+        # interim convergence trajectory (metrics plane): cache hits
+        # included — a resumed sweep's dashboard must still cover every
+        # chunk of the run, not only the freshly computed ones
+        _record_chunk_health(s // chunk, -(-B // chunk), qs, stats, n)
         qs_parts.append(qs[:n])
         stats_parts.append({k: v[:n] for k, v in stats.items()})
 

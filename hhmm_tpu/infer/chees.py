@@ -56,6 +56,7 @@ import numpy as np
 from jax import lax
 
 from hhmm_tpu.infer.nuts import find_reasonable_step_size
+from hhmm_tpu.obs.metrics import record_sampler_health
 from hhmm_tpu.obs.trace import span
 from hhmm_tpu.infer.run import (
     _da_init,
@@ -487,8 +488,13 @@ def sample_chees_batched(
     with span("infer.chees.sample") as sp:
         sp.annotate(warmup=config.num_warmup, samples=config.num_samples)
         if fault is None:
-            return sp.sync(fn(key, init_q))
-        return sp.sync(fn(key, init_q, *fault))
+            qs_out, stats_out = sp.sync(fn(key, init_q))
+        else:
+            qs_out, stats_out = sp.sync(fn(key, init_q, *fault))
+    # metrics plane (obs/metrics.py): divergence + quarantine counters;
+    # no-op while disabled, tracer-tolerant under batched jit callers
+    record_sampler_health("chees", stats_out)
+    return qs_out, stats_out
 
 
 def sample_chees(

@@ -183,6 +183,7 @@ def summary(
     samples: Dict[str, np.ndarray],
     probs=(0.025, 0.25, 0.5, 0.75, 0.975),
     health: Optional[np.ndarray] = None,
+    diverging: Optional[np.ndarray] = None,
 ) -> Dict[str, Dict[str, np.ndarray]]:
     """Per-parameter posterior summary table.
 
@@ -196,6 +197,16 @@ def summary(
     reports ``chains_used`` / ``chains_quarantined``. If *every* chain
     is quarantined nothing is dropped (``chains_used = 0`` flags that the
     numbers are computed from quarantined chains and are not trustworthy).
+
+    ``diverging``: optional [chains, draws] bool — the samplers'
+    ``stats["diverging"]`` (Stan's ΔH > 1000 rule, computed at
+    `infer/nuts.py`; ChEES's analog; all-False for Gibbs). Every entry
+    then reports ``divergences`` / ``divergence_rate`` alongside R̂/ESS
+    — Stan's own summary pairs them for the same reason: a clean R̂
+    over divergent transitions is not convergence, it is the sampler
+    failing to explore the region that would have broken R̂. Counted
+    over the same chains as the statistics (quarantined chains' draws
+    are excluded from both).
     """
     keep = None
     n_bad = 0
@@ -204,6 +215,20 @@ def summary(
         n_bad = int((~health).sum())
         if health.any() and n_bad:
             keep = health
+    n_div = div_rate = None
+    if diverging is not None:
+        div = np.asarray(diverging).astype(bool)
+        if div.ndim != 2:
+            raise ValueError(f"diverging must be [chains, draws], got {div.shape}")
+        if health is not None and div.shape[0] != health.shape[0]:
+            raise ValueError(
+                f"health mask has {health.shape[0]} chains, "
+                f"diverging has {div.shape[0]}"
+            )
+        if keep is not None:
+            div = div[keep]
+        n_div = int(div.sum())
+        div_rate = float(div.mean()) if div.size else 0.0
     out = {}
     for name, arr in samples.items():
         arr = np.asarray(arr)
@@ -231,5 +256,8 @@ def summary(
         if health is not None:
             stats["chains_used"] = c if keep is not None or n_bad == 0 else 0
             stats["chains_quarantined"] = n_bad
+        if n_div is not None:
+            stats["divergences"] = n_div
+            stats["divergence_rate"] = div_rate
         out[name] = stats
     return out

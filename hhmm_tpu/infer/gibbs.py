@@ -51,6 +51,7 @@ from jax import lax
 from hhmm_tpu.kernels.dispatch import ffbs_dispatch
 from hhmm_tpu.kernels.ffbs import backward_sample
 from hhmm_tpu.kernels.filtering import forward_filter
+from hhmm_tpu.obs.metrics import record_sampler_health
 from hhmm_tpu.obs.trace import span
 from hhmm_tpu.robust import faults
 from hhmm_tpu.robust.guards import all_finite, guard_where
@@ -249,4 +250,8 @@ def sample_gibbs(
         "chain_healthy": healthy,
         "quarantine_step": q_step,
     }
+    # metrics plane (obs/metrics.py): quarantine counters (Gibbs never
+    # diverges — its all-False parity array keeps the rate honest);
+    # no-op while disabled, tracer-tolerant under batched jit callers
+    record_sampler_health("gibbs", stats)
     return qs, stats

@@ -29,6 +29,7 @@ import numpy as np
 from jax import lax
 
 from hhmm_tpu.infer.nuts import nuts_step, find_reasonable_step_size, NUTSInfo
+from hhmm_tpu.obs.metrics import record_sampler_health
 from hhmm_tpu.obs.trace import span
 from hhmm_tpu.robust import faults
 from hhmm_tpu.robust.guards import finite_mask, guard_update, guard_where
@@ -306,4 +307,8 @@ def sample_nuts(
     # preserving async dispatch for callers that pipeline
     with span("infer.nuts.sample") as sp:
         sp.annotate(chains=C, warmup=config.num_warmup, samples=config.num_samples)
-        return sp.sync(fn(*args))
+        qs, stats = sp.sync(fn(*args))
+    # metrics plane (obs/metrics.py): divergence + quarantine counters;
+    # no-op while disabled, tracer-tolerant when vmapped by batch/fit.py
+    record_sampler_health("nuts", stats)
+    return qs, stats

@@ -11,15 +11,22 @@ The cross-cutting layer that answers, for any run of the engine,
   ``jax.monitoring`` listener + a registry of named jitted entry
   points) and device-memory watermarks where the backend exposes
   ``memory_stats()``.
+- `obs/metrics.py` — process-wide structured metrics registry
+  (labeled counters/gauges/fixed-bucket histograms): the statistical
+  health plane every producer emits into — interim fit convergence,
+  divergence/quarantine counters, serving staleness/drift, SLO
+  inputs. Deterministic snapshots, atomic JSONL export, Prometheus
+  text exposition. Rendered by `scripts/obs_report.py`.
 - `obs/manifest.py` — run manifests (git rev, jax/jaxlib versions,
   backend + device kind, config/model digests, seed, span table,
-  compile counts, peak memory) written atomically next to results;
-  the provenance record `scripts/bench_diff.py` gates regressions on.
+  compile counts, peak memory, metrics snapshot) written atomically
+  next to results; the provenance record `scripts/bench_diff.py`
+  gates regressions on.
 
 See `docs/observability.md`.
 """
 
-from hhmm_tpu.obs import manifest, telemetry, trace
+from hhmm_tpu.obs import manifest, metrics, telemetry, trace
 from hhmm_tpu.obs.manifest import (
     MANIFEST_VERSION,
     collect_manifest,
@@ -33,12 +40,29 @@ from hhmm_tpu.obs.telemetry import (
     register_jit,
     telemetry_snapshot,
 )
+from hhmm_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    counter,
+    gauge,
+    histogram,
+)
 from hhmm_tpu.obs.trace import Tracer, event, perf_counter, span, traced, tracer
 
 __all__ = [
     "manifest",
+    "metrics",
     "telemetry",
     "trace",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "counter",
+    "gauge",
+    "histogram",
     "MANIFEST_VERSION",
     "collect_manifest",
     "load_manifest",

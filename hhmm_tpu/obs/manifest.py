@@ -39,6 +39,7 @@ import subprocess
 import sys
 from typing import Any, Dict, Optional
 
+from hhmm_tpu.obs import metrics as obs_metrics
 from hhmm_tpu.obs import telemetry, trace
 
 __all__ = [
@@ -235,6 +236,10 @@ def collect_manifest(
         ),
         "spans": trace.aggregate(),
         "trace_enabled": trace.enabled(),
+        # the statistical-health plane (obs/metrics.py): interim fit
+        # convergence gauges, divergence/quarantine counters, serving
+        # staleness — whatever the run's producers emitted
+        "metrics": obs_metrics.snapshot(),
         **telemetry.telemetry_snapshot(),
     }
     if extra:
@@ -266,6 +271,10 @@ def manifest_stanza(
     compile_st = man.pop("compile")
     man.pop("argv", None)
     man.pop("config", None)  # the records already carry their config
+    # compact: the full metrics table lives in the file manifest; the
+    # embedded stanza keeps only its size (callers wanting a metric in
+    # the record — e.g. the bench's SLO attainment — add it explicitly)
+    man["metrics_keys"] = len(man.pop("metrics", {}) or {})
     hottest = next(iter(spans), None)
     man["span_count"] = sum(t["count"] for t in spans.values())
     man["span_names"] = len(spans)

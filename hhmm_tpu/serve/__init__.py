@@ -11,8 +11,9 @@ filter in O(K²) with a compile-stable bucketed dispatch. See
 `docs/serving.md`.
 """
 
-from hhmm_tpu.serve.metrics import ServeMetrics
+from hhmm_tpu.serve.metrics import ServeMetrics, SLOSpec, evaluate_slo
 from hhmm_tpu.serve.online import (
+    LoglikCUSUM,
     RegimeDetector,
     StreamState,
     filter_scan,
@@ -33,6 +34,9 @@ from hhmm_tpu.serve.scheduler import MicroBatchScheduler, TickResponse
 
 __all__ = [
     "ServeMetrics",
+    "SLOSpec",
+    "evaluate_slo",
+    "LoglikCUSUM",
     "RegimeDetector",
     "StreamState",
     "filter_scan",

@@ -1,5 +1,6 @@
-"""Serving metrics: per-request latency histogram, throughput, and the
-XLA compile counter whose flatness is the no-recompile guarantee.
+"""Serving metrics: per-request latency histogram, throughput, staleness,
+the XLA compile counter whose flatness is the no-recompile guarantee —
+and the serve SLO spec those numbers are gated against.
 
 The scheduler's contract (`serve/scheduler.py`) is that after warmup the
 vmapped tick kernel never recompiles — every flush lands in one of a
@@ -9,29 +10,45 @@ traced signatures across the scheduler's jitted entry points (read from
 jit's own specialization cache), and ``tests/test_serve.py`` plus
 ``bench.py --serve`` assert it stays flat over a sustained tick replay.
 
-The counter itself lives in a named :class:`~hhmm_tpu.obs.telemetry.
-CompileScope` of the process-wide compile registry
-(`hhmm_tpu/obs/telemetry.py`) rather than a private attribute, so run
-manifests (`obs/manifest.py`) see the serving compile count alongside
-the global ``jax.monitoring`` compile events without knowing about this
-class. The ``summary()`` schema is unchanged — consumers
-(``tests/test_serve.py``, ``bench.py --serve``) read the same keys.
+Instrument substrate (`hhmm_tpu/obs/metrics.py` — the statistical
+health plane): the latency histogram, counters, and staleness gauge are
+the registry's own instrument classes, **attached** to the process-wide
+registry under ``serve.*`` names so exports (`MetricsRegistry.
+export_jsonl` / Prometheus exposition) and `scripts/obs_report.py` see
+live serving health without knowing this class. Serving metrics are
+product metrics: they record regardless of the ``HHMM_TPU_TRACE`` flag
+(`bench.py --serve` reads them untraced); the registry's disabled fast
+path gates only the debug-telemetry accessor route. The compile counter
+itself stays in a named :class:`~hhmm_tpu.obs.telemetry.CompileScope`
+of the compile registry, exactly as before. The ``summary()`` schema is
+frozen — consumers (``tests/test_serve.py``, ``bench.py --serve``) read
+the same keys.
 
 The latency histogram uses fixed log-spaced bucket edges (constant
 memory, mergeable across processes); quantiles are read from the
 cumulative counts at the conservative upper edge of the containing
-bucket.
+bucket (`obs/metrics.Histogram.quantile` — one implementation, defined
+there).
+
+:class:`SLOSpec` makes the serving objectives explicit — p99 tick
+latency, snapshot staleness bound, post-warmup recompile budget
+(ROADMAP item 4) — and :func:`evaluate_slo` turns one measurement
+window into an attainment verdict that ``bench.py --serve`` embeds in
+its record's manifest stanza, where `scripts/bench_diff.py` gates SLO
+regressions the same way it gates throughput.
 """
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence
+from dataclasses import asdict, dataclass
+from typing import Any, Dict, Optional, Sequence
 
 import numpy as np
 
+from hhmm_tpu.obs import metrics as obs_metrics
 from hhmm_tpu.obs import telemetry
 
-__all__ = ["ServeMetrics"]
+__all__ = ["ServeMetrics", "SLOSpec", "evaluate_slo"]
 
 
 class ServeMetrics:
@@ -40,57 +57,126 @@ class ServeMetrics:
     def __init__(self, edges: Optional[Sequence[float]] = None):
         # 1 µs .. 60 s: log-spaced, generous at both ends (CPU smoke
         # tests sit in the ms range, TPU serving in the µs range)
-        self.edges = np.asarray(
-            edges if edges is not None else np.geomspace(1e-6, 60.0, 48)
+        self.latency = obs_metrics.Histogram(
+            edges if edges is not None else obs_metrics.default_latency_edges()
         )
-        self.counts = np.zeros(len(self.edges) + 1, dtype=np.int64)
-        self.requests = 0
-        self.ticks = 0
-        self.degraded_responses = 0
-        self.degraded_attaches = 0
-        self.superseded_responses = 0
-        self.flushes = 0
-        self.busy_seconds = 0.0
+        self._requests = obs_metrics.Counter()
+        self._ticks = obs_metrics.Counter()
+        self._flushes = obs_metrics.Counter()
+        self._busy = obs_metrics.Counter()
+        self._degraded_responses = obs_metrics.Counter()
+        self._degraded_attaches = obs_metrics.Counter()
+        self._superseded_responses = obs_metrics.Counter()
+        # snapshot staleness (ROADMAP item 3): seconds since the oldest
+        # serving snapshot was attached, written by the scheduler per
+        # flush; the peak is the SLO-facing watermark for the window
+        self._staleness = obs_metrics.Gauge()
+        self._staleness_peak = float("nan")
         # the compile counter is a registered telemetry scope (one per
         # metrics instance; the registry sums same-label scopes)
         self._compile_scope = telemetry.new_scope("serve.compile_count")
+        # attach every instrument to the shared metrics plane: weakrefs
+        # only, merged per name across instances (counters sum, gauges
+        # max, histograms add) — obs_report and the exports read them
+        for name, inst in (
+            ("serve.tick_latency_seconds", self.latency),
+            ("serve.requests", self._requests),
+            ("serve.ticks", self._ticks),
+            ("serve.flushes", self._flushes),
+            ("serve.busy_seconds", self._busy),
+            ("serve.degraded_responses", self._degraded_responses),
+            ("serve.degraded_attaches", self._degraded_attaches),
+            ("serve.superseded_responses", self._superseded_responses),
+            ("serve.snapshot_staleness_seconds", self._staleness),
+        ):
+            obs_metrics.attach(name, inst)
+
+    # ---- frozen read API (pre-registry attribute names) ----
+
+    @property
+    def edges(self) -> np.ndarray:
+        return self.latency.edges
+
+    @property
+    def counts(self) -> np.ndarray:
+        return self.latency.counts
+
+    @property
+    def requests(self) -> int:
+        return int(self._requests.get())
+
+    @property
+    def ticks(self) -> int:
+        return int(self._ticks.get())
+
+    @property
+    def flushes(self) -> int:
+        return int(self._flushes.get())
+
+    @property
+    def busy_seconds(self) -> float:
+        return float(self._busy.get())
+
+    @property
+    def degraded_responses(self) -> int:
+        return int(self._degraded_responses.get())
+
+    @property
+    def degraded_attaches(self) -> int:
+        return int(self._degraded_attaches.get())
+
+    @property
+    def superseded_responses(self) -> int:
+        return int(self._superseded_responses.get())
 
     # ---- recording ----
 
     def reset_throughput_window(self) -> None:
         """Zero the latency histogram and throughput counters — 'start
         measuring now'. Benches call this after warmup so the reported
-        percentiles and ticks/sec describe the steady state, not the
-        compile flushes; the compile counter and degradation counters
-        (cumulative health facts) are deliberately kept."""
-        self.counts[:] = 0
-        self.requests = 0
-        self.ticks = 0
-        self.flushes = 0
-        self.busy_seconds = 0.0
+        percentiles, ticks/sec, and staleness peak describe the steady
+        state, not the compile flushes; the compile counter and
+        degradation counters (cumulative health facts) are deliberately
+        kept."""
+        self.latency.reset()
+        self._requests.reset()
+        self._ticks.reset()
+        self._flushes.reset()
+        self._busy.reset()
+        self._staleness_peak = float("nan")
 
     def observe_latency(self, latency_s: float, n: int = 1) -> None:
         """Record ``n`` requests that completed with ``latency_s``."""
-        self.counts[int(np.searchsorted(self.edges, latency_s))] += n
-        self.requests += n
+        self.latency.observe(latency_s, n)
+        self._requests.inc(n)
 
     def observe_flush(self, n_ticks: int, seconds: float) -> None:
         """Record one micro-batch flush: ``n_ticks`` state updates in
         ``seconds`` of wall-clock."""
-        self.flushes += 1
-        self.ticks += n_ticks
-        self.busy_seconds += seconds
+        self._flushes.inc()
+        self._ticks.inc(n_ticks)
+        self._busy.inc(seconds)
+
+    def observe_staleness(self, seconds: float) -> None:
+        """Record the current serving-snapshot staleness (seconds since
+        the oldest attached posterior was banked/attached). The gauge
+        holds the latest read; the peak is the window watermark the SLO
+        evaluation consumes."""
+        s = float(seconds)
+        self._staleness.set(s)
+        if not (self._staleness_peak >= s):  # NaN-safe max
+            self._staleness_peak = s
 
     def note_degraded_response(self, n: int = 1) -> None:
-        self.degraded_responses += n
+        self._degraded_responses.inc(n)
 
     def note_degraded_attach(self) -> None:
-        self.degraded_attaches += 1
+        self._degraded_attaches.inc()
 
     def note_superseded_response(self) -> None:
         """A tick() dict collapse dropped an older same-series response
         (latest-wins); the filter state still folded that tick."""
-        self.superseded_responses += 1
+        self._superseded_responses.inc()
 
     @property
     def compile_count(self) -> int:
@@ -105,24 +191,30 @@ class ServeMetrics:
         """Latency quantile (seconds), conservative (upper bucket edge).
         A quantile landing in the unbounded overflow bucket (beyond the
         last edge) returns ``inf`` — a pathological tail must read as
-        pathological, not as the largest edge."""
-        if self.requests == 0:
-            return float("nan")
-        cum = np.cumsum(self.counts)
-        idx = int(np.searchsorted(cum, q * self.requests, side="left"))
-        if idx >= len(self.edges):
-            return float("inf")
-        return float(self.edges[idx])
+        pathological, not as the largest edge; an empty histogram
+        returns ``nan``. Semantics pinned by
+        `hhmm_tpu/obs/metrics.Histogram.quantile`."""
+        return self.latency.quantile(q)
+
+    def staleness_seconds(self) -> float:
+        """Latest staleness read (NaN before the first flush)."""
+        return self._staleness.get()
+
+    def peak_staleness_seconds(self) -> float:
+        """Worst staleness observed in the current measurement window
+        (NaN if never observed) — the SLO-facing watermark."""
+        return self._staleness_peak
 
     def ticks_per_sec(self) -> float:
-        return self.ticks / self.busy_seconds if self.busy_seconds > 0 else float("nan")
+        busy = self.busy_seconds
+        return self.ticks / busy if busy > 0 else float("nan")
 
     def summary(self) -> Dict[str, float]:
         """JSON-ready metrics record (the `bench.py --serve` payload).
         An empty measurement window reports ``None`` (JSON null) and an
         overflow-bucket quantile the string ``"inf"`` — never a bare
         NaN/Infinity token that breaks strict JSON consumers of the
-        bench records."""
+        bench records. Schema frozen (``tests/test_obs.py``)."""
 
         def _q_ms(q: float):
             v = self.quantile(q)
@@ -132,15 +224,83 @@ class ServeMetrics:
 
         tps = self.ticks_per_sec()
         return {
-            "requests": int(self.requests),
-            "ticks": int(self.ticks),
-            "flushes": int(self.flushes),
+            "requests": self.requests,
+            "ticks": self.ticks,
+            "flushes": self.flushes,
             "ticks_per_sec": None if np.isnan(tps) else round(tps, 1),
             "latency_p50_ms": _q_ms(0.50),
             "latency_p90_ms": _q_ms(0.90),
             "latency_p99_ms": _q_ms(0.99),
-            "degraded_responses": int(self.degraded_responses),
-            "degraded_attaches": int(self.degraded_attaches),
-            "superseded_responses": int(self.superseded_responses),
+            "degraded_responses": self.degraded_responses,
+            "degraded_attaches": self.degraded_attaches,
+            "superseded_responses": self.superseded_responses,
             "compile_count": int(self.compile_count),
         }
+
+
+# ---- serve SLOs ----
+
+
+@dataclass(frozen=True)
+class SLOSpec:
+    """Explicit serving objectives (ROADMAP item 4). Defaults are the
+    bench's CPU-smoke-passable bar; production deployments pass their
+    own. A spec is a *gate definition*, not workload — `bench.py`
+    excludes these knobs from the workload digest, so tightening an SLO
+    never forks the `scripts/bench_diff.py` comparability key."""
+
+    p99_latency_ms: float = 250.0
+    max_staleness_s: float = 900.0
+    max_post_warmup_recompiles: int = 0
+
+
+def evaluate_slo(
+    spec: SLOSpec,
+    *,
+    p99_latency_ms: Any,
+    staleness_s: Any,
+    post_warmup_recompiles: Any,
+) -> Dict[str, Any]:
+    """One measurement window → SLO attainment verdict.
+
+    ``p99_latency_ms`` accepts the ``summary()`` encoding directly
+    (``None`` = empty window, ``"inf"`` = overflow tail) — both FAIL
+    their check: attainment must be *demonstrated*, an unmeasured or
+    pathological window cannot claim it. Returns a JSON-ready dict
+    (``{"attained": bool, "spec": ..., "checks": {...}}``) that
+    ``bench.py --serve`` embeds in its record's manifest stanza for
+    `scripts/bench_diff.py` to gate on."""
+
+    def check(observed, limit) -> Dict[str, Any]:
+        if observed is None:
+            return {"observed": None, "limit": limit, "ok": False,
+                    "reason": "unmeasured"}
+        if isinstance(observed, str):  # the summary() "inf" encoding
+            obs_v = float("inf") if observed == "inf" else float("nan")
+        else:
+            obs_v = float(observed)
+        ok = bool(np.isfinite(obs_v) and obs_v <= limit)
+        rec: Dict[str, Any] = {
+            "observed": observed if isinstance(observed, str) else round(obs_v, 4),
+            "limit": limit,
+            "ok": ok,
+        }
+        if not np.isfinite(obs_v):
+            rec["reason"] = "non-finite observation"
+        return rec
+
+    # NaN staleness (never observed) must fail, not pass vacuously
+    if isinstance(staleness_s, float) and np.isnan(staleness_s):
+        staleness_s = None
+    checks = {
+        "p99_latency_ms": check(p99_latency_ms, spec.p99_latency_ms),
+        "staleness_s": check(staleness_s, spec.max_staleness_s),
+        "post_warmup_recompiles": check(
+            post_warmup_recompiles, spec.max_post_warmup_recompiles
+        ),
+    }
+    return {
+        "attained": all(c["ok"] for c in checks.values()),
+        "spec": asdict(spec),
+        "checks": checks,
+    }

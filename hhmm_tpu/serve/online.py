@@ -62,6 +62,7 @@ __all__ = [
     "predictive_state_logprobs",
     "posterior_predictive_mean",
     "RegimeDetector",
+    "LoglikCUSUM",
 ]
 
 
@@ -264,3 +265,80 @@ class RegimeDetector:
             self.regime, self._cand, self._streak = top, -1, 0
             return self.regime, True
         return self.regime, False
+
+
+@dataclass
+class LoglikCUSUM:
+    """One-sided CUSUM drift detector on the per-tick predictive
+    log-likelihood — the cheap O(1) staleness signal serving needs
+    (ROADMAP item 3): a posterior going stale shows up as a sustained
+    *downward* shift in ``log p(x_t | x_{1:t-1})`` long before any
+    refit diagnostic can see it.
+
+    Feed it per-tick predictive loglik **increments** — consecutive
+    differences of :class:`StreamState`'s running ``loglik`` (the
+    ``TickResponse.loglik`` stream the scheduler already emits; the
+    caller differences adjacent ticks, or passes the increment
+    directly when it has one).
+
+    Page's test, standardized online: the first ``calibrate`` ticks
+    estimate the in-distribution mean/variance of the increment
+    (Welford); thereafter each tick folds the standardized *drop*
+    ``z_t = (μ̂ − x_t)/σ̂`` into ``S_t = max(0, S_{t−1} + z_t − k)``
+    and alarms when ``S_t > h``. ``k`` (drift allowance, in σ units)
+    absorbs ordinary noise; ``h`` trades detection delay against false
+    alarms — the default (h=8, k=0.5) sits above the classic textbook
+    h=4 because a serving alarm triggers a refit: at k=0.5 the
+    in-control ARL is ~340 ticks for h=4 (an alarm storm at tick rate)
+    vs ~70k for h=8, while a 2σ sustained drop is still caught in
+    ~h/1.5 ≈ 6 ticks. After an alarm the
+    statistic resets so repeated alarms mean *sustained* drift, not one
+    excursion replaying forever. Host-side, O(1) per tick — lives next
+    to :class:`RegimeDetector` by design; each alarm also increments
+    the ``serve.drift_alarms`` counter on the shared metrics plane
+    (`hhmm_tpu/obs/metrics.py` — a no-op while the plane is disabled).
+    """
+
+    threshold: float = 8.0  # h, in σ units of cumulated drop
+    drift: float = 0.5  # k, per-tick allowance in σ units
+    calibrate: int = 32  # ticks of baseline estimation before arming
+    min_sigma: float = 1e-6
+    stat: float = field(default=0.0, repr=False)  # S_t
+    alarms: int = field(default=0, repr=False)
+    _n: int = field(default=0, repr=False)
+    _finite: int = field(default=0, repr=False)
+    _mean: float = field(default=0.0, repr=False)
+    _m2: float = field(default=0.0, repr=False)
+
+    def update(self, loglik_increment: float) -> Tuple[float, bool]:
+        """Absorb one tick's predictive loglik increment; returns
+        ``(cusum_stat, drifted_this_tick)``. Non-finite increments (a
+        quarantined stream's −inf floor) count as a maximal drop — a
+        dead stream IS drifted — without poisoning the baseline."""
+        x = float(loglik_increment)
+        self._n += 1
+        if np.isfinite(x) and self._n <= self.calibrate:
+            # Welford baseline over the FINITE calibration samples only:
+            # both the mean divisor and the variance denominator must
+            # count what was folded, or skipped -inf ticks bias the
+            # baseline toward 0 and inflate sigma — persistent false
+            # alarms on a healthy stream
+            self._finite += 1
+            d = x - self._mean
+            self._mean += d / self._finite
+            self._m2 += d * (x - self._mean)
+        if self._n <= self.calibrate:
+            return self.stat, False
+        sigma = max(
+            np.sqrt(self._m2 / max(self._finite - 1, 1)), self.min_sigma
+        )
+        z = (self._mean - x) / sigma if np.isfinite(x) else self.threshold + 1.0
+        self.stat = max(0.0, self.stat + z - self.drift)
+        if self.stat > self.threshold:
+            self.stat = 0.0
+            self.alarms += 1
+            from hhmm_tpu.obs import metrics as _obs_metrics
+
+            _obs_metrics.counter("serve.drift_alarms").inc()
+            return 0.0, True
+        return self.stat, False

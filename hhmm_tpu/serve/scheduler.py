@@ -94,6 +94,12 @@ class MicroBatchScheduler:
         self.history_pad = int(history_pad)
         self.n_draws: Optional[int] = None
         self._series: Dict[str, Dict[str, Any]] = {}
+        # snapshot-staleness accounting (obs metrics plane): perf_counter
+        # at each series' last committed attach; the min is the oldest
+        # serving posterior, whose age is the staleness gauge flush()
+        # publishes (ROADMAP item 3's cheap staleness signal)
+        self._attach_t: Dict[str, float] = {}
+        self._oldest_attach_t: Optional[float] = None
         self._pending: List[Tuple[str, Dict[str, Any], float]] = []
         self._undelivered: List[TickResponse] = []
         self._draws_cache: Dict[Tuple[str, ...], jnp.ndarray] = {}
@@ -315,6 +321,17 @@ class MicroBatchScheduler:
             rec = self._series[series_id]
             rec["rejected_fits"] = rec.get("rejected_fits", 0) + 1
         self._series.update(new_recs)
+        # staleness clock: a committed (re-)attach refreshes the series'
+        # posterior age; a kept (rejected-fit) series keeps aging on its
+        # previously attached snapshot — exactly the drift the gauge
+        # must surface
+        now = time.perf_counter()
+        for series_id in new_recs:
+            self._attach_t[series_id] = now
+        for series_id in keeps:
+            self._attach_t.setdefault(series_id, now)
+        if self._attach_t:
+            self._oldest_attach_t = min(self._attach_t.values())
         if resolved:
             self._refresh_compile_count()
 
@@ -486,6 +503,10 @@ class MicroBatchScheduler:
         for _, _, t_submit in pending:
             self.metrics.observe_latency(done - t_submit)
         self.metrics.observe_flush(len(pending), done - t0)
+        if self._oldest_attach_t is not None:
+            # age of the OLDEST serving posterior: the staleness gauge
+            # + SLO watermark (serve/metrics.py)
+            self.metrics.observe_staleness(done - self._oldest_attach_t)
         self._refresh_compile_count()
         carried, self._undelivered = self._undelivered, []
         return carried + responses

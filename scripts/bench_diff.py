@@ -35,7 +35,14 @@ Further gate rules:
   gate's;
 - a degraded record (``degraded_cpu_smoke`` / ``backend_fallback``)
   never gates in either direction — a CPU fallback run regressing
-  against a TPU run is a backend change, not a perf change.
+  against a TPU run is a backend change, not a perf change;
+- **SLO attainment gates like throughput**: a record whose manifest
+  stanza carries an ``slo`` verdict (`bench.py --serve` embeds the
+  `serve/metrics.py` ``evaluate_slo`` result) fails the gate when the
+  previous comparable record ATTAINED its SLOs and this one does not —
+  the serving-objective analog of a throughput regression. A first
+  record that is already unmet is reported (never silently green) but
+  has no baseline to regress from, so it does not gate.
 
 Exit codes: 0 clean (or nothing comparable), 1 regression, 2 usage/IO
 error. No jax import — this runs in CI guards and pre-push hooks.
@@ -152,6 +159,7 @@ def diff(
     rows: List[Dict[str, Any]] = []
     last_by_metric: Dict[str, Dict[str, Any]] = {}
     last_by_key: Dict[Tuple, Dict[str, Any]] = {}
+    last_slo_by_key: Dict[Tuple, bool] = {}
     failures = 0
     for rnd in rounds:
         rec = rnd["record"]
@@ -218,6 +226,29 @@ def diff(
                     row["status"] = f"ok vs round {prev['n']}"
             if isinstance(value, (int, float)):
                 last_by_key[key] = {"n": rnd["n"], "value": value}
+            # SLO attainment rides the same comparability key: an
+            # attained -> unmet transition between comparable records
+            # is a serving regression, gated exactly like throughput
+            slo = (rec.get("manifest") or {}).get("slo")
+            if isinstance(slo, dict) and "attained" in slo:
+                attained = bool(slo.get("attained"))
+                prev_attained = last_slo_by_key.get(key)
+                if prev_attained is True and not attained:
+                    failures += 1
+                    row["gated"] = True
+                    unmet = sorted(
+                        k
+                        for k, c in (slo.get("checks") or {}).items()
+                        if isinstance(c, dict) and not c.get("ok")
+                    )
+                    row["status"] += (
+                        f"; SLO REGRESSION: attained -> unmet ({', '.join(unmet)})"
+                    )
+                elif not attained:
+                    row["status"] += "; SLO unmet (no attained baseline)"
+                else:
+                    row["status"] += "; SLO attained"
+                last_slo_by_key[key] = attained
         if isinstance(value, (int, float)):
             last_by_metric[metric] = {"n": rnd["n"], "value": value}
         rows.append(row)

@@ -33,16 +33,28 @@ depend on:
    there has NaN cotangents and, in naive forms, NaN values
    (docs/parallel_scan.md).
 5. **Observability invariants** (`docs/observability.md`): (a) no raw
-   ``time.time()`` call anywhere under ``hhmm_tpu/`` or in
-   ``bench.py`` — durations must come from the monotonic
-   ``time.perf_counter()`` (directly or via the `hhmm_tpu/obs/trace.py`
-   helpers); a wall-clock step (NTP slew, suspend/resume) under
-   ``time.time()`` silently corrupts every throughput record built on
-   it. (b) Every serve/bench module that creates a ``jax.jit`` entry
-   point (``hhmm_tpu/serve/*.py``, ``bench.py``) must import a
-   registration hook from ``hhmm_tpu.obs.telemetry`` and call it —
-   otherwise run manifests lose per-entry-point compile attribution
-   and the no-recompile audits go dark for that module.
+   ``time.time()`` call anywhere under ``hhmm_tpu/``, in ``bench.py``
+   / ``bench_zoo.py``, or under ``scripts/`` — durations must come
+   from the monotonic ``time.perf_counter()`` (directly or via the
+   `hhmm_tpu/obs/trace.py` helpers); a wall-clock step (NTP slew,
+   suspend/resume) under ``time.time()`` silently corrupts every
+   throughput record built on it — and the ``scripts/tpu_*_probe.py``
+   timings feed the measured crossover table `kernels/dispatch.py`
+   bets real decode throughput on, so skew there corrupts dispatch
+   decisions, not just records. (b) Every serve/bench module that
+   creates a ``jax.jit`` entry point (``hhmm_tpu/serve/*.py``,
+   ``bench.py``, ``bench_zoo.py``) must import a registration hook
+   from ``hhmm_tpu.obs.telemetry`` and call it — otherwise run
+   manifests lose per-entry-point compile attribution and the
+   no-recompile audits go dark for that module.
+6. **One metrics plane** (`hhmm_tpu/obs/metrics.py`): every module
+   emitting health metrics goes through the shared registry — no
+   private ``MetricsRegistry()`` instances outside ``obs/metrics.py``
+   (a second registry forks the sink: its counters never reach the
+   exports, manifests, or `scripts/obs_report.py`), no ad-hoc
+   module-level count dicts, and any call to a bare
+   ``counter``/``gauge``/``histogram`` name must be bound from the
+   metrics module, not a local shadow.
 
 Exit 0 when clean, 1 with one line per violation. Run by
 ``tests/test_robust.py`` (and re-asserted by ``tests/test_serve.py``,
@@ -54,6 +66,7 @@ from __future__ import annotations
 
 import ast
 import pathlib
+import re
 import sys
 from typing import List
 
@@ -96,6 +109,13 @@ RAW_LSE_WRAPPERS = ("logsumexp", "log_vecmat", "log_matvec", "log_normalize")
 # exactly the condition the invariant exists to prevent.
 TELEMETRY_MODULES = ("hhmm_tpu.obs.telemetry", "hhmm_tpu.obs")
 TELEMETRY_HOOKS = ("register_jit",)
+
+# invariant 6: the shared statistical-health plane. Bare-name calls to
+# these must be bound from the metrics module; a private registry or a
+# module-level count dict forks the sink.
+METRICS_MODULES = ("hhmm_tpu.obs.metrics", "hhmm_tpu.obs")
+METRIC_FNS = ("counter", "gauge", "histogram")
+AD_HOC_COUNT_RE = re.compile(r"(^|_)(counts?|counters?)$")
 
 
 def _bare_excepts(tree: ast.Module, rel: str, problems: List[str]) -> None:
@@ -240,6 +260,67 @@ def _check_telemetry_registration(
         )
 
 
+def _check_metrics_discipline(
+    tree: ast.Module, rel: str, problems: List[str]
+) -> None:
+    """Invariant 6: one shared metrics plane. (a) no private
+    ``MetricsRegistry()`` outside ``obs/metrics.py``; (b) bare-name
+    ``counter``/``gauge``/``histogram`` calls must be bound from the
+    metrics module (a local shadow is an ad-hoc sink); (c) no
+    module-level count-dict stores (``foo_counts = {}``) — counts that
+    bypass the registry never reach the exports or obs_report."""
+    if rel.replace("\\", "/") == "hhmm_tpu/obs/metrics.py":
+        return
+    imported = _imported_symbols(tree, METRICS_MODULES)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if (isinstance(fn, ast.Name) and fn.id == "MetricsRegistry") or (
+                isinstance(fn, ast.Attribute) and fn.attr == "MetricsRegistry"
+            ):
+                problems.append(
+                    f"{rel}:{node.lineno}: instantiates a private "
+                    "MetricsRegistry — a second registry forks the metrics "
+                    "sink; use the shared hhmm_tpu.obs.metrics registry"
+                )
+            elif (
+                isinstance(fn, ast.Name)
+                and fn.id in METRIC_FNS
+                and fn.id not in imported
+            ):
+                problems.append(
+                    f"{rel}:{node.lineno}: calls bare `{fn.id}(...)` not "
+                    "imported from hhmm_tpu.obs.metrics — ad-hoc metric "
+                    "sinks never reach the exports/manifests/obs_report"
+                )
+    # (c) module-level count-dict assignments only (function-local
+    # working dicts are algorithm state, not a metrics sink)
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+            value = node.value
+        else:
+            continue
+        is_dictish = isinstance(value, (ast.Dict, ast.DictComp)) or (
+            isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Name)
+            and value.func.id in ("dict", "defaultdict")
+        )
+        if not is_dictish:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and AD_HOC_COUNT_RE.search(t.id):
+                problems.append(
+                    f"{rel}:{node.lineno}: module-level count store "
+                    f"`{t.id}` — route counts through the shared "
+                    "hhmm_tpu.obs.metrics registry"
+                )
+
+
 def check(root: pathlib.Path) -> List[str]:
     problems: List[str] = []
     pkg = root / "hhmm_tpu"
@@ -253,15 +334,27 @@ def check(root: pathlib.Path) -> List[str]:
         _bare_excepts(tree, rel, problems)
         # invariant 5a: monotonic clocks only, package-wide
         _check_raw_time(tree, rel, problems)
+        # invariant 6: one shared metrics plane, package-wide
+        _check_metrics_discipline(tree, rel, problems)
         # invariant 5b over the serving layer: every module with a
         # jax.jit entry point registers it with the telemetry registry
         if py.parent == serve_dir:
             _check_telemetry_registration(tree, rel, problems)
-    bench = root / "bench.py"
-    if bench.is_file():
-        btree = ast.parse(bench.read_text(), filename=str(bench))
-        _check_raw_time(btree, "bench.py", problems)
-        _check_telemetry_registration(btree, "bench.py", problems)
+    for bench_name in ("bench.py", "bench_zoo.py"):
+        bench = root / bench_name
+        if bench.is_file():
+            btree = ast.parse(bench.read_text(), filename=str(bench))
+            _check_raw_time(btree, bench_name, problems)
+            _check_telemetry_registration(btree, bench_name, problems)
+            _check_metrics_discipline(btree, bench_name, problems)
+    # invariant 5a over scripts/: the tpu_*_probe timings feed the
+    # measured crossover table kernels/dispatch.py dispatches on — a
+    # wall-clock step there corrupts dispatch decisions silently
+    scripts_dir = root / "scripts"
+    if scripts_dir.is_dir():
+        for py in sorted(scripts_dir.glob("*.py")):
+            stree = ast.parse(py.read_text(), filename=str(py))
+            _check_raw_time(stree, f"scripts/{py.name}", problems)
 
     def check_guarded(spec, source_modules, kind, noun, what):
         for rel, guard_fns in sorted(spec.items()):
@@ -358,7 +451,8 @@ def main(argv: List[str]) -> int:
     print(
         "check_guards: ok (no bare excepts; all samplers guarded; "
         "online serve step guarded; semiring combines guarded; "
-        "monotonic clocks only; serve/bench jits telemetry-registered)"
+        "monotonic clocks only; serve/bench jits telemetry-registered; "
+        "one shared metrics plane)"
     )
     return 0
 

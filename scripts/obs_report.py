@@ -1,0 +1,360 @@
+#!/usr/bin/env python
+"""Render one run's manifest + metrics export as a text dashboard.
+
+The consumer end of the observability stack: `obs/manifest.py` pins
+what ran, `obs/trace.py` where the time went, `obs/telemetry.py` what
+XLA compiled, and `obs/metrics.py` the statistical health (interim
+convergence, divergence/quarantine counters, serving staleness/drift,
+SLO attainment). This script folds all of it into one readable report:
+
+  == run ==          host, stack, hardware, git, workload digest
+  == spans ==        hottest-first span table (count/total/p50/p99)
+  == compile ==      backend compiles, per-phase seconds, per-entry-point
+                     jit cache sizes, component scopes
+  == memory ==       per-device peak watermarks (where exposed)
+  == convergence ==  the per-chunk interim R̂/ESS/divergence/quarantine
+                     trajectory a traced `batch/fit.py` run emits
+  == serving ==      tick latency, throughput, staleness, drift alarms
+  == slo ==          per-check PASS/FAIL + overall attainment
+
+Inputs: the full manifest JSON (``bench.py --manifest-out`` /
+``results/manifest_bench_<mode>.json`` under ``HHMM_TPU_TRACE=1``),
+which embeds the metrics snapshot; ``--metrics`` optionally points at a
+JSONL export (`MetricsRegistry.export_jsonl`) to use instead — e.g. a
+scrape taken mid-run.
+
+No jax import (asserted by ``tests/test_obs.py``) — this renders
+records on CI hosts and laptops that have neither an accelerator nor
+the pinned jax. Exit 0 on success, 2 on unreadable input.
+
+Usage::
+
+    python scripts/obs_report.py results/manifest_bench_serve.json
+    python scripts/obs_report.py MANIFEST --metrics run.metrics.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# ---- formatting helpers ----
+
+
+def _table(headers: Tuple[str, ...], rows: List[Tuple[str, ...]], out) -> None:
+    if not rows:
+        print("  (empty)", file=out)
+        return
+    widths = [
+        max(len(headers[i]), *(len(r[i]) for r in rows)) for i in range(len(headers))
+    ]
+    fmt = "  " + "  ".join(f"{{:<{w}}}" for w in widths)
+    print(fmt.format(*headers), file=out)
+    print(fmt.format(*("-" * w for w in widths)), file=out)
+    for r in rows:
+        print(fmt.format(*r), file=out)
+
+
+def _fmt(v: Any) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, bool):
+        return str(v)
+    if isinstance(v, float):
+        return f"{v:g}"
+    return str(v)
+
+
+def _section(title: str, out) -> None:
+    print(f"\n== {title} ==", file=out)
+
+
+# ---- metrics helpers ----
+
+
+def parse_metric_key(key: str) -> Tuple[str, Dict[str, str]]:
+    """``name{k=v,...}`` → (name, labels) — the snapshot key format."""
+    name, _, rest = key.partition("{")
+    labels: Dict[str, str] = {}
+    if rest:
+        for pair in rest.rstrip("}").split(","):
+            k, _, v = pair.partition("=")
+            labels[k] = v
+    return name, labels
+
+
+def load_metrics_jsonl(path: str) -> Dict[str, Dict[str, Any]]:
+    """JSONL export → the snapshot dict shape (keyed by rendered key)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            key = rec.pop("key", None) or rec.get("name", "?")
+            rec.pop("name", None)
+            rec.pop("labels", None)
+            out[key] = rec
+    return out
+
+
+def hist_quantile(state: Dict[str, Any], q: float) -> float:
+    """Conservative upper-edge quantile from an exported histogram
+    state — mirrors `obs/metrics.Histogram.quantile` without numpy."""
+    total = state.get("count", 0)
+    if not total:
+        return float("nan")
+    target = max(q * total, 1e-300)
+    cum = 0
+    for edge, c in zip(state["edges"], state["counts"]):
+        cum += c
+        if cum >= target:
+            return float(edge)
+    return float("inf")
+
+
+# ---- sections ----
+
+
+def render_run(man: Dict[str, Any], out) -> None:
+    _section("run", out)
+    versions = man.get("versions") or {}
+    git = man.get("git") or {}
+    rows = [
+        ("host", _fmt(man.get("hostname"))),
+        (
+            "hardware",
+            f"{_fmt(man.get('backend'))} / {_fmt(man.get('device_kind'))}"
+            f" x{_fmt(man.get('device_count'))}",
+        ),
+        (
+            "stack",
+            f"python {_fmt(versions.get('python'))}, "
+            f"jax {_fmt(versions.get('jax'))}, "
+            f"jaxlib {_fmt(versions.get('jaxlib'))}",
+        ),
+        (
+            "git",
+            f"{_fmt(git.get('rev'))[:12]}"
+            + (" (dirty)" if git.get("dirty") else ""),
+        ),
+        ("seed", _fmt(man.get("seed"))),
+        ("workload_digest", _fmt(man.get("workload_digest"))),
+        ("trace_enabled", _fmt(man.get("trace_enabled"))),
+        ("bench_mode", _fmt(man.get("bench_mode"))),
+    ]
+    _table(("field", "value"), rows, out)
+
+
+def render_spans(man: Dict[str, Any], out) -> None:
+    _section("spans (hottest first)", out)
+    spans = man.get("spans") or {}
+    rows = [
+        (
+            name,
+            _fmt(t.get("count")),
+            _fmt(t.get("total_s")),
+            _fmt(t.get("p50_ms")),
+            _fmt(t.get("p99_ms")),
+            _fmt(t.get("max_ms")),
+        )
+        for name, t in spans.items()
+    ]
+    _table(("span", "count", "total_s", "p50_ms", "p99_ms", "max_ms"), rows, out)
+
+
+def render_compile(man: Dict[str, Any], out) -> None:
+    _section("compile", out)
+    comp = man.get("compile") or {}
+    print(
+        f"  backend_compiles: {_fmt(comp.get('backend_compiles'))} "
+        f"(listener {'on' if comp.get('listening') else 'off'})",
+        file=out,
+    )
+    secs = comp.get("compile_seconds") or {}
+    for phase, s in sorted(secs.items()):
+        print(f"  {phase}: {_fmt(s)} s", file=out)
+    sizes = comp.get("jit_cache_sizes") or {}
+    if sizes:
+        _table(
+            ("jit entry point", "traced signatures"),
+            [(k, _fmt(v)) for k, v in sorted(sizes.items())],
+            out,
+        )
+    scopes = comp.get("scopes") or {}
+    for label, v in sorted(scopes.items()):
+        print(f"  scope {label}: {_fmt(v)}", file=out)
+
+
+def render_memory(man: Dict[str, Any], out) -> None:
+    peak = man.get("peak_memory") or {}
+    _section("memory", out)
+    if not peak:
+        print("  (backend exposes no memory_stats)", file=out)
+        return
+    rows = []
+    for dev, st in sorted(peak.items()):
+        rows.append(
+            (
+                f"device {dev}",
+                _fmt(st.get("bytes_in_use")),
+                _fmt(st.get("peak_bytes_in_use")),
+                _fmt(st.get("bytes_limit")),
+            )
+        )
+    _table(("device", "bytes_in_use", "peak_bytes", "limit"), rows, out)
+
+
+def render_convergence(metrics: Dict[str, Dict[str, Any]], out) -> None:
+    _section("convergence (interim, per fit chunk)", out)
+    by_chunk: Dict[str, Dict[str, Any]] = {}
+    for key, state in metrics.items():
+        name, labels = parse_metric_key(key)
+        if name.startswith("fit.interim.") and "chunk" in labels:
+            by_chunk.setdefault(labels["chunk"], {})[
+                name[len("fit.interim.") :]
+            ] = state.get("value")
+    rows = []
+    for chunk in sorted(by_chunk, key=lambda c: (len(c), c)):
+        vals = by_chunk[chunk]
+        rows.append(
+            (
+                chunk,
+                _fmt(vals.get("rhat_max")),
+                _fmt(vals.get("ess_min")),
+                _fmt(vals.get("divergence_rate")),
+                _fmt(vals.get("quarantined_series")),
+            )
+        )
+    _table(
+        ("chunk", "rhat_max", "ess_min", "div_rate", "quarantined"), rows, out
+    )
+    totals = [
+        ("fit.chunks", "chunks"),
+        ("fit.divergences", "divergences"),
+        ("fit.quarantined_series", "quarantined series"),
+        ("fit.heal_attempts", "heal attempts"),
+        ("fit.healed_series", "healed series"),
+        ("fit.unhealed_series", "unhealed series"),
+    ]
+    for key, label in totals:
+        if key in metrics:
+            print(f"  total {label}: {_fmt(metrics[key].get('value'))}", file=out)
+    for key, state in sorted(metrics.items()):
+        name, labels = parse_metric_key(key)
+        if name in ("infer.divergences", "infer.quarantined_chains"):
+            print(
+                f"  {name}[{labels.get('sampler', '?')}]: "
+                f"{_fmt(state.get('value'))}",
+                file=out,
+            )
+
+
+def render_serving(metrics: Dict[str, Dict[str, Any]], out) -> None:
+    _section("serving", out)
+    lat = metrics.get("serve.tick_latency_seconds")
+    if lat and lat.get("type") == "histogram":
+        p50, p99 = hist_quantile(lat, 0.5), hist_quantile(lat, 0.99)
+        print(
+            f"  tick latency: p50 {p50 * 1e3:g} ms, p99 {p99 * 1e3:g} ms "
+            f"({lat.get('count', 0)} requests)",
+            file=out,
+        )
+    simple = [
+        ("serve.ticks", "ticks"),
+        ("serve.flushes", "flushes"),
+        ("serve.busy_seconds", "busy seconds"),
+        ("serve.degraded_responses", "degraded responses"),
+        ("serve.degraded_attaches", "degraded attaches"),
+        ("serve.superseded_responses", "superseded responses"),
+        ("serve.snapshot_staleness_seconds", "snapshot staleness (s)"),
+        ("serve.drift_alarms", "drift alarms"),
+    ]
+    seen = False
+    for key, label in simple:
+        if key in metrics:
+            seen = True
+            print(f"  {label}: {_fmt(metrics[key].get('value'))}", file=out)
+    if not seen and not lat:
+        print("  (no serving metrics in this run)", file=out)
+
+
+def render_slo(man: Dict[str, Any], out) -> bool:
+    _section("slo", out)
+    slo = man.get("slo")
+    if slo is None:
+        rec = man.get("record")
+        if isinstance(rec, dict):
+            slo = (rec.get("manifest") or {}).get("slo")
+    if not isinstance(slo, dict):
+        print("  (no SLO verdict in this run)", file=out)
+        return True
+    rows = []
+    for name, c in sorted((slo.get("checks") or {}).items()):
+        rows.append(
+            (
+                name,
+                _fmt(c.get("observed")),
+                _fmt(c.get("limit")),
+                "PASS" if c.get("ok") else "FAIL"
+                + (f" ({c['reason']})" if c.get("reason") else ""),
+            )
+        )
+    _table(("check", "observed", "limit", "verdict"), rows, out)
+    attained = bool(slo.get("attained"))
+    print(f"  overall: {'ATTAINED' if attained else 'UNMET'}", file=out)
+    return attained
+
+
+def render(man: Dict[str, Any], metrics: Dict[str, Dict[str, Any]], out) -> None:
+    print("hhmm_tpu run report", file=out)
+    render_run(man, out)
+    render_spans(man, out)
+    render_compile(man, out)
+    render_memory(man, out)
+    render_convergence(metrics, out)
+    render_serving(metrics, out)
+    render_slo(man, out)
+
+
+def main(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("manifest", help="full run manifest JSON (obs/manifest.py)")
+    ap.add_argument(
+        "--metrics",
+        default=None,
+        metavar="JSONL",
+        help="metrics JSONL export to render instead of the manifest's "
+        "embedded snapshot (MetricsRegistry.export_jsonl)",
+    )
+    args = ap.parse_args(argv[1:])
+    try:
+        with open(args.manifest) as f:
+            man = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"obs_report: cannot read manifest {args.manifest}: {e}", file=sys.stderr)
+        return 2
+    if not isinstance(man, dict):
+        print(f"obs_report: {args.manifest} is not a manifest object", file=sys.stderr)
+        return 2
+    metrics: Dict[str, Dict[str, Any]] = {}
+    if args.metrics is not None:
+        try:
+            metrics = load_metrics_jsonl(args.metrics)
+        except (OSError, json.JSONDecodeError) as e:
+            print(
+                f"obs_report: cannot read metrics {args.metrics}: {e}",
+                file=sys.stderr,
+            )
+            return 2
+    else:
+        metrics = man.get("metrics") or {}
+    render(man, metrics, sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

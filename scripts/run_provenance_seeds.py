@@ -61,7 +61,7 @@ def main(cache_dir: str):
         if cache.get(ck) is not None:
             print(seed, "cached", flush=True)
             continue
-        t0 = time.time()
+        t0 = time.perf_counter()  # monotonic (check_guards invariant 5a)
         res = run_window(
             price, size, t, ins_end, config=cfg, key=jax.random.PRNGKey(seed)
         )
@@ -77,7 +77,7 @@ def main(cache_dir: str):
         cache.put(ck, hit)
         print(
             seed,
-            round(time.time() - t0, 1),
+            round(time.perf_counter() - t0, 1),
             "s:",
             {k: round(float(v[0]), 4) for k, v in hit.items()},
             flush=True,

@@ -79,10 +79,13 @@ def main():
         (arg_sets pre-staged on device; compile on set -1)."""
         out = fn(*arg_sets[-1])
         jax.block_until_ready(out)
-        t0 = time.time()
+        # monotonic clock only (check_guards invariant 5a): a wall-clock
+        # step here would corrupt the measured crossover table that
+        # kernels/dispatch.py bets real decode throughput on
+        t0 = time.perf_counter()
         for r in range(reps):
             jax.block_until_ready(fn(*arg_sets[r]))
-        return (time.time() - t0) / reps
+        return (time.perf_counter() - t0) / reps
 
     def inputs(K, T, batch=None):
         shp = () if batch is None else (batch,)

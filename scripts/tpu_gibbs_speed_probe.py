@@ -50,10 +50,11 @@ def time_path(model, data, chains, draws, seed):
 
     lp = run(jax.random.PRNGKey(seed))  # compile + run
     float(np.asarray(lp.sum()))
-    t0 = time.time()
+    # monotonic clock only (check_guards invariant 5a)
+    t0 = time.perf_counter()
     lp = run(jax.random.PRNGKey(seed + 1))  # fresh key: defeats memoization
     float(np.asarray(lp.sum()))
-    dt = time.time() - t0
+    dt = time.perf_counter() - t0
     return dt, dt / (draws + 1) * 1e3  # ms per sweep (all chains)
 
 
