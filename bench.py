@@ -154,6 +154,8 @@ WORKLOAD_FLAGS = (
     "scale_sweep",
     "sweep_samples",
     "assoc_sweep",
+    "plan_sweep",
+    "plan_topologies",
     "serve",
     "ticks",
     "serve_draws",
@@ -419,6 +421,166 @@ def serve_bench(args, backend, degraded) -> None:
         sys.exit(1)
 
 
+def plan_sweep(args, backend, topologies) -> None:
+    """``--plan-sweep``: planned vs naive single-axis layouts over
+    synthetic multi-device topologies (virtual CPU devices — the same
+    substrate `__graft_entry__.dryrun_multichip` and `tests/test_plan.py`
+    use).
+
+    For each topology the topology-aware planner (`hhmm_tpu/plan/`,
+    `docs/sharding.md`) chooses the mesh/chunk/branch jointly
+    (``layout="auto"``) and is raced against the pre-planner single-axis
+    layout (every device on the series axis, ``layout="series"``); the
+    single-device path is the correctness reference — planned draws must
+    match it BITWISE (exit 1 otherwise). Emits one
+    ``tayal_plan_sweep_throughput`` record whose points carry each
+    topology's plan stanza, so `scripts/bench_diff.py` gates planned-
+    layout throughput between comparable records (the workload digest
+    includes the topology list)."""
+    from __graft_entry__ import _tayal_batch
+    from hhmm_tpu.batch import default_init
+    from hhmm_tpu.infer import GibbsConfig, sample_gibbs
+    from hhmm_tpu.models import TayalHHMM
+    from hhmm_tpu.plan import WorkloadShape, make_plan
+
+    avail = len(jax.devices())
+    for n in topologies:
+        if n > avail:
+            print(
+                f"# plan-sweep: skipping topology {n} (only {avail} devices)",
+                file=sys.stderr,
+                flush=True,
+            )
+    # ascending, deduped, with the single-device parity reference FIRST
+    # regardless of the order --plan-topologies was given in — the
+    # reference, the headline value (largest topology), and the stamped
+    # plan stanza all depend on this ordering
+    usable = sorted({n for n in topologies if n <= avail} | {1})
+    # the workload digest must describe the topologies actually measured,
+    # not the raw flag (None default / entries skipped for lack of
+    # devices would alias digests across genuinely different sweeps)
+    args.plan_topologies = usable
+
+    model = TayalHHMM(gate_mode="hard")
+    B, T = (8, 64) if args.quick else (32, 256)
+    w, s = (2, 6) if args.quick else (20, 80)
+    reps = 2 if args.quick else 5
+    # 2 chains: the planner's auto layout (chain axis first — it divides
+    # exactly) genuinely DIFFERS from the naive all-on-series arm, so
+    # the planned-vs-naive race measures a real planner decision instead
+    # of comparing a layout against itself
+    chains = 2
+    cfg = GibbsConfig(num_warmup=w, num_samples=s, num_chains=chains)
+    x, sign = _tayal_batch(B, T, seed=42)
+    init = default_init(
+        model, {"x": x, "sign": sign}, B, chains, jax.random.PRNGKey(100)
+    )
+    keys = jax.random.split(jax.random.PRNGKey(0), B)
+    shape = WorkloadShape(B=B, T=T, C=chains, K=model.K)
+
+    def run_chunk(x, sign, init, keys):
+        def one(xi, si, qi, ki):
+            qs, _ = sample_gibbs(
+                model, {"x": xi, "sign": si}, ki, cfg, init_q=qi, jit=False
+            )
+            return qs
+
+        return jax.vmap(one)(x, sign, init, keys)
+
+    def runner(plan, name):
+        # placement objects come from the plan (check_guards invariant 7)
+        if plan.mesh is None:
+            fn = jax.jit(run_chunk)
+        else:
+            fn = jax.jit(
+                run_chunk,
+                in_shardings=(
+                    plan.data_sharding(x.ndim),
+                    plan.data_sharding(sign.ndim),
+                    plan.sharding("series", "chain", None),
+                    plan.data_sharding(keys.ndim),
+                ),
+            )
+        return telemetry.register_jit(name, fn)
+
+    points = []
+    ref = None
+    parity_all = True
+    last_planned = None
+    for n in usable:
+        devs = jax.devices()[:n]
+        row = {"devices": n, "series": B}
+        arms = [("planned", "auto")]
+        if n > 1:
+            arms.append(("naive", "series"))
+        for arm, layout in arms:
+            plan = make_plan(shape, devices=devs, chunk_size=B, layout=layout)
+            fn = runner(plan, f"bench.plan_sweep.{arm}.d{n}")
+            with plan.dispatch_scope():
+                qs = jax.block_until_ready(fn(x, sign, init, keys))  # compile
+            t0 = perf_counter()
+            for _ in range(reps):
+                jax.block_until_ready(fn(x, sign, init, keys))
+            dt = (perf_counter() - t0) / reps
+            row[f"{arm}_series_per_sec"] = round(B / dt, 2)
+            if arm == "planned":
+                last_planned = plan
+                row["plan"] = plan.stanza()
+                q_np = np.asarray(qs)
+                if ref is None:
+                    ref = q_np  # usable is sorted: this is the 1-device run
+                else:
+                    # equal_nan: a quarantined (non-finite) draw that is
+                    # byte-identical in both arms is parity, not a
+                    # layout divergence
+                    ok = bool(np.array_equal(q_np, ref, equal_nan=True))
+                    row["parity_bitwise"] = ok
+                    with np.errstate(invalid="ignore"):
+                        diff = np.abs(q_np - ref)
+                    row["parity_max_abs"] = float(
+                        np.max(np.where(np.isnan(q_np) & np.isnan(ref), 0.0, diff))
+                    )
+                    parity_all = parity_all and ok
+        if row.get("naive_series_per_sec"):  # the layout="series" arm
+            row["speedup_planned_vs_naive"] = round(
+                row["planned_series_per_sec"] / row["naive_series_per_sec"], 3
+            )
+        points.append(row)
+        print(json.dumps(row), file=sys.stderr, flush=True)
+
+    if last_planned is not None:
+        # the record's manifest plan stanza is the planned layout at the
+        # LARGEST topology (the headline), not whatever plan was noted
+        # last inside the loop (the naive comparison arm)
+        last_planned.note()
+    record = stamp_record(
+        {
+            "metric": "tayal_plan_sweep_throughput",
+            "unit": "series/sec",
+            "value": points[-1]["planned_series_per_sec"],
+            "points": points,
+            "parity_ok": parity_all,
+            "topologies": usable,
+            "backend": backend["backend"],
+            "backend_fallback": backend["fallback"],
+            "device": str(jax.devices()[0]),
+            "quick": bool(args.quick),
+        },
+        args,
+        model=model,
+    )
+    print(json.dumps(record))
+    emit_manifest(args, "plan_sweep", record, model=model)
+    if not parity_all:
+        print(
+            "# plan-sweep FAILED: a planned layout diverged from the "
+            "single-device reference (bitwise parity is the correctness "
+            "bar on CPU)",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+
+
 def assoc_sweep(args, backend) -> None:
     """``--assoc-sweep``: sequential-scan vs associative-scan decode
     throughput (`kernels/assoc.py`, dispatched by
@@ -612,6 +774,26 @@ def main() -> None:
         "see docs/parallel_scan.md)",
     )
     ap.add_argument(
+        "--plan-sweep",
+        action="store_true",
+        help="run the execution-planner layout sweep instead of the fit "
+        "bench: for each synthetic CPU topology (default 1 2 4 8; "
+        "virtual host devices are forced before backend init), race the "
+        "planner-chosen layout (hhmm_tpu/plan) against the naive "
+        "all-devices-on-series layout, assert bitwise parity against "
+        "the single-device reference, and emit a gateable "
+        "tayal_plan_sweep_throughput record whose workload digest "
+        "includes the topology (see docs/sharding.md)",
+    )
+    ap.add_argument(
+        "--plan-topologies",
+        nargs="*",
+        type=int,
+        default=None,
+        metavar="N",
+        help="plan-sweep device counts (default: 1 2 4 8; quick: 1 4)",
+    )
+    ap.add_argument(
         "--serve",
         action="store_true",
         help="run the streaming-service bench instead of the fit bench: "
@@ -692,6 +874,27 @@ def main() -> None:
     # a fake-clean 0 — compile_listener_on gates the subtraction below.
     compile_listener_on = telemetry.install_listeners()
     from hhmm_tpu.robust.retry import ensure_backend
+
+    if args.plan_sweep:
+        # synthetic multi-device topology: the CPU platform + virtual
+        # device count must be forced BEFORE any backend initializes
+        # (the same discipline as __graft_entry__.dryrun_multichip)
+        from hhmm_tpu.plan import force_host_platform_devices
+
+        topologies = args.plan_topologies or ([1, 4] if args.quick else [1, 2, 4, 8])
+        try:
+            force_host_platform_devices(max(topologies))
+        except RuntimeError as e:  # backend already up: use what exists
+            print(f"# plan-sweep: {e}; using existing devices", file=sys.stderr)
+        backend = {
+            # honest stamp: if the force failed above, the surviving
+            # backend may not be CPU — record what actually runs
+            "backend": jax.default_backend(),
+            "fallback": False,
+            "devices": len(jax.devices()),
+        }
+        plan_sweep(args, backend, topologies)
+        return
 
     if args.cpu:
         # forced-CPU runs must set the platform BEFORE any backend probe
@@ -779,6 +982,16 @@ def main() -> None:
     chunk = min(args.chunk, args.series)
     if args.series % chunk != 0:
         raise SystemExit(f"--series {args.series} must be divisible by --chunk {chunk}")
+    # record the resolved execution plan for this (single-chip) workload
+    # so every fit-bench manifest carries the `plan` stanza — mesh,
+    # chunk, kernel branch, rationale (hhmm_tpu/plan, docs/sharding.md)
+    from hhmm_tpu.plan import WorkloadShape as _WShape, make_plan as _make_plan
+
+    _make_plan(
+        _WShape(B=args.series, T=args.T, C=chains, K=model.K),
+        n_devices=1,
+        chunk_size=chunk,
+    )
     from hhmm_tpu.batch import default_init
 
     init = default_init(

@@ -17,6 +17,10 @@ Layer map (see SURVEY.md §7):
 - ``infer``    — iterative NUTS on TPU (vmapped chains), Stan-style warmup
   adaptation, Rhat/ESS diagnostics, k-means inits, relabeling.
 - ``parallel`` — mesh sharding for many-series scale-out, result caching.
+- ``plan``     — topology-aware execution planner: ONE placement
+  substrate (mesh axes, shardings, chunking, kernel branch) shared by
+  the batch fit path, the serve scheduler, and the multi-chip entry
+  points (`docs/sharding.md`).
 - ``robust``   — chain-health guards, self-healing retry, fault injection.
 - ``obs``      — observability: span tracing (``HHMM_TPU_TRACE=1``),
   compile/memory telemetry, run manifests (`docs/observability.md`).

@@ -11,10 +11,16 @@ Key design points:
   and doubles as the granularity of crash recovery via the digest cache
   — exactly the role of the reference's per-task RDS files
   (`tayal2009/R/wf-trade.R:86-109`).
-- **Mesh sharding**: pass a ``jax.sharding.Mesh`` with a ``"series"``
-  axis and each chunk is laid out across devices with
-  ``NamedSharding``; per-series work is embarrassingly parallel so the
-  only communication is the result gather (SURVEY.md §2.9).
+- **Planned placement**: layout decisions (mesh axes, shardings, chunk
+  rounding, kernel branch) come from the topology-aware planner
+  (`hhmm_tpu/plan/`, `docs/sharding.md`) — pass ``plan=`` (preferred)
+  or a legacy ``mesh=`` with a ``"series"`` axis (wrapped via
+  :func:`hhmm_tpu.plan.plan_for_mesh`). A chunk size that doesn't
+  divide the series axis is auto-rounded UP (warned once), never an
+  error; per-series work is embarrassingly parallel so the only
+  communication is the result gather (SURVEY.md §2.9). The resolved
+  plan is recorded in run manifests (`obs/manifest.py` ``plan``
+  stanza).
 - **Warm starts**: ``init`` can be given explicitly — the walk-forward
   harness passes the previous window's posterior, the idiomatic
   improvement over Stan's cold restarts the reference calls out as its
@@ -38,6 +44,7 @@ from hhmm_tpu.obs.trace import span
 from hhmm_tpu.infer.chees import ChEESConfig, make_lp_bc, sample_chees_batched
 from hhmm_tpu.infer.gibbs import GibbsConfig, sample_gibbs
 from hhmm_tpu.infer.run import SamplerConfig
+from hhmm_tpu.plan import Plan, WorkloadShape, make_plan, plan_for_mesh
 from hhmm_tpu.robust import faults
 from hhmm_tpu.robust.retry import RetryPolicy, escalate, rejitter
 
@@ -45,6 +52,11 @@ __all__ = ["default_init", "fit_batched"]
 
 # base backoff between chunk retries on device faults (tests zero this)
 _RETRY_SLEEP_S = 15.0
+
+# one chunk-rounding warning per (requested, rounded) pair per process —
+# the rounding is deliberate planner behavior, not an anomaly worth a
+# line per chunk of every sweep
+_CHUNK_ROUND_WARNED: set = set()
 
 # bound on the (series × parameter) rows fed to the interim per-chunk
 # convergence estimators — a 512-series × 100-dim chunk must not pay a
@@ -169,6 +181,7 @@ def fit_batched(
     init: Optional[jnp.ndarray] = None,
     chunk_size: int = 64,
     mesh: Optional[jax.sharding.Mesh] = None,
+    plan: Optional[Plan] = None,
     cache_dir: Optional[str] = None,
     retry: Optional[RetryPolicy] = None,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
@@ -185,6 +198,20 @@ def fit_batched(
     per-series, so its adaptation reductions stay within each series),
     and a :class:`GibbsConfig` runs blocked conjugate Gibbs
     (`infer/gibbs.py` — the model must implement ``gibbs_update``).
+
+    Placement: pass ``plan=`` (a :class:`hhmm_tpu.plan.Plan` from
+    :func:`hhmm_tpu.plan.make_plan` — the topology-aware layout
+    decision, `docs/sharding.md`) to shard chunks over a device mesh;
+    the legacy ``mesh=`` argument is wrapped into a plan via
+    :func:`hhmm_tpu.plan.plan_for_mesh`. Without either, a trivial
+    single-device plan is recorded so run manifests always carry the
+    resolved layout. An explicit ``plan=`` governs chunking —
+    ``chunk_size`` is only consulted when no plan is passed — and is
+    validated against the workload (chain ways must divide
+    ``config.num_chains``). ``chunk_size`` is auto-rounded up to a
+    multiple of the plan's series ways (one warning per process); the planner's
+    resolved time-parallel branch scopes ``"auto"`` kernel dispatch
+    while chunks trace (`kernels/dispatch.py`).
 
     Self-healing dispatch (`docs/robustness.md`): every sampler routes
     transitions through the chain-health guard, so a chunk's
@@ -233,13 +260,58 @@ def fit_batched(
     keys = jax.random.split(key, B)
 
     cache = ResultCache(cache_dir)
-    chunk = min(chunk_size, B)
-    if mesh is not None:
-        n_series_dev = mesh.shape["series"]
-        if chunk % n_series_dev != 0:
+    # ---- placement (hhmm_tpu/plan): one substrate decides mesh axes,
+    # shardings, chunk rounding, and the time-parallel branch ----
+    if plan is not None and mesh is not None:
+        raise ValueError("pass plan= or mesh=, not both")
+    if plan is None:
+        T_guess = max(
+            [int(v.shape[1]) for v in data.values() if v.ndim >= 2] or [1]
+        )
+        shape_w = WorkloadShape(
+            B=B, T=T_guess, C=C, K=int(getattr(model, "K", 0) or 0)
+        )
+        if mesh is not None:
+            plan = plan_for_mesh(mesh, shape_w, chunk_size=chunk_size)
+        else:
+            # default: the existing single-device dispatch, but decided
+            # and recorded through the planner (manifest `plan` stanza)
+            plan = make_plan(shape_w, n_devices=1, chunk_size=chunk_size)
+    else:
+        # an explicitly-passed plan GOVERNS (chunk_size= is unused):
+        # validate it against the actual workload so a mismatch fails
+        # here with a planner-level message, not as an opaque XLA
+        # sharding error deep inside jit
+        cw = plan.ways("chain")
+        if cw > 1 and C % cw != 0:
             raise ValueError(
-                f"chunk_size {chunk} not divisible by mesh series axis {n_series_dev}"
+                f"plan shards chains {cw}-ways but config.num_chains={C} "
+                f"is not divisible by it — build the plan with "
+                f"WorkloadShape(C={C}) (got plan for {plan.shape.as_dict()})"
             )
+        if int(plan.shape.B) != B:
+            # stale plan: still correct (ragged chunks pad), but a chunk
+            # sized for a different B can waste whole dispatches on
+            # padding lanes — surface it
+            print(
+                f"# fit_batched: plan was built for B={plan.shape.B} "
+                f"series, fitting B={B} (the plan's chunk {plan.chunk} "
+                "governs; chunk_size= is ignored when plan= is given)",
+                file=sys.stderr,
+                flush=True,
+            )
+        plan.note()
+    mesh = plan.mesh
+    chunk = plan.chunk
+    if chunk != plan.chunk_requested and (plan.chunk_requested, chunk) not in _CHUNK_ROUND_WARNED:
+        _CHUNK_ROUND_WARNED.add((plan.chunk_requested, chunk))
+        print(
+            f"# fit_batched: chunk_size {plan.chunk_requested} rounded up to "
+            f"{chunk} (multiple of mesh series axis {plan.series_ways}; "
+            "ragged tails pad by lane repeat with weight 0)",
+            file=sys.stderr,
+            flush=True,
+        )
 
     data_keys = list(data.keys())
 
@@ -290,17 +362,9 @@ def fit_batched(
 
         if mesh is None:
             return jax.jit(run_chunk)
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        def shard(x):
-            return NamedSharding(mesh, P("series", *([None] * (x.ndim - 1))))
-
-        in_shardings = (
-            {k: shard(v[:chunk]) for k, v in data.items()},
-            shard(init[:chunk]),
-            shard(keys[:chunk]),
-            NamedSharding(mesh, P("series")),  # chunk_w [chunk]
-        )
+        # placement objects come from the plan (check_guards invariant 7:
+        # no Mesh/NamedSharding/PartitionSpec construction in this module)
+        in_shardings = plan.fit_in_shardings(data, init, keys)
         return jax.jit(run_chunk, in_shardings=in_shardings)
 
     runners = {config: make_runner(config)}
@@ -318,7 +382,11 @@ def fit_batched(
         attempts = max(1, policy.device_retries)
         for attempt in range(attempts):
             try:
-                return jax.block_until_ready(run_fn(*args))
+                # the plan's resolved time-parallel branch scopes "auto"
+                # kernel dispatch while the chunk traces — the manifest
+                # plan stanza and the kernels that actually run agree
+                with plan.dispatch_scope():
+                    return jax.block_until_ready(run_fn(*args))
             except (jax.errors.JaxRuntimeError, ValueError) as e:
                 # device faults surface as JaxRuntimeError OR a
                 # ValueError wrapper depending on where in the

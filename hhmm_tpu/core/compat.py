@@ -24,7 +24,18 @@ from __future__ import annotations
 import jax
 from jax import lax
 
-__all__ = ["shard_map", "pcast_varying"]
+__all__ = ["shard_map", "pcast_varying", "pspec"]
+
+
+def pspec(*axes):
+    """Construct a ``jax.sharding.PartitionSpec``. The kernel layer's
+    shard_map bodies (`kernels/assoc.py`) describe their in/out specs
+    through this shim so that placement-object construction stays
+    confined to `hhmm_tpu/plan/` and this module — the
+    `scripts/check_guards.py` invariant-7 boundary."""
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec(*axes)
 
 
 def shard_map(f, *, mesh, in_specs, out_specs):

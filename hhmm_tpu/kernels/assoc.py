@@ -55,9 +55,12 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
-from hhmm_tpu.core.compat import pcast_varying, shard_map
+# placement objects are constructed only in hhmm_tpu/plan/ and
+# core/compat.py (check_guards invariant 7): shard_map body specs go
+# through the compat pspec shim
+from hhmm_tpu.core.compat import pcast_varying, pspec as P, shard_map
 from hhmm_tpu.core.lmath import safe_log_normalize, safe_logsumexp
 from hhmm_tpu.kernels.semiring import (
     compose_maps,

@@ -24,6 +24,8 @@ static under ``jit``, so dispatch is plain Python with zero trace cost.
 
 from __future__ import annotations
 
+import contextlib
+import threading
 from typing import Optional, Tuple, Union
 
 import jax
@@ -43,6 +45,7 @@ from hhmm_tpu.obs.trace import span
 
 __all__ = [
     "ASSOC_CROSSOVER",
+    "plan_time_parallel",
     "use_assoc",
     "forward_filter_dispatch",
     "backward_dispatch",
@@ -97,8 +100,43 @@ ASSOC_CROSSOVER = {
 }
 
 
+# per-process backend cache: jax.default_backend() walks the backend
+# registry on every call, and dispatch runs once per draw per kernel —
+# the platform cannot change after the first backend init, so pay the
+# lookup exactly once
+_PLATFORM_CACHE: Optional[str] = None
+
+
 def _platform() -> str:
-    return jax.default_backend()
+    global _PLATFORM_CACHE
+    if _PLATFORM_CACHE is None:
+        _PLATFORM_CACHE = jax.default_backend()
+    return _PLATFORM_CACHE
+
+
+# planner override (hhmm_tpu/plan): while a Plan's dispatch_scope() is
+# active, "auto" resolves to the plan's already-recorded branch instead
+# of re-consulting the crossover table — the planner's manifest stanza
+# and what actually dispatches can never disagree. Thread-local (the
+# obs/trace.py discipline): a fit tracing under one plan's scope must
+# not leak its pinned branch into a serve thread's "auto" dispatch.
+_PLAN_TLS = threading.local()
+
+
+@contextlib.contextmanager
+def plan_time_parallel(value: Optional[bool]):
+    """Scope an execution-plan branch decision over ``"auto"`` dispatch
+    (installed by ``hhmm_tpu.plan.Plan.dispatch_scope``). ``True`` pins
+    assoc, ``False`` pins the sequential scan, ``None`` restores table
+    lookup. Explicit ``time_parallel=True/False`` call sites still win.
+    Per-thread: the scope only affects dispatch on the installing
+    thread."""
+    prev = getattr(_PLAN_TLS, "value", None)
+    _PLAN_TLS.value = value
+    try:
+        yield
+    finally:
+        _PLAN_TLS.value = prev
 
 
 def use_assoc(
@@ -109,6 +147,7 @@ def use_assoc(
 ) -> bool:
     """Resolve a ``time_parallel`` setting to a concrete choice for a
     (K, T) shape: explicit ``True``/``False`` pass through; ``"auto"``
+    defers to an active plan scope (:func:`plan_time_parallel`), else
     consults the measured crossover table for the active backend."""
     if time_parallel is True or time_parallel is False:
         return time_parallel
@@ -116,6 +155,9 @@ def use_assoc(
         raise ValueError(
             f"time_parallel must be True, False, or 'auto', got {time_parallel!r}"
         )
+    plan_value = getattr(_PLAN_TLS, "value", None)
+    if plan_value is not None:
+        return plan_value
     table = ASSOC_CROSSOVER.get(
         platform or _platform(), ASSOC_CROSSOVER["default"]
     )

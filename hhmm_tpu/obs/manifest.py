@@ -37,6 +37,7 @@ import platform
 import socket
 import subprocess
 import sys
+import threading
 from typing import Any, Dict, Optional
 
 from hhmm_tpu.obs import metrics as obs_metrics
@@ -48,6 +49,8 @@ __all__ = [
     "stack_versions",
     "device_info",
     "config_digest",
+    "note_stanza",
+    "noted_stanza",
     "collect_manifest",
     "manifest_stanza",
     "write_manifest",
@@ -55,6 +58,35 @@ __all__ = [
 ]
 
 MANIFEST_VERSION = 1
+
+# decision stanzas noted by subsystems for embedding into every
+# subsequently collected manifest — the planner (hhmm_tpu/plan) records
+# its resolved layout here the way kernels/dispatch.py records its
+# resolved branch in span names. Last note per name wins (the manifest
+# describes the run's current decisions, not a history — the span table
+# carries the history). Lock-guarded (the obs/trace.py discipline): a
+# serving thread noting a plan while another thread collects a manifest
+# must not tear the iteration.
+_NOTED_STANZAS: Dict[str, Any] = {}
+_NOTED_LOCK = threading.Lock()
+
+
+def note_stanza(name: str, stanza: Any) -> None:
+    """Record a subsystem decision (e.g. the execution ``plan``) to be
+    embedded verbatim in every manifest collected afterward."""
+    with _NOTED_LOCK:
+        _NOTED_STANZAS[str(name)] = stanza
+
+
+def noted_stanza(name: str) -> Optional[Any]:
+    """The most recently noted stanza for ``name`` (or ``None``)."""
+    with _NOTED_LOCK:
+        return _NOTED_STANZAS.get(str(name))
+
+
+def _noted_snapshot() -> Dict[str, Any]:
+    with _NOTED_LOCK:
+        return dict(_NOTED_STANZAS)
 
 
 def _digest_update(h, obj) -> None:
@@ -242,6 +274,10 @@ def collect_manifest(
         "metrics": obs_metrics.snapshot(),
         **telemetry.telemetry_snapshot(),
     }
+    # subsystem decision stanzas (note_stanza): the execution planner's
+    # resolved layout rides in every manifest as man["plan"]
+    for k, v in _noted_snapshot().items():
+        man.setdefault(k, v)
     if extra:
         man.update(extra)
     return man

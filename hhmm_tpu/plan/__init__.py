@@ -1,0 +1,23 @@
+"""Topology-aware execution planner — the single placement substrate
+shared by `batch/fit.py`, `serve/scheduler.py`, and the multi-chip
+entry points (`docs/sharding.md`). All ``Mesh`` / ``NamedSharding`` /
+``PartitionSpec`` construction lives here (plus the `core/compat.py`
+shims) — `scripts/check_guards.py` invariant 7."""
+
+from hhmm_tpu.plan.planner import (
+    MIN_SP_CHUNK,
+    Plan,
+    WorkloadShape,
+    force_host_platform_devices,
+    make_plan,
+    plan_for_mesh,
+)
+
+__all__ = [
+    "MIN_SP_CHUNK",
+    "Plan",
+    "WorkloadShape",
+    "force_host_platform_devices",
+    "make_plan",
+    "plan_for_mesh",
+]

@@ -1,0 +1,514 @@
+"""Topology-aware execution planner: ONE placement substrate for the
+batch fit path (`batch/fit.py`), the serving scheduler
+(`serve/scheduler.py`), and the multi-chip dry run (`__graft_entry__`).
+
+Before this module, layout decisions were scattered per callsite:
+`batch/fit.py` hand-rolled a 1-D series ``NamedSharding`` and hard-
+errored on ``chunk % mesh.shape["series"]``, `serve/scheduler.py` kept
+its own fixed bucket ladder, and the 2-D series × sp mesh repaired in
+the time-parallel PR was exercised only inside
+``__graft_entry__.dryrun_multichip``. The Megatron/GSPMD lesson is that
+placement belongs in one planner that sees the whole
+(batch, sequence, chains, devices) problem — so this module is the ONLY
+place (plus the `core/compat.py` shims) where ``Mesh`` /
+``NamedSharding`` / ``PartitionSpec`` objects are constructed;
+`scripts/check_guards.py` invariant 7 enforces it statically.
+
+Decision procedure (:func:`make_plan`), given a
+:class:`WorkloadShape` ``(B series, T steps, C chains, K states)`` and a
+device topology of ``D`` devices:
+
+1. **chains first** — ``chain_ways = gcd(C, D)``: chains divide exactly
+   (zero padding waste), so they soak up devices before the series axis,
+   which may need chunk rounding;
+2. **series next** — the largest divisor of the remaining ways that is
+   ``<= B`` becomes the ``series`` axis;
+3. **sequence last** — ways still left go to an ``sp`` axis *iff* the
+   time axis divides evenly and each chunk keeps at least
+   ``MIN_SP_CHUNK`` steps (the `kernels/assoc.py` seqshard algebra);
+   otherwise the leftover devices idle (recorded in the rationale).
+
+The resulting :class:`Plan` carries the mesh axes, chunk size
+(auto-rounded UP to a multiple of the series ways — the planner never
+raises the old divisibility error), the serve bucket ladder (each bucket
+rounded to a series-ways multiple, plus the minimum bucket size worth
+sharding a flush for), the resolved ``time_parallel`` kernel branch
+(via the measured `kernels/dispatch.py` crossover), and a human-readable
+``reason`` string. Every plan is recorded into the run-manifest plane
+(`obs/manifest.py` ``note_stanza("plan", ...)``) exactly the way
+`kernels/dispatch.py` records its resolved branch in span names, so
+every bench/fit manifest shows which layout actually ran.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+from hhmm_tpu.obs import manifest as obs_manifest
+
+__all__ = [
+    "MIN_SP_CHUNK",
+    "WorkloadShape",
+    "Plan",
+    "make_plan",
+    "plan_for_mesh",
+    "force_host_platform_devices",
+]
+
+# an sp (sequence-parallel) shard below this many steps pays more in
+# all_gather/psum glue than the log-depth scan saves — leftover devices
+# idle instead (the rationale string says so)
+MIN_SP_CHUNK = 8
+
+
+@dataclass(frozen=True)
+class WorkloadShape:
+    """The four numbers every placement decision is a function of."""
+
+    B: int  # independent series
+    T: int  # time steps per series
+    C: int = 1  # chains per series
+    K: int = 4  # hidden states
+
+    def as_dict(self) -> Dict[str, int]:
+        return {"B": int(self.B), "T": int(self.T), "C": int(self.C), "K": int(self.K)}
+
+
+def _largest_divisor_leq(n: int, cap: int) -> int:
+    """Largest divisor of ``n`` that is <= cap (>= 1)."""
+    best = 1
+    for d in range(1, n + 1):
+        if n % d == 0 and d <= cap:
+            best = d
+    return best
+
+
+@dataclass
+class Plan:
+    """An explicit, recordable placement decision.
+
+    ``axes`` is the ordered mesh layout as ``((name, ways), ...)`` with
+    only ways > 1 retained (``()`` means single-device, no mesh);
+    ``chunk`` is the auto-rounded series-per-dispatch; ``buckets`` the
+    serve micro-batch ladder; ``branch`` the resolved time-parallel
+    kernel branch (``"scan"`` / ``"assoc"`` / ``"seqshard"``);
+    ``reason`` the human-readable rationale recorded in manifests.
+    """
+
+    shape: WorkloadShape
+    platform: str
+    n_devices: int  # topology offered to the planner
+    axes: Tuple[Tuple[str, int], ...]
+    chunk: int
+    chunk_requested: int
+    buckets: Tuple[int, ...]
+    shard_min_bucket: int
+    branch: str
+    reason: str
+    _devices: Optional[tuple] = field(default=None, repr=False, compare=False)
+    _mesh: Any = field(default=None, repr=False, compare=False)
+    # per-axes NamedSharding cache: the serve scheduler calls place()
+    # several times per sharded flush, and the sharding is a pure
+    # function of (mesh, axes) — construct each once, like _mesh
+    _sharding_cache: Dict[Tuple, Any] = field(
+        default_factory=dict, repr=False, compare=False
+    )
+
+    # ---- derived topology ----
+
+    def ways(self, name: str) -> int:
+        for n, w in self.axes:
+            if n == name:
+                return w
+        return 1
+
+    @property
+    def series_ways(self) -> int:
+        return self.ways("series")
+
+    @property
+    def devices_used(self) -> int:
+        return int(math.prod(w for _, w in self.axes)) if self.axes else 1
+
+    @property
+    def mesh_shape(self) -> Optional[Dict[str, int]]:
+        return dict(self.axes) if self.axes else None
+
+    # ---- placement objects (the ONLY construction site outside
+    # core/compat.py — check_guards invariant 7) ----
+
+    @property
+    def mesh(self):
+        """The ``jax.sharding.Mesh`` for this plan (built lazily, cached)
+        or ``None`` for a single-device plan."""
+        if self._mesh is not None:
+            return self._mesh
+        if not self.axes:
+            return None
+        import numpy as np
+        import jax
+        from jax.sharding import Mesh
+
+        devices = list(self._devices) if self._devices else jax.devices()
+        need = self.devices_used
+        if len(devices) < need:
+            raise RuntimeError(
+                f"plan needs {need} devices "
+                f"({dict(self.axes)}), only {len(devices)} available"
+            )
+        names = tuple(n for n, _ in self.axes)
+        shape = tuple(w for _, w in self.axes)
+        self._mesh = Mesh(np.asarray(devices[:need]).reshape(shape), names)
+        return self._mesh
+
+    def sharding(self, *axes):
+        """``NamedSharding`` placing each array dimension on the named
+        mesh axis (or replicated for ``None`` / axes the mesh doesn't
+        have — so drivers can say ``plan.sharding("series", "chain",
+        None)`` without caring whether the chain axis materialized).
+        Returns ``None`` for a single-device plan."""
+        mesh = self.mesh
+        if mesh is None:
+            return None
+        cached = self._sharding_cache.get(axes)
+        if cached is not None:
+            return cached
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        present = set(mesh.axis_names)
+        spec = PartitionSpec(*(a if (a in present) else None for a in axes))
+        sh = NamedSharding(mesh, spec)
+        self._sharding_cache[axes] = sh
+        return sh
+
+    def data_sharding(self, ndim: int):
+        """Leading-axis series sharding for a [B, ...] array (the fit
+        chunk / serve bucket layout); ``None`` on a single-device plan."""
+        return self.sharding("series", *([None] * (max(ndim, 1) - 1)))
+
+    def fit_in_shardings(self, data: Dict[str, Any], init: Any, keys: Any):
+        """The `batch/fit.py` chunk-runner input layout:
+        ``(data shardings, init, keys, weights)``. Data and keys shard
+        their leading series axis; ``init`` [B, C, dim] additionally
+        shards chains over the chain axis when the plan has one.
+        ``None`` when the plan is single-device (plain ``jax.jit``)."""
+        if self.mesh is None:
+            return None
+        data_sh = {
+            k: self.data_sharding(getattr(v, "ndim", 1)) for k, v in data.items()
+        }
+        init_sh = self.sharding(
+            "series", "chain", *([None] * (max(getattr(init, "ndim", 3), 2) - 2))
+        )
+        keys_sh = self.data_sharding(getattr(keys, "ndim", 2))
+        w_sh = self.sharding("series")
+        return (data_sh, init_sh, keys_sh, w_sh)
+
+    def place(self, arr):
+        """Commit a [B, ...] array onto the plan's series layout (used by
+        the serve scheduler's sharded flush). Identity on single-device
+        plans."""
+        sh = self.data_sharding(getattr(arr, "ndim", 1))
+        if sh is None:
+            return arr
+        import jax
+
+        return jax.device_put(arr, sh)
+
+    def shard_bucket(self, bucket: int) -> bool:
+        """Whether a serve flush of ``bucket`` lanes is worth dispatching
+        sharded: the plan has a series axis, the bucket divides it, and
+        it clears the minimum size (below it the collective/placement
+        glue outweighs the parallelism). A pure function of the bucket
+        size, so the scheduler's compile count stays flat."""
+        sw = self.series_ways
+        return sw > 1 and bucket >= self.shard_min_bucket and bucket % sw == 0
+
+    # ---- dispatch coupling ----
+
+    def dispatch_scope(self):
+        """Context manager installing this plan's resolved kernel branch
+        as the `kernels/dispatch.py` ``"auto"`` answer, so the planner's
+        recorded branch and what ``use_assoc`` picks inside the jitted
+        program can never disagree. No-op for the ``seqshard`` branch
+        (seqshard is invoked explicitly, not via the crossover table)."""
+        from hhmm_tpu.kernels import dispatch
+
+        if self.branch == "assoc":
+            return dispatch.plan_time_parallel(True)
+        if self.branch == "scan":
+            return dispatch.plan_time_parallel(False)
+        return dispatch.plan_time_parallel(None)
+
+    # ---- observability ----
+
+    def stanza(self) -> Dict[str, Any]:
+        """The manifest ``plan`` stanza — the planner analog of the
+        resolved-branch span names `kernels/dispatch.py` emits: mesh
+        shape, partition specs, chunk, resolved branch, and the reason,
+        all JSON-clean."""
+        specs = None
+        if self.axes:
+            has_chain = self.ways("chain") > 1
+            specs = {
+                "data": ["series"],
+                "init": ["series", "chain"] if has_chain else ["series"],
+                "keys": ["series"],
+                "weights": ["series"],
+            }
+        return {
+            "workload": self.shape.as_dict(),
+            "platform": self.platform,
+            "devices": int(self.n_devices),
+            "devices_used": int(self.devices_used),
+            "mesh": self.mesh_shape,
+            "specs": specs,
+            "chunk": int(self.chunk),
+            "chunk_requested": int(self.chunk_requested),
+            "buckets": [int(b) for b in self.buckets],
+            "shard_min_bucket": int(self.shard_min_bucket),
+            "branch": self.branch,
+            "reason": self.reason,
+        }
+
+    def note(self) -> "Plan":
+        """Record this plan's stanza into the manifest plane so every
+        subsequently emitted bench/fit manifest carries it."""
+        obs_manifest.note_stanza("plan", self.stanza())
+        return self
+
+
+def _resolve_branch(shape: WorkloadShape, sp_ways: int, time_parallel, platform):
+    """The time-parallel kernel branch this plan resolves to, via the
+    measured crossover table (`kernels/dispatch.py`)."""
+    if sp_ways > 1:
+        return "seqshard"
+    from hhmm_tpu.kernels.dispatch import use_assoc
+
+    return (
+        "assoc"
+        if use_assoc(shape.K, shape.T, time_parallel, platform)
+        else "scan"
+    )
+
+
+def _round_up(n: int, multiple: int) -> int:
+    return -(-n // multiple) * multiple
+
+
+def _bucket_ladder(
+    buckets: Sequence[int], series_ways: int
+) -> Tuple[Tuple[int, ...], int]:
+    """Round each serve bucket up to a series-ways multiple (padded lanes
+    are the scheduler's existing policy — a bucket that doesn't divide
+    the mesh would force replicated ragged shards) and pick the minimum
+    bucket worth a sharded flush: at least 4 lanes per device, floor 16."""
+    ladder = tuple(
+        sorted({max(_round_up(int(b), series_ways), series_ways) for b in buckets})
+    )
+    return ladder, max(4 * series_ways, 16)
+
+
+def _decide(shape: WorkloadShape, D: int, layout: str):
+    """Core joint decision: (axes, reason_parts)."""
+    B, T, C = int(shape.B), int(shape.T), int(shape.C)
+    parts = []
+    if D <= 1 or layout == "single":
+        return (), ["single device: no mesh"]
+    if layout == "series":
+        parts.append(f"forced single-axis layout: series={D}")
+        return (("series", D),), parts
+    rem = D
+    chain_ways = math.gcd(max(C, 1), rem)
+    if chain_ways > 1:
+        rem //= chain_ways
+        parts.append(
+            f"chain={chain_ways} (chains divide the topology exactly — no padding)"
+        )
+    series_ways = _largest_divisor_leq(rem, max(B, 1))
+    if series_ways > 1:
+        rem //= series_ways
+        parts.append(f"series={series_ways} over B={B}")
+    sp_ways = 1
+    if rem > 1:
+        if T % rem == 0 and T // rem >= MIN_SP_CHUNK:
+            sp_ways = rem
+            parts.append(
+                f"sp={sp_ways}: leftover devices sequence-shard T={T} "
+                f"({T // sp_ways} steps/shard)"
+            )
+        else:
+            parts.append(
+                f"{rem} devices idle: T={T} not divisible into >={MIN_SP_CHUNK}-step "
+                "sp shards"
+            )
+    axes = tuple(
+        (n, w)
+        for n, w in (("series", series_ways), ("chain", chain_ways), ("sp", sp_ways))
+        if w > 1
+    )
+    if not axes:
+        parts.append("workload too small to shard: single-device plan")
+    return axes, parts
+
+
+def make_plan(
+    shape: WorkloadShape,
+    *,
+    devices: Optional[Sequence[Any]] = None,
+    n_devices: Optional[int] = None,
+    chunk_size: int = 64,
+    buckets: Sequence[int] = (8, 32, 128),
+    time_parallel="auto",
+    platform: Optional[str] = None,
+    layout: str = "auto",
+) -> Plan:
+    """Jointly choose mesh axes, chunk size, serve bucket ladder, and
+    the time-parallel kernel branch for ``shape`` on the given topology.
+
+    ``devices``: explicit device list (the mesh is built over a prefix
+    of it); ``n_devices``: decide for a topology size without touching
+    real devices (golden tests) — default is every visible device.
+    ``layout``: ``"auto"`` (the joint decision), ``"series"`` (force the
+    naive all-devices-on-series single-axis layout — the pre-planner
+    behavior, kept for `bench.py --plan-sweep` comparisons), or
+    ``"single"`` (pin to one device). The returned plan is recorded in
+    the manifest plane (:meth:`Plan.note`).
+    """
+    if devices is not None:
+        D = len(devices)
+    elif n_devices is not None:
+        D = int(n_devices)
+    else:
+        import jax
+
+        D = len(jax.devices())
+    if platform is None:
+        from hhmm_tpu.kernels.dispatch import _platform
+
+        platform = _platform()
+    if layout not in ("auto", "series", "single"):
+        raise ValueError(f"layout must be auto/series/single, got {layout!r}")
+
+    axes, parts = _decide(shape, D, layout)
+    series_ways = dict(axes).get("series", 1)
+    chunk_req = max(1, min(int(chunk_size), int(shape.B)))
+    chunk = _round_up(chunk_req, series_ways)
+    if chunk != chunk_req:
+        parts.append(
+            f"chunk {chunk_req} -> {chunk} (rounded up to series ways "
+            f"{series_ways}; ragged tail pads by lane repeat, weight 0)"
+        )
+    ladder, shard_min = _bucket_ladder(buckets, series_ways)
+    sp_ways = dict(axes).get("sp", 1)
+    branch = _resolve_branch(shape, sp_ways, time_parallel, platform)
+    parts.append(f"branch={branch}")
+    plan = Plan(
+        shape=shape,
+        platform=platform,
+        n_devices=D,
+        axes=axes,
+        chunk=chunk,
+        chunk_requested=chunk_req,
+        buckets=ladder,
+        shard_min_bucket=shard_min,
+        branch=branch,
+        reason="; ".join(parts),
+        _devices=tuple(devices) if devices is not None else None,
+    )
+    return plan.note()
+
+
+def plan_for_mesh(
+    mesh,
+    shape: WorkloadShape,
+    *,
+    chunk_size: int = 64,
+    buckets: Sequence[int] = (8, 32, 128),
+    time_parallel="auto",
+    platform: Optional[str] = None,
+) -> Plan:
+    """Wrap a caller-supplied ``jax.sharding.Mesh`` (the legacy
+    `batch/fit.py` ``mesh=`` argument) in a :class:`Plan`, keeping the
+    mesh exactly as given but applying the planner's chunk auto-rounding
+    (replacing the old ``chunk % series`` hard error) and branch
+    resolution. The mesh must carry a ``"series"`` axis."""
+    mesh_shape = dict(mesh.shape)
+    if "series" not in mesh_shape:
+        raise ValueError(
+            f"fit meshes must have a 'series' axis, got {tuple(mesh_shape)}"
+        )
+    if platform is None:
+        from hhmm_tpu.kernels.dispatch import _platform
+
+        platform = _platform()
+    axes = tuple((n, int(w)) for n, w in mesh_shape.items())
+    series_ways = mesh_shape["series"]
+    chunk_req = max(1, min(int(chunk_size), int(shape.B)))
+    chunk = _round_up(chunk_req, series_ways)
+    ladder, shard_min = _bucket_ladder(buckets, series_ways)
+    branch = _resolve_branch(
+        shape, dict(mesh_shape).get("sp", 1), time_parallel, platform
+    )
+    parts = [f"caller-supplied mesh {mesh_shape}"]
+    if chunk != chunk_req:
+        parts.append(
+            f"chunk {chunk_req} -> {chunk} (rounded up to series ways {series_ways})"
+        )
+    parts.append(f"branch={branch}")
+    plan = Plan(
+        shape=shape,
+        platform=platform,
+        n_devices=int(mesh.devices.size),
+        axes=axes,
+        chunk=chunk,
+        chunk_requested=chunk_req,
+        buckets=ladder,
+        shard_min_bucket=shard_min,
+        branch=branch,
+        reason="; ".join(parts),
+        _mesh=mesh,
+    )
+    return plan.note()
+
+
+def force_host_platform_devices(n_devices: int) -> None:
+    """Force the CPU backend with ``n_devices`` virtual host devices —
+    the synthetic-topology substrate for `bench.py --plan-sweep`,
+    `__graft_entry__.dryrun_multichip`, and the `tests/test_plan.py`
+    parity suite. Must run BEFORE any JAX backend initializes (raises
+    loudly otherwise); handles the pinned-JAX fallback where
+    ``jax_num_cpu_devices`` predates the config option via XLA_FLAGS.
+    """
+    import jax
+
+    _initialized = getattr(
+        getattr(jax._src, "xla_bridge", None), "backends_are_initialized", None
+    )
+    if _initialized is not None and _initialized():  # pragma: no cover
+        raise RuntimeError(
+            "force_host_platform_devices must run in a fresh process: a JAX "
+            "backend is already initialized, so the platform can no longer "
+            "be forced"
+        )
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", int(n_devices))
+    except AttributeError:
+        # pinned JAX predates jax_num_cpu_devices: the XLA flag is the
+        # version-stable spelling (read at first backend init, which the
+        # guard above proved has not happened yet). A pre-existing flag
+        # with a smaller count would silently win, so replace it.
+        flags = os.environ.get("XLA_FLAGS", "")
+        want = f"--xla_force_host_platform_device_count={int(n_devices)}"
+        if "xla_force_host_platform_device_count" in flags:
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", want, flags
+            )
+        else:
+            flags = (flags + " " + want).strip()
+        os.environ["XLA_FLAGS"] = flags

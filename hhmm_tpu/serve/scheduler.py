@@ -80,14 +80,29 @@ class MicroBatchScheduler:
     def __init__(
         self,
         model,
-        buckets: Sequence[int] = (8, 32, 128),
+        buckets: Optional[Sequence[int]] = None,
         registry: Optional[SnapshotRegistry] = None,
         metrics: Optional[ServeMetrics] = None,
         history_pad: int = 64,
+        plan=None,
     ):
+        """``plan``: an optional :class:`hhmm_tpu.plan.Plan` — the
+        topology-aware placement decision (`docs/sharding.md`). When
+        given, the bucket ladder defaults to the planner-chosen one
+        (each bucket a multiple of the mesh series ways) and flushes of
+        at least ``plan.shard_min_bucket`` lanes dispatch with their
+        batch axis sharded over the plan's series mesh axis
+        (``plan.place``). Whether a bucket shards is a pure function of
+        its size, so the compile count stays flat after warmup exactly
+        as in the unsharded path."""
+        if buckets is None:
+            buckets = plan.buckets if plan is not None else (8, 32, 128)
         if not buckets or any(b <= 0 for b in buckets):
             raise ValueError(f"buckets must be positive, got {buckets}")
         self.model = model
+        self.plan = plan
+        if plan is not None:
+            plan.note()  # record the serving layout in run manifests
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         self.registry = registry
         self.metrics = metrics if metrics is not None else ServeMetrics()
@@ -376,8 +391,14 @@ class MicroBatchScheduler:
                 mask = m
             data_b["mask"] = jnp.asarray(mask)
             draws_b = jnp.stack([d for _, d, _, _ in lanes])
+            # the replay dispatch shards exactly like a tick flush of
+            # the same bucket size (one placement rule everywhere)
+            sharded = self.plan is not None and self.plan.shard_bucket(bn)
+            if sharded:
+                data_b = {k: self.plan.place(v) for k, v in data_b.items()}
+                draws_b = self.plan.place(draws_b)
             with span("serve.replay") as sp:
-                sp.annotate(bucket=bn, T_pad=T_pad)
+                sp.annotate(bucket=bn, T_pad=T_pad, sharded=sharded)
                 alpha, ll, okd = jax.block_until_ready(
                     self._replay_j(draws_b, data_b)
                 )
@@ -544,20 +565,32 @@ class MicroBatchScheduler:
         # [bucket, D, dim] array per lane membership so the per-tick hot
         # path ships only the arrays that actually change (alpha/ll/ok)
         lane_key = tuple(s for s, _, _ in lanes)
+        # planner-chosen sharded flush: big buckets commit their batch
+        # axis onto the plan's series mesh axis before dispatch; whether
+        # a bucket shards depends only on its size, so the jit signature
+        # per bucket is stable (compile count stays flat after warmup)
+        sharded = self.plan is not None and self.plan.shard_bucket(bn)
+        place = self.plan.place if sharded else (lambda a: a)
+        if sharded:
+            obs_b = {k: place(v) for k, v in obs_b.items()}
         draws_b = self._draws_cache.get(lane_key)
         if draws_b is None:
             if len(self._draws_cache) >= 64:  # bound churny memberships
                 self._draws_cache.clear()
-            draws_b = jnp.stack([self._series[s]["draws"] for s in lane_key])
+            draws_b = place(
+                jnp.stack([self._series[s]["draws"] for s in lane_key])
+            )
             self._draws_cache[lane_key] = draws_b
         with span(f"serve.dispatch.{kernel}") as sp:
-            sp.annotate(bucket=bn)
+            sp.annotate(bucket=bn, sharded=sharded)
             if kernel == "init":
                 out = self._init_j(draws_b, obs_b)
             else:
-                alpha_b = jnp.stack([self._series[s]["alpha"] for s, _, _ in lanes])
-                ll_b = jnp.stack([self._series[s]["ll"] for s, _, _ in lanes])
-                ok_b = jnp.stack([self._series[s]["ok"] for s, _, _ in lanes])
+                alpha_b = place(
+                    jnp.stack([self._series[s]["alpha"] for s, _, _ in lanes])
+                )
+                ll_b = place(jnp.stack([self._series[s]["ll"] for s, _, _ in lanes]))
+                ok_b = place(jnp.stack([self._series[s]["ok"] for s, _, _ in lanes]))
                 out = self._update_j(draws_b, alpha_b, ll_b, ok_b, obs_b)
             alpha, ll, okd, probs, mean_ll = jax.block_until_ready(out)
         self._obs_dtypes.update(dtype_locks)  # dispatch succeeded

@@ -55,6 +55,18 @@ depend on:
    module-level count dicts, and any call to a bare
    ``counter``/``gauge``/``histogram`` name must be bound from the
    metrics module, not a local shadow.
+7. **One placement substrate** (`hhmm_tpu/plan/`, `docs/sharding.md`):
+   no ``Mesh`` / ``NamedSharding`` / ``PartitionSpec`` construction
+   anywhere outside ``hhmm_tpu/plan/`` and the ``core/compat.py``
+   shims — covering the package, ``bench.py`` / ``bench_zoo.py``,
+   ``__graft_entry__.py``, and ``scripts/``. Before the planner,
+   `batch/fit.py` and `serve/scheduler.py` each hand-rolled their own
+   layout; a new callsite constructing placement objects directly
+   would re-fragment the decision the planner exists to centralize
+   (and its layout would be invisible to the manifest ``plan``
+   stanza). Consumers take a ``Plan`` (or a caller mesh wrapped via
+   ``plan_for_mesh``); kernel shard_map bodies describe specs through
+   ``core.compat.pspec``.
 
 Exit 0 when clean, 1 with one line per violation. Run by
 ``tests/test_robust.py`` (and re-asserted by ``tests/test_serve.py``,
@@ -116,6 +128,13 @@ TELEMETRY_HOOKS = ("register_jit",)
 METRICS_MODULES = ("hhmm_tpu.obs.metrics", "hhmm_tpu.obs")
 METRIC_FNS = ("counter", "gauge", "histogram")
 AD_HOC_COUNT_RE = re.compile(r"(^|_)(counts?|counters?)$")
+
+# invariant 7: placement-object constructors confined to the planner
+# (and the core/compat.py shims) — any other construction site is a
+# placement decision the planner cannot see or record
+SHARDING_CTORS = ("Mesh", "NamedSharding", "PartitionSpec")
+PLACEMENT_ALLOWED_PREFIXES = ("hhmm_tpu/plan/",)
+PLACEMENT_ALLOWED_FILES = ("hhmm_tpu/core/compat.py",)
 
 
 def _bare_excepts(tree: ast.Module, rel: str, problems: List[str]) -> None:
@@ -321,6 +340,41 @@ def _check_metrics_discipline(
                 )
 
 
+def _check_placement_confinement(
+    tree: ast.Module, rel: str, problems: List[str]
+) -> None:
+    """Invariant 7: flag every ``Mesh``/``NamedSharding``/
+    ``PartitionSpec`` constructor call outside the allowed modules —
+    both the bare-name spelling (``from jax.sharding import
+    PartitionSpec as P; P(...)``) and the attribute spelling
+    (``jax.sharding.Mesh(...)``)."""
+    rel_n = rel.replace("\\", "/")
+    if rel_n.startswith(PLACEMENT_ALLOWED_PREFIXES) or rel_n in PLACEMENT_ALLOWED_FILES:
+        return
+    aliases = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax.sharding":
+            for alias in node.names:
+                if alias.name in SHARDING_CTORS:
+                    aliases[alias.asname or alias.name] = alias.name
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        ctor = None
+        if isinstance(fn, ast.Name) and fn.id in aliases:
+            ctor = aliases[fn.id]
+        elif isinstance(fn, ast.Attribute) and fn.attr in SHARDING_CTORS:
+            ctor = fn.attr
+        if ctor is not None:
+            problems.append(
+                f"{rel}:{node.lineno}: constructs `{ctor}` outside "
+                "hhmm_tpu/plan/ — placement decisions belong to the "
+                "execution planner (take a Plan / plan_for_mesh, or the "
+                "core/compat.py pspec shim); see docs/sharding.md"
+            )
+
+
 def check(root: pathlib.Path) -> List[str]:
     problems: List[str] = []
     pkg = root / "hhmm_tpu"
@@ -336,6 +390,8 @@ def check(root: pathlib.Path) -> List[str]:
         _check_raw_time(tree, rel, problems)
         # invariant 6: one shared metrics plane, package-wide
         _check_metrics_discipline(tree, rel, problems)
+        # invariant 7: placement objects only from the planner
+        _check_placement_confinement(tree, rel, problems)
         # invariant 5b over the serving layer: every module with a
         # jax.jit entry point registers it with the telemetry registry
         if py.parent == serve_dir:
@@ -347,14 +403,26 @@ def check(root: pathlib.Path) -> List[str]:
             _check_raw_time(btree, bench_name, problems)
             _check_telemetry_registration(btree, bench_name, problems)
             _check_metrics_discipline(btree, bench_name, problems)
+            _check_placement_confinement(btree, bench_name, problems)
+    # __graft_entry__ hand-rolled the dryrun meshes before the planner;
+    # invariant 7 keeps it a thin driver (5b does not apply: its jits
+    # are one-shot dry-run probes, not serving entry points)
+    graft = root / "__graft_entry__.py"
+    if graft.is_file():
+        gtree = ast.parse(graft.read_text(), filename=str(graft))
+        _check_raw_time(gtree, "__graft_entry__.py", problems)
+        _check_placement_confinement(gtree, "__graft_entry__.py", problems)
     # invariant 5a over scripts/: the tpu_*_probe timings feed the
     # measured crossover table kernels/dispatch.py dispatches on — a
     # wall-clock step there corrupts dispatch decisions silently
+    # (invariant 7 rides along: a probe constructing its own mesh would
+    # measure a layout the planner never dispatches)
     scripts_dir = root / "scripts"
     if scripts_dir.is_dir():
         for py in sorted(scripts_dir.glob("*.py")):
             stree = ast.parse(py.read_text(), filename=str(py))
             _check_raw_time(stree, f"scripts/{py.name}", problems)
+            _check_placement_confinement(stree, f"scripts/{py.name}", problems)
 
     def check_guarded(spec, source_modules, kind, noun, what):
         for rel, guard_fns in sorted(spec.items()):
@@ -452,7 +520,8 @@ def main(argv: List[str]) -> int:
         "check_guards: ok (no bare excepts; all samplers guarded; "
         "online serve step guarded; semiring combines guarded; "
         "monotonic clocks only; serve/bench jits telemetry-registered; "
-        "one shared metrics plane)"
+        "one shared metrics plane; placement objects confined to the "
+        "planner)"
     )
     return 0
 
