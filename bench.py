@@ -157,6 +157,10 @@ WORKLOAD_FLAGS = (
     "plan_sweep",
     "plan_topologies",
     "serve",
+    "serve_storm",
+    "storm_registered",
+    "storm_resident",
+    "storm_rounds",
     "ticks",
     "serve_draws",
     "quick",
@@ -418,6 +422,273 @@ def serve_bench(args, backend, degraded) -> None:
             "after warmup (bucketed dispatch must be compile-stable)",
             file=sys.stderr,
         )
+        sys.exit(1)
+
+
+def serve_storm(args, backend, degraded) -> None:
+    """``--serve-storm``: open-loop overload generator for the serving
+    hardening layer (ROADMAP item 4; docs/serving.md "Overload &
+    failure modes").
+
+    Scenario: ``--storm-registered`` snapshots (default 1000) in a
+    `SnapshotRegistry`, a `SnapshotPager` byte budget sized for
+    ``--storm-resident`` of them (default 256), an `AdmissionPolicy`
+    deliberately smaller than the offered load, and a
+    `robust.faults.TrafficFaultPlan` active for the whole measured
+    window: burst-load spikes, slow-snapshot-load latency, torn
+    registry files at load, and a mid-replay simulated device loss. A
+    rotating hot window drives ticks past the admission limits so
+    shedding AND paging must engage.
+
+    Exit is nonzero when the survival claims fail: any injected fault
+    escapes ``submit``/``flush`` as an exception, shedding or paging
+    never engaged (the overload machinery was not exercised), peak
+    resident snapshot bytes exceeded the budget, or any XLA compile
+    landed after warmup. The SLO verdict (`serve/metrics.py`) is
+    embedded in the record's manifest stanza exactly like the
+    ``--serve`` bench, so `scripts/bench_diff.py` gates attained→unmet
+    transitions; a ``storm`` stanza (faults escaped / injected) rides
+    along for the resilience gate."""
+    import tempfile
+
+    from __graft_entry__ import _tayal_batch
+    from hhmm_tpu.models import TayalHHMM
+    from hhmm_tpu.robust import faults
+    from hhmm_tpu.serve import (
+        AdmissionPolicy,
+        MicroBatchScheduler,
+        PosteriorSnapshot,
+        ServeMetrics,
+        SLOSpec,
+        SnapshotPager,
+        SnapshotRegistry,
+        evaluate_slo,
+        model_spec,
+    )
+
+    n_reg = args.storm_registered
+    n_resident = args.storm_resident
+    rounds = min(args.storm_rounds, 16) if args.quick else args.storm_rounds
+    draws = 4 if args.quick else min(args.serve_draws, 16)
+    model = TayalHHMM(gate_mode="hard")
+    spec = model_spec(model)
+    names = [f"t{i:05d}" for i in range(n_reg)]
+
+    # registry of synthetic posteriors: the storm exercises overload
+    # machinery, not sampler quality — small jittered draw banks through
+    # the real snapshot/registry/pager path
+    reg_root = tempfile.mkdtemp(prefix="serve_storm_registry_")
+    import atexit
+    import shutil
+
+    atexit.register(shutil.rmtree, reg_root, ignore_errors=True)
+    registry = SnapshotRegistry(reg_root)
+    rng = np.random.default_rng(42)
+    t0 = perf_counter()
+    for name in names:
+        registry.save(
+            name,
+            PosteriorSnapshot(
+                spec=spec,
+                draws=(rng.normal(size=(draws, model.n_free)) * 0.3).astype(
+                    np.float32
+                ),
+            ),
+        )
+    register_s = perf_counter() - t0
+
+    snap_bytes = draws * model.n_free * 4
+    budget = n_resident * snap_bytes
+    pager = SnapshotPager(registry, budget_bytes=budget)
+    metrics = ServeMetrics()
+    window = min(192, max(8, (3 * n_resident) // 4))
+    policy = AdmissionPolicy(
+        max_queue_depth=max(256, window + window // 3),
+        max_pending_per_series=2,
+        max_ticks_per_flush=512,
+    )
+    sched = MicroBatchScheduler(
+        model,
+        buckets=(8, 64, 256),
+        registry=registry,
+        metrics=metrics,
+        admission=policy,
+        pager=pager,
+    )
+
+    # tick observations from a shared Tayal pool (series i reads pool
+    # row i mod P)
+    P, T_pool = 64, 256
+    x, sign = _tayal_batch(P, T_pool, seed=7)
+    x_np, s_np = np.asarray(x), np.asarray(sign)
+
+    def obs_for(i: int, t: int):
+        return {
+            "x": int(x_np[i % P, t % T_pool]),
+            "sign": int(s_np[i % P, t % T_pool]),
+        }
+
+    escaped = 0
+
+    def drive_round(r: int, mult: int, stride: int = 64) -> None:
+        nonlocal escaped
+        start = (r * stride) % n_reg
+        idx = [(start + k) % n_reg for k in range(window)]
+        try:
+            for j in range(mult):  # round-robin: waves stay batched
+                for i in idx:
+                    sched.submit(names[i], obs_for(i, r * mult + j))
+            sched.flush()
+        except Exception as e:  # an injected fault ESCAPED the serve layer
+            escaped += 1
+            print(
+                f"# serve-storm: ESCAPED exception in round {r}: "
+                f"{type(e).__name__}: {e}",
+                file=sys.stderr,
+                flush=True,
+            )
+
+    # ---- warmup (no faults): land every bucket shape's init + update
+    # compile before the measured window
+    t0 = perf_counter()
+    for r, mult in ((0, 1), (0, 1)):  # init@window-bucket, update@...
+        drive_round(r, mult)
+    for fresh_n in (64, 8):  # small fresh batches warm the low buckets
+        base = window + (0 if fresh_n == 64 else 64)
+        for _ in range(2):  # first pass init, second update
+            try:
+                for k in range(fresh_n):
+                    i = (base + k) % n_reg
+                    sched.submit(names[i], obs_for(i, 0))
+                sched.flush()
+            except Exception as e:
+                escaped += 1
+                print(f"# serve-storm: warmup escape: {e}", file=sys.stderr)
+    warmup_s = perf_counter() - t0
+    compiles_warm = metrics.compile_count
+    metrics.reset_throughput_window()
+
+    # ---- the storm: every traffic fault active for the whole window
+    plan = faults.TrafficFaultPlan(
+        burst_factor=4,
+        burst_every=5,
+        slow_load_s=0.005 if args.quick else 0.02,
+        slow_load_every=7,
+        tear_load_every=41,
+        device_loss_at_dispatch=max(2, rounds),  # lands mid-replay
+        device_loss_count=2,
+    )
+    t0 = perf_counter()
+    with faults.inject(plan):
+        for r in range(1, rounds + 1):
+            drive_round(r, plan.burst_multiplier(r))
+    storm_s = perf_counter() - t0
+    compiles_after_warmup = metrics.compile_count - compiles_warm
+
+    summary = metrics.summary()
+    pstats = pager.stats()
+    slo = evaluate_slo(
+        SLOSpec(
+            p99_latency_ms=args.storm_slo_p99_ms,
+            max_staleness_s=args.slo_staleness_s,
+            max_post_warmup_recompiles=args.slo_recompiles,
+        ),
+        p99_latency_ms=summary["latency_p99_ms"],
+        staleness_s=metrics.peak_staleness_seconds(),
+        post_warmup_recompiles=compiles_after_warmup,
+    )
+
+    # ---- survival gates ----
+    failures = []
+    if escaped:
+        failures.append(f"{escaped} injected fault(s) escaped as exceptions")
+    if summary["shed_ticks"] == 0:
+        failures.append("shedding never engaged (shed_ticks == 0)")
+    if pstats["evictions"] == 0 or pstats["reloads"] == 0:
+        failures.append(
+            "paging never engaged (evictions="
+            f"{pstats['evictions']}, reloads={pstats['reloads']})"
+        )
+    if pstats["peak_resident_bytes"] > budget:
+        failures.append(
+            f"resident bytes peaked at {pstats['peak_resident_bytes']} "
+            f"over the {budget}-byte budget"
+        )
+    if compiles_after_warmup != 0:
+        failures.append(
+            f"{compiles_after_warmup} XLA compiles after warmup "
+            "(bucketed dispatch must stay compile-stable under overload)"
+        )
+    if summary["device_loss_events"] == 0:
+        failures.append("device-loss fault was never absorbed (not injected?)")
+
+    storm_stanza = {
+        "faults_escaped": escaped,
+        "faults_injected": {
+            "burst": {"factor": plan.burst_factor, "every": plan.burst_every},
+            "slow_load": {"s": plan.slow_load_s, "every": plan.slow_load_every},
+            "tear_load_every": plan.tear_load_every,
+            "device_loss_at_dispatch": plan.device_loss_at_dispatch,
+        },
+        "gates_failed": failures,
+    }
+    record = stamp_record(
+        {
+            "metric": "tayal_serve_storm_throughput",
+            "value": round(summary["ticks"] / storm_s, 1) if storm_s > 0 else None,
+            "unit": "ticks/sec",
+            "registered": n_reg,
+            "resident_budget_series": n_resident,
+            "budget_bytes": budget,
+            "rounds": rounds,
+            "window": window,
+            "register_s": round(register_s, 3),
+            "warmup_s": round(warmup_s, 3),
+            "storm_s": round(storm_s, 3),
+            **{
+                k: summary[k]
+                for k in (
+                    "ticks",
+                    "ticks_per_sec",
+                    "latency_p50_ms",
+                    "latency_p99_ms",
+                    "shed_ticks",
+                    "rejected_attaches",
+                    "dispatch_errors",
+                    "device_loss_events",
+                    "degraded_responses",
+                    "compile_count",
+                )
+            },
+            "pager": pstats,
+            "compiles_after_warmup": compiles_after_warmup,
+            "faults_escaped": escaped,
+            "slo_attained": slo["attained"],
+            "backend": backend["backend"],
+            "backend_fallback": backend["fallback"],
+            "degraded_cpu_smoke": degraded,
+        },
+        args,
+        model=model,
+    )
+    record["manifest"]["slo"] = slo
+    record["manifest"]["storm"] = storm_stanza
+    print(json.dumps(record))
+    print(
+        "# serve-storm "
+        + ("SURVIVED" if not failures else "FAILED")
+        + f": shed={summary['shed_ticks']} evictions={pstats['evictions']} "
+        f"reloads={pstats['reloads']} resident_peak="
+        f"{pstats['peak_resident_bytes']}/{budget}B "
+        f"device_loss={summary['device_loss_events']} escaped={escaped} "
+        f"compiles_after_warmup={compiles_after_warmup} "
+        + ("SLO ATTAINED" if slo["attained"] else "SLO UNMET"),
+        file=sys.stderr,
+    )
+    emit_manifest(args, "serve_storm", record, model=model)
+    if failures:
+        for f in failures:
+            print(f"# serve-storm FAILED: {f}", file=sys.stderr)
         sys.exit(1)
 
 
@@ -803,6 +1074,50 @@ def main() -> None:
         "docs/serving.md)",
     )
     ap.add_argument(
+        "--serve-storm",
+        action="store_true",
+        help="run the overload/failure survival bench instead of the fit "
+        "bench: --storm-registered snapshots, a pager byte budget sized "
+        "for --storm-resident of them, admission limits deliberately "
+        "below the offered load, and traffic-shaped faults (burst load, "
+        "slow snapshot loads, torn registry files, mid-replay device "
+        "loss) active for the whole measured window; exits nonzero if "
+        "any injected fault escapes as an exception, shedding/paging "
+        "never engage, resident bytes exceed the budget, or any XLA "
+        "compile lands after warmup (see docs/serving.md)",
+    )
+    ap.add_argument(
+        "--storm-registered",
+        type=int,
+        default=1000,
+        help="serve-storm: snapshots registered (the fleet size)",
+    )
+    ap.add_argument(
+        "--storm-resident",
+        type=int,
+        default=256,
+        help="serve-storm: snapshots the pager byte budget is sized for "
+        "(resident set << registered set forces paging)",
+    )
+    ap.add_argument(
+        "--storm-rounds",
+        type=int,
+        default=120,
+        help="serve-storm: load-generator rounds in the measured window "
+        "(capped at 16 with --quick)",
+    )
+    ap.add_argument(
+        "--storm-slo-p99-ms",
+        type=float,
+        default=5000.0,
+        help="serve-storm SLO: max p99 QUEUE-INCLUSIVE tick latency (ms) "
+        "under deliberate overload — a storm tick waits out its whole "
+        "arrival round plus shedding, so this bound is necessarily "
+        "looser than the steady-state --slo-p99-ms; like the other SLO "
+        "knobs it is a gate definition, excluded from the workload "
+        "digest",
+    )
+    ap.add_argument(
         "--ticks",
         type=int,
         default=256,
@@ -941,6 +1256,10 @@ def main() -> None:
 
     if args.serve:
         serve_bench(args, backend, degraded)
+        return
+
+    if args.serve_storm:
+        serve_storm(args, backend, degraded)
         return
 
     from __graft_entry__ import _tayal_batch

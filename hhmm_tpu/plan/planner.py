@@ -138,6 +138,27 @@ class Plan:
     def mesh_shape(self) -> Optional[Dict[str, int]]:
         return dict(self.axes) if self.axes else None
 
+    def admission_caps(
+        self,
+        *,
+        depth_factor: int = 8,
+        flush_factor: int = 4,
+        per_series: int = 2,
+    ) -> Dict[str, int]:
+        """Shed-aware admission caps derived from the planner-owned
+        serve bucket ladder (the scheduler's
+        ``AdmissionPolicy.from_plan`` consumes this — serve owns the
+        policy type, the planner owns the numbers): queue depth and
+        per-flush dispatch budget are multiples of the largest bucket,
+        so a capacity-bounded flush always drains in already-compiled
+        bucket shapes and shedding never forces a novel jit signature."""
+        top = int(self.buckets[-1])
+        return {
+            "max_queue_depth": max(1, int(depth_factor)) * top,
+            "max_ticks_per_flush": max(1, int(flush_factor)) * top,
+            "max_pending_per_series": max(1, int(per_series)),
+        }
+
     # ---- placement objects (the ONLY construction site outside
     # core/compat.py — check_guards invariant 7) ----
 

@@ -7,7 +7,13 @@ applies the retry policy per dispatch chunk. See `docs/robustness.md`.
 """
 
 from hhmm_tpu.robust.guards import all_finite, finite_mask, guard_update, guard_where
-from hhmm_tpu.robust.faults import FaultPlan, SimulatedCrash, inject
+from hhmm_tpu.robust.faults import (
+    FaultPlan,
+    SimulatedCrash,
+    SimulatedDeviceLoss,
+    TrafficFaultPlan,
+    inject,
+)
 from hhmm_tpu.robust.retry import RetryPolicy, ensure_backend, escalate, rejitter
 
 __all__ = [
@@ -17,6 +23,8 @@ __all__ = [
     "guard_where",
     "FaultPlan",
     "SimulatedCrash",
+    "SimulatedDeviceLoss",
+    "TrafficFaultPlan",
     "inject",
     "RetryPolicy",
     "ensure_backend",

@@ -21,15 +21,34 @@ Design constraints:
   ``fit_batched`` between the XLA execution and the retry logic) and
   ``crash_after_chunks`` instead.
 
+- **Plans are thread-scoped.** The active-plan stack is thread-local
+  (the `kernels/dispatch.py` plan-scope discipline): a fault plan
+  injected in one thread — a test, a storm bench arm — can never leak
+  into another thread's fit or serve path. A serving host running fits
+  on a worker thread while the scheduler ticks on another must never
+  see a cross-thread injection.
+
 Usage::
 
     with faults.inject(faults.FaultPlan(kind="nan_grad", step=40, chain=1)):
         qs, stats = sample_nuts(...)
     assert not stats["chain_healthy"][1]
+
+Traffic-shaped faults (`TrafficFaultPlan`) target the serving layer the
+way chain faults target the samplers: burst-load spikes for the load
+generator (`bench.py --serve-storm`), slow-snapshot-load latency and
+torn-registry-file corruption injected at the `serve/pager.py` load
+path (:func:`snapshot_load_fault`), and mid-replay simulated device
+loss raised inside the scheduler's dispatch (:func:`dispatch_fault`) —
+which the flush path must *degrade*, never propagate
+(`scripts/check_guards.py` invariant 8).
 """
 
 from __future__ import annotations
 
+import os
+import threading
+import time
 from contextlib import contextmanager
 from dataclasses import dataclass
 from typing import Dict, Optional, Tuple
@@ -40,15 +59,20 @@ import jax.numpy as jnp
 
 __all__ = [
     "FaultPlan",
+    "TrafficFaultPlan",
     "SimulatedCrash",
+    "SimulatedDeviceLoss",
     "inject",
     "active",
+    "traffic_active",
     "chain_fault_arrays",
     "batch_fault_arrays",
     "corrupt",
     "corrupt_tree",
     "note_chunk_complete",
     "corrupt_chunk_result",
+    "snapshot_load_fault",
+    "dispatch_fault",
     "tear_file",
 ]
 
@@ -70,6 +94,15 @@ class SimulatedCrash(RuntimeError):
     """Raised by :func:`note_chunk_complete` to simulate a process dying
     between dispatch chunks (TPU preemption / watchdog kill). Completed
     chunks are already cached, so a rerun resumes from the cache."""
+
+
+class SimulatedDeviceLoss(RuntimeError):
+    """Raised by :func:`dispatch_fault` to simulate the accelerator
+    vanishing mid-replay (preempted TPU slice, dead PCIe link). The
+    serving flush path must catch it and degrade the affected ticks
+    into shed responses — a device loss escaping ``flush()`` as an
+    exception is exactly the failure mode ``bench.py --serve-storm``
+    exits nonzero on."""
 
 
 @dataclass(frozen=True)
@@ -106,25 +139,108 @@ class FaultPlan:
             raise ValueError(f"unknown fault kind {self.kind!r}")
 
 
-_ACTIVE: list = []  # stack of FaultPlan
-_CHUNKS_DONE: list = []  # parallel stack of completed-chunk counters
+@dataclass(frozen=True)
+class TrafficFaultPlan:
+    """Traffic-shaped serving faults (ROADMAP item 4). Every mechanism
+    is deterministic — counters live on the injection-stack entry, so
+    the Nth load/dispatch under a plan always fires the same fault:
+
+    - ``burst_factor``/``burst_every``: every ``burst_every``-th load
+      round is a burst — the open-loop generator submits
+      ``burst_factor``× the nominal tick volume
+      (:meth:`burst_multiplier`; consulted by the generator, not the
+      scheduler — bursts are *arrivals*, the scheduler only sees them).
+    - ``slow_load_s``/``slow_load_every``: every ``slow_load_every``-th
+      snapshot load through :func:`snapshot_load_fault` sleeps
+      ``slow_load_s`` first (cold storage / contended filesystem). The
+      latency lands inside the page-in path and must surface in the
+      tick-latency SLO, not wedge the flush.
+    - ``tear_load_every``: every ``tear_load_every``-th load first
+      truncates the snapshot file (:func:`tear_file`) — the reader must
+      see a quarantined miss, never an exception or half-parsed draws.
+    - ``device_loss_at_dispatch``/``device_loss_count``: dispatches
+      ``[at, at + count)`` through :func:`dispatch_fault` raise
+      :class:`SimulatedDeviceLoss` (``-1`` = never).
+    """
+
+    burst_factor: int = 1
+    burst_every: int = 0
+    slow_load_s: float = 0.0
+    slow_load_every: int = 0
+    tear_load_every: int = 0
+    device_loss_at_dispatch: int = -1
+    device_loss_count: int = 1
+
+    def burst_multiplier(self, round_idx: int) -> int:
+        """Arrival multiplier for load round ``round_idx`` (0-based):
+        ``burst_factor`` on every ``burst_every``-th round, else 1."""
+        if self.burst_every > 0 and (round_idx + 1) % self.burst_every == 0:
+            return max(1, int(self.burst_factor))
+        return 1
+
+
+class _ActiveEntry:
+    """One injection-stack frame: the plan plus its mutable fault
+    counters (chunk crashes for :class:`FaultPlan`, load/dispatch
+    indices for :class:`TrafficFaultPlan`)."""
+
+    __slots__ = ("plan", "chunks_done", "loads", "dispatches")
+
+    def __init__(self, plan):
+        self.plan = plan
+        self.chunks_done = 0
+        self.loads = 0
+        self.dispatches = 0
+
+
+# THREAD-LOCAL stack of _ActiveEntry (the kernels/dispatch.py plan-scope
+# discipline): a plan injected on one thread is invisible to every other
+# thread's fit/serve path — no cross-thread fault leakage, ever.
+_TLS = threading.local()
+
+
+def _stack() -> list:
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = _TLS.stack = []
+    return stack
 
 
 @contextmanager
-def inject(plan: FaultPlan):
-    """Activate ``plan`` for the duration of the block (re-entrant; the
-    innermost plan wins)."""
-    _ACTIVE.append(plan)
-    _CHUNKS_DONE.append(0)
+def inject(plan):
+    """Activate ``plan`` (a :class:`FaultPlan` or
+    :class:`TrafficFaultPlan`) for the duration of the block on THIS
+    thread (re-entrant; the innermost plan of each type wins)."""
+    if not isinstance(plan, (FaultPlan, TrafficFaultPlan)):
+        raise TypeError(
+            f"inject() takes a FaultPlan or TrafficFaultPlan, got "
+            f"{type(plan).__name__}"
+        )
+    stack = _stack()
+    stack.append(_ActiveEntry(plan))
     try:
         yield plan
     finally:
-        _ACTIVE.pop()
-        _CHUNKS_DONE.pop()
+        stack.pop()
+
+
+def _innermost(cls):
+    for entry in reversed(_stack()):
+        if isinstance(entry.plan, cls):
+            return entry
+    return None
 
 
 def active() -> Optional[FaultPlan]:
-    return _ACTIVE[-1] if _ACTIVE else None
+    """The innermost chain/dispatch fault plan on this thread."""
+    entry = _innermost(FaultPlan)
+    return entry.plan if entry is not None else None
+
+
+def traffic_active() -> Optional[TrafficFaultPlan]:
+    """The innermost traffic-shaped fault plan on this thread."""
+    entry = _innermost(TrafficFaultPlan)
+    return entry.plan if entry is not None else None
 
 
 # ---------------------------------------------------------------- in-scan
@@ -213,13 +329,13 @@ def note_chunk_complete() -> None:
     """Called by ``fit_batched`` after each chunk is computed *and*
     cached; raises :class:`SimulatedCrash` when the active plan's
     ``crash_after_chunks`` budget is exhausted."""
-    plan = active()
-    if plan is None or plan.crash_after_chunks is None:
+    entry = _innermost(FaultPlan)
+    if entry is None or entry.plan.crash_after_chunks is None:
         return
-    _CHUNKS_DONE[-1] += 1
-    if _CHUNKS_DONE[-1] >= plan.crash_after_chunks:
+    entry.chunks_done += 1
+    if entry.chunks_done >= entry.plan.crash_after_chunks:
         raise SimulatedCrash(
-            f"simulated crash after {_CHUNKS_DONE[-1]} completed chunk(s)"
+            f"simulated crash after {entry.chunks_done} completed chunk(s)"
         )
 
 
@@ -246,6 +362,57 @@ def corrupt_chunk_result(qs, stats, chunk_start: int, chunk_len: int, attempt: i
             jnp.asarray(stats["quarantine_step"]).at[s, plan.chain].set(plan.step)
         )
     return qs, stats
+
+
+# -------------------------------------------------------------- traffic
+
+
+def snapshot_load_fault(path: str) -> None:
+    """Serving-side load-path hook (`serve/pager.py` calls this before
+    every registry load): under an active :class:`TrafficFaultPlan`,
+    counts the load and fires the configured torn-file and slow-load
+    faults deterministically. No-op (one thread-local read) when no
+    traffic plan is active — the production path."""
+    entry = _innermost(TrafficFaultPlan)
+    if entry is None:
+        return
+    plan = entry.plan
+    entry.loads += 1
+    if (
+        plan.tear_load_every > 0
+        and entry.loads % plan.tear_load_every == 0
+        and os.path.exists(path)
+    ):
+        tear_file(path)
+    if (
+        plan.slow_load_every > 0
+        and plan.slow_load_s > 0
+        and entry.loads % plan.slow_load_every == 0
+    ):
+        time.sleep(plan.slow_load_s)
+
+
+def dispatch_fault() -> None:
+    """Serving-side dispatch hook (`serve/scheduler.py` calls this at
+    the head of every micro-batch dispatch): under an active
+    :class:`TrafficFaultPlan` with ``device_loss_at_dispatch >= 0``,
+    raises :class:`SimulatedDeviceLoss` for the configured dispatch
+    window. The flush path must degrade the affected ticks, never let
+    the exception propagate (check_guards invariant 8)."""
+    entry = _innermost(TrafficFaultPlan)
+    if entry is None:
+        return
+    plan = entry.plan
+    if plan.device_loss_at_dispatch < 0:
+        return
+    idx = entry.dispatches
+    entry.dispatches += 1
+    lo = plan.device_loss_at_dispatch
+    if lo <= idx < lo + max(1, plan.device_loss_count):
+        raise SimulatedDeviceLoss(
+            f"simulated device loss at dispatch {idx} (window "
+            f"[{lo}, {lo + max(1, plan.device_loss_count)}))"
+        )
 
 
 def tear_file(path: str, keep_bytes: int = 16) -> None:

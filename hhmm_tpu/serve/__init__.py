@@ -7,11 +7,19 @@ The online layer over the offline stack: `batch/fit.py` produces a
 posterior → `snapshot_from_fit` banks it as a servable artifact →
 `MicroBatchScheduler.attach` loads it (optionally warm-started from
 recorded history) → per-tick `submit`/`flush` advances every stream's
-filter in O(K²) with a compile-stable bucketed dispatch. See
-`docs/serving.md`.
+filter in O(K²) with a compile-stable bucketed dispatch. Under heavy
+traffic the overload layer engages: `AdmissionPolicy` bounds the queue
+and sheds (degraded responses, never exceptions), and the
+`SnapshotPager` (`serve/pager.py`) keeps snapshot residency under a
+device-memory byte budget. See `docs/serving.md`.
 """
 
 from hhmm_tpu.serve.metrics import ServeMetrics, SLOSpec, evaluate_slo
+from hhmm_tpu.serve.pager import (
+    SnapshotPager,
+    resolve_budget_bytes,
+    snapshot_nbytes,
+)
 from hhmm_tpu.serve.online import (
     LoglikCUSUM,
     RegimeDetector,
@@ -30,12 +38,20 @@ from hhmm_tpu.serve.registry import (
     model_spec,
     snapshot_from_fit,
 )
-from hhmm_tpu.serve.scheduler import MicroBatchScheduler, TickResponse
+from hhmm_tpu.serve.scheduler import (
+    AdmissionPolicy,
+    MicroBatchScheduler,
+    TickResponse,
+)
 
 __all__ = [
     "ServeMetrics",
     "SLOSpec",
     "evaluate_slo",
+    "SnapshotPager",
+    "resolve_budget_bytes",
+    "snapshot_nbytes",
+    "AdmissionPolicy",
     "LoglikCUSUM",
     "RegimeDetector",
     "StreamState",

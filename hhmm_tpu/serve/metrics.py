@@ -67,6 +67,14 @@ class ServeMetrics:
         self._degraded_responses = obs_metrics.Counter()
         self._degraded_attaches = obs_metrics.Counter()
         self._superseded_responses = obs_metrics.Counter()
+        # overload/failure ladder counters (docs/serving.md "Overload &
+        # failure modes"): every shed tick and rejected attach is a
+        # counted, degraded-not-raised outcome — the storm bench gates
+        # on these actually engaging under synthetic overload
+        self._shed_ticks = obs_metrics.Counter()
+        self._rejected_attaches = obs_metrics.Counter()
+        self._dispatch_errors = obs_metrics.Counter()
+        self._device_loss_events = obs_metrics.Counter()
         # snapshot staleness (ROADMAP item 3): seconds since the oldest
         # serving snapshot was attached, written by the scheduler per
         # flush; the peak is the SLO-facing watermark for the window
@@ -87,6 +95,10 @@ class ServeMetrics:
             ("serve.degraded_responses", self._degraded_responses),
             ("serve.degraded_attaches", self._degraded_attaches),
             ("serve.superseded_responses", self._superseded_responses),
+            ("serve.shed_ticks", self._shed_ticks),
+            ("serve.rejected_attaches", self._rejected_attaches),
+            ("serve.dispatch_errors", self._dispatch_errors),
+            ("serve.device_loss_events", self._device_loss_events),
             ("serve.snapshot_staleness_seconds", self._staleness),
         ):
             obs_metrics.attach(name, inst)
@@ -128,6 +140,22 @@ class ServeMetrics:
     @property
     def superseded_responses(self) -> int:
         return int(self._superseded_responses.get())
+
+    @property
+    def shed_ticks(self) -> int:
+        return int(self._shed_ticks.get())
+
+    @property
+    def rejected_attaches(self) -> int:
+        return int(self._rejected_attaches.get())
+
+    @property
+    def dispatch_errors(self) -> int:
+        return int(self._dispatch_errors.get())
+
+    @property
+    def device_loss_events(self) -> int:
+        return int(self._device_loss_events.get())
 
     # ---- recording ----
 
@@ -177,6 +205,29 @@ class ServeMetrics:
         """A tick() dict collapse dropped an older same-series response
         (latest-wins); the filter state still folded that tick."""
         self._superseded_responses.inc()
+
+    def note_shed_tick(self, n: int = 1) -> None:
+        """``n`` ticks were shed — dropped under admission pressure or
+        degraded by a dispatch failure — each surfaced as a
+        ``shed=True`` :class:`~hhmm_tpu.serve.scheduler.TickResponse`,
+        never an exception."""
+        self._shed_ticks.inc(n)
+
+    def note_rejected_attach(self, n: int = 1) -> None:
+        """``n`` attach items were rejected (admission capacity or
+        per-item validation) without failing the rest of the batch."""
+        self._rejected_attaches.inc(n)
+
+    def note_dispatch_error(self, n_ticks: int = 1) -> None:
+        """One dispatch group failed; its ``n_ticks`` ticks degraded
+        into shed responses."""
+        self._dispatch_errors.inc()
+        self._shed_ticks.inc(n_ticks)
+
+    def note_device_loss(self) -> None:
+        """A dispatch failure classified as device loss (simulated or
+        real UNAVAILABLE) was absorbed by the flush path."""
+        self._device_loss_events.inc()
 
     @property
     def compile_count(self) -> int:
@@ -234,6 +285,10 @@ class ServeMetrics:
             "degraded_responses": self.degraded_responses,
             "degraded_attaches": self.degraded_attaches,
             "superseded_responses": self.superseded_responses,
+            "shed_ticks": self.shed_ticks,
+            "rejected_attaches": self.rejected_attaches,
+            "dispatch_errors": self.dispatch_errors,
+            "device_loss_events": self.device_loss_events,
             "compile_count": int(self.compile_count),
         }
 

@@ -1,0 +1,304 @@
+"""Memory-budgeted snapshot paging: an LRU pager over the
+`serve/registry.py` ``.npz`` store, so thousands of *registered*
+snapshots no longer imply thousands *resident*.
+
+The scheduler's scaling wall before this module: every attached series
+held its full ``[D, dim]`` draw bank resident forever. At the ROADMAP
+item 4 scale (thousands of tickers × users) that is gigabytes of draw
+banks for series that may not tick for hours. The pager makes residency
+a *budgeted cache*:
+
+- **touch** (:meth:`SnapshotPager.touch`) is the only load path: a
+  resident snapshot is a hit (moved to MRU); a cold one is loaded from
+  the registry — through `robust.faults.snapshot_load_fault`, so the
+  storm bench's slow-load and torn-file faults land exactly here — and
+  admitted, evicting cold unpinned entries until the byte budget holds.
+- **pinning**: series with queued ticks are pinned by the scheduler —
+  the pager never evicts a snapshot a pending tick is about to fold
+  against (that eviction would shed the tick for no memory gain).
+- **eviction** fires a listener (the scheduler's
+  ``detach``), releasing the series' device-side draw bank, stream
+  state, and staleness entry in the same motion. Reload is transparent:
+  the next touch pages the snapshot back in and the series re-attaches
+  cold (fresh filter — the ladder's "page" rung trades filter warmth
+  for memory; see docs/serving.md "Overload & failure modes").
+
+Budget signal (:func:`resolve_budget_bytes`): where the backend exposes
+``Device.memory_stats()`` (TPU), the budget is a fraction of the
+smallest device's ``bytes_limit`` read through
+`obs/telemetry.sample_memory` — the same watermark the run manifest
+records; on backends that hide the stats (XLA:CPU) a static fallback
+budget applies. An explicit ``budget_bytes`` always wins (the storm
+bench sizes it to the scenario).
+
+Metrics (always-on product metrics, attached to the shared
+`obs/metrics.py` plane): ``serve.pager_loads`` / ``_reloads`` /
+``_evictions`` / ``_hits`` counters and the ``serve.pager_resident_bytes``
+gauge; :meth:`SnapshotPager.stats` is the host-side read the bench
+embeds in its record.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from hhmm_tpu.obs import metrics as obs_metrics
+from hhmm_tpu.obs import telemetry
+from hhmm_tpu.robust import faults
+from hhmm_tpu.serve.registry import PosteriorSnapshot, SnapshotRegistry
+
+__all__ = ["SnapshotPager", "resolve_budget_bytes", "snapshot_nbytes"]
+
+# static fallback budget where the backend hides memory stats (CPU):
+# generous for tests, small enough that a storm scenario can shrink it
+DEFAULT_FALLBACK_BUDGET = 256 << 20  # 256 MiB
+DEFAULT_BUDGET_FRACTION = 0.25
+
+
+def snapshot_nbytes(snap: PosteriorSnapshot) -> int:
+    """Resident cost of one snapshot: its draw bank. The spec/meta
+    dicts are O(100) bytes and deliberately ignored — the draw bank is
+    what lands on the device per attached series."""
+    return int(np.asarray(snap.draws).nbytes)
+
+
+def resolve_budget_bytes(
+    budget_bytes: Optional[int] = None,
+    *,
+    fraction: float = DEFAULT_BUDGET_FRACTION,
+    fallback_bytes: int = DEFAULT_FALLBACK_BUDGET,
+) -> Tuple[int, str]:
+    """``(budget, source)``: explicit budget if given; else ``fraction``
+    of the smallest device's ``bytes_limit`` from the telemetry memory
+    watermarks; else the static fallback (no device memory stats —
+    XLA:CPU)."""
+    if budget_bytes is not None:
+        if budget_bytes <= 0:
+            raise ValueError(f"budget_bytes must be positive, got {budget_bytes}")
+        return int(budget_bytes), "explicit"
+    stats = telemetry.sample_memory()
+    limits = [rec["bytes_limit"] for rec in stats.values() if "bytes_limit" in rec]
+    if limits:
+        return max(1, int(fraction * min(limits))), (
+            f"{fraction:g} x device bytes_limit watermark"
+        )
+    return int(fallback_bytes), "static fallback (backend hides memory stats)"
+
+
+class SnapshotPager:
+    """See module docstring. Not thread-safe by itself — it lives
+    inside the scheduler's (single-threaded) serving loop, exactly like
+    the scheduler's own tables."""
+
+    def __init__(
+        self,
+        registry: SnapshotRegistry,
+        budget_bytes: Optional[int] = None,
+        *,
+        budget_fraction: float = DEFAULT_BUDGET_FRACTION,
+        fallback_budget_bytes: int = DEFAULT_FALLBACK_BUDGET,
+    ):
+        self.registry = registry
+        self.budget_bytes, self.budget_source = resolve_budget_bytes(
+            budget_bytes,
+            fraction=budget_fraction,
+            fallback_bytes=fallback_budget_bytes,
+        )
+        # name -> (snapshot, nbytes); insertion order IS the LRU order
+        self._resident: "OrderedDict[str, Tuple[PosteriorSnapshot, int]]" = (
+            OrderedDict()
+        )
+        self._pinned: set = set()
+        self._ever_resident: set = set()
+        self._resident_bytes = 0
+        self._peak_resident_bytes = 0
+        self._on_evict: Optional[Callable[[str], None]] = None
+        # always-on product metrics (the ServeMetrics attach discipline)
+        self._loads = obs_metrics.Counter()
+        self._reloads = obs_metrics.Counter()
+        self._evictions = obs_metrics.Counter()
+        self._hits = obs_metrics.Counter()
+        self._misses = obs_metrics.Counter()
+        self._budget_overruns = obs_metrics.Counter()
+        self._resident_gauge = obs_metrics.Gauge()
+        for name, inst in (
+            ("serve.pager_loads", self._loads),
+            ("serve.pager_reloads", self._reloads),
+            ("serve.pager_evictions", self._evictions),
+            ("serve.pager_hits", self._hits),
+            ("serve.pager_misses", self._misses),
+            ("serve.pager_budget_overruns", self._budget_overruns),
+            ("serve.pager_resident_bytes", self._resident_gauge),
+        ):
+            obs_metrics.attach(name, inst)
+
+    # ---- wiring ----
+
+    def set_evict_listener(self, fn: Optional[Callable[[str], None]]) -> None:
+        """Called with each evicted name AFTER it leaves the resident
+        set (so a listener calling back into :meth:`discard` is a
+        no-op, not a recursion). The scheduler installs its ``detach``
+        here."""
+        self._on_evict = fn
+
+    # ---- the load path ----
+
+    def load(self, name: str) -> Optional[PosteriorSnapshot]:
+        """Hit-or-load WITHOUT admitting: the resident snapshot (moved
+        to MRU), else a registry load — faults injected, corrupt files
+        a quarantined miss (``None``). The caller accounts residency
+        with :meth:`admit` once it has actually accepted the snapshot —
+        the scheduler's page-in path validates the attach first, so a
+        rejected attach never leaks unattached residency or evicts an
+        attached series on behalf of a snapshot that will not serve."""
+        entry = self._resident.get(name)
+        if entry is not None:
+            self._resident.move_to_end(name)
+            self._hits.inc()
+            return entry[0]
+        self._misses.inc()
+        # the traffic-fault surface: slow-load latency and torn-file
+        # corruption land here, exactly where cold storage would bite
+        faults.snapshot_load_fault(self.registry.path(name))
+        return self.registry.load(name)
+
+    def touch(self, name: str) -> Optional[PosteriorSnapshot]:
+        """Load-or-hit WITH admission (:meth:`load` + :meth:`admit`):
+        budget enforced after insertion. ``None`` when nothing servable
+        is registered under ``name``."""
+        snap = self.load(name)
+        if snap is not None:
+            self.admit(name, snap)
+        return snap
+
+    def admit(self, name: str, snap: PosteriorSnapshot) -> None:
+        """Account an externally-loaded snapshot as resident (the
+        scheduler's direct ``attach_many`` path) — same LRU/budget
+        discipline as a :meth:`touch` load. A re-admit (re-attach of a
+        fresh fit) REPLACES the resident copy: serving a stale draw
+        bank after a later eviction+touch would silently undo the
+        refit."""
+        entry = self._resident.get(name)
+        if entry is not None and entry[0] is snap:
+            # the page-in path: touch() already loaded and accounted
+            # this very object
+            self._resident.move_to_end(name)
+            return
+        if entry is not None:
+            self._resident.pop(name)
+            self._resident_bytes -= entry[1]
+        self._admit(name, snap)
+
+    def _admit(self, name: str, snap: PosteriorSnapshot) -> None:
+        nbytes = snapshot_nbytes(snap)
+        self._loads.inc()
+        if name in self._ever_resident:
+            self._reloads.inc()
+        self._ever_resident.add(name)
+        self._resident[name] = (snap, nbytes)
+        self._resident_bytes += nbytes
+        self._evict_over_budget(exempt=name)
+        self._note_resident()
+
+    # ---- pinning ----
+
+    def pin(self, name: str) -> None:
+        """Exempt ``name`` from eviction (a pending tick needs it)."""
+        self._pinned.add(name)
+
+    def unpin(self, name: str) -> None:
+        self._pinned.discard(name)
+
+    # ---- eviction ----
+
+    def _evict_over_budget(self, exempt: Optional[str] = None) -> None:
+        """Evict LRU-first unpinned entries until the budget holds. The
+        just-admitted entry is exempt for this pass (it is needed right
+        now); if only pinned/exempt entries remain while still over
+        budget, the overrun is counted and allowed — shedding a tick to
+        save memory is the admission policy's call, not the pager's."""
+        while self._resident_bytes > self.budget_bytes:
+            victim = next(
+                (
+                    n
+                    for n in self._resident  # LRU-first iteration order
+                    if n != exempt and n not in self._pinned
+                ),
+                None,
+            )
+            if victim is None:
+                self._budget_overruns.inc()
+                break
+            self._evict(victim)
+
+    def _evict(self, name: str) -> None:
+        _, nbytes = self._resident.pop(name)
+        self._resident_bytes -= nbytes
+        self._evictions.inc()
+        self._note_resident()
+        if self._on_evict is not None:
+            self._on_evict(name)
+
+    def shrink_to_budget(self) -> None:
+        """Evict unpinned LRU entries until the budget holds — the
+        scheduler calls this at the end of every flush, when the
+        just-drained ticks have unpinned their snapshots. An admission
+        policy whose pending reach exceeds the budget can pin the pager
+        past it transiently (counted in ``budget_overruns``); this is
+        where residency comes back under."""
+        self._evict_over_budget()
+
+    def evict(self, name: str) -> bool:
+        """Explicit eviction (fires the listener). False if not
+        resident."""
+        if name not in self._resident:
+            return False
+        self._evict(name)
+        return True
+
+    def discard(self, name: str) -> None:
+        """Drop residency WITHOUT firing the listener — for the
+        listener itself (detach already in progress)."""
+        entry = self._resident.pop(name, None)
+        if entry is not None:
+            self._resident_bytes -= entry[1]
+            self._note_resident()
+        self._pinned.discard(name)
+
+    # ---- reading ----
+
+    def _note_resident(self) -> None:
+        self._resident_gauge.set(self._resident_bytes)
+        if self._resident_bytes > self._peak_resident_bytes:
+            self._peak_resident_bytes = self._resident_bytes
+
+    def resident_names(self) -> List[str]:
+        """LRU→MRU order."""
+        return list(self._resident)
+
+    def resident_bytes(self) -> int:
+        return self._resident_bytes
+
+    def peak_resident_bytes(self) -> int:
+        """High-watermark of resident bytes — the storm bench's
+        held-under-budget gate reads this."""
+        return self._peak_resident_bytes
+
+    def stats(self) -> Dict[str, int]:
+        """JSON-ready paging counters for bench records."""
+        return {
+            "budget_bytes": int(self.budget_bytes),
+            "budget_source": self.budget_source,
+            "resident": len(self._resident),
+            "resident_bytes": int(self._resident_bytes),
+            "peak_resident_bytes": int(self._peak_resident_bytes),
+            "loads": int(self._loads.get()),
+            "reloads": int(self._reloads.get()),
+            "evictions": int(self._evictions.get()),
+            "hits": int(self._hits.get()),
+            "misses": int(self._misses.get()),
+            "budget_overruns": int(self._budget_overruns.get()),
+        }

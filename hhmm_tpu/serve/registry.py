@@ -198,6 +198,18 @@ class SnapshotRegistry:
             raise ValueError(f"invalid snapshot name: {name!r}")
         return os.path.join(self.root, f"{name}.npz")
 
+    def path(self, name: str) -> str:
+        """On-disk path a snapshot lives (or would live) at — the
+        paging and fault-injection surface (`serve/pager.py` hands it
+        to `robust.faults.snapshot_load_fault`; tests tear it)."""
+        return self._path(name)
+
+    def exists(self, name: str) -> bool:
+        """Whether a servable file is on disk under ``name`` (corrupt
+        quarantines and stranded temps don't count — they have
+        different suffixes)."""
+        return os.path.exists(self._path(name))
+
     def names(self) -> List[str]:
         # temps are "<name>.npz.tmp.<pid>.npz" (a crash can strand one)
         # and quarantined files "<name>.npz.corrupt": neither is a

@@ -31,6 +31,28 @@ Robustness (the `robust/` discipline, applied to serving):
   attached posterior, else the registry's last healthy snapshot, and
   only serves the degraded draws (flagged) when no healthy fallback
   exists.
+
+Overload & failure survival (docs/serving.md "Overload & failure
+modes"): the scheduler runs an **explicit capacity model** instead of
+the historical implicit unboundedness —
+
+- **admission control** (:class:`AdmissionPolicy`): the pending queue
+  is bounded (total depth + per-series quota), the attached-series set
+  is capped, and each flush dispatches at most a fixed tick budget;
+  pressure beyond the caps **sheds** — oldest-first for depth, oldest-
+  of-that-series for quota — and every shed is a counted,
+  ``shed=True``/``degraded=True`` :class:`TickResponse`, never an
+  exception;
+- **degrade-don't-raise hot path** (`scripts/check_guards.py`
+  invariant 8): errors surfacing inside a dispatch (malformed
+  observation values, a simulated or real device loss) degrade that
+  group's ticks into shed responses while the rest of the flush
+  proceeds; ``submit`` for an unknown series sheds (or transparently
+  pages the series in, below) instead of raising;
+- **snapshot paging** (`serve/pager.py`): with a pager attached,
+  snapshot residency is an LRU cache under a byte budget — an evicted
+  series is ``detach``\\ ed (draw bank, stream state, staleness entry
+  all released) and transparently re-attached on its next ``submit``.
 """
 
 from __future__ import annotations
@@ -48,6 +70,7 @@ from hhmm_tpu.batch.pad import pad_ragged
 from hhmm_tpu.core.lmath import safe_log_normalize
 from hhmm_tpu.obs.telemetry import register_jit
 from hhmm_tpu.obs.trace import span, traced
+from hhmm_tpu.robust import faults
 from hhmm_tpu.robust.guards import finite_mask, guard_update
 from hhmm_tpu.serve.metrics import ServeMetrics
 from hhmm_tpu.serve.online import StreamState, filter_scan, stream_init, stream_step
@@ -57,12 +80,15 @@ from hhmm_tpu.serve.registry import (
     model_spec,
 )
 
-__all__ = ["TickResponse", "MicroBatchScheduler"]
+__all__ = ["TickResponse", "AdmissionPolicy", "MicroBatchScheduler"]
 
 
 @dataclass(frozen=True)
 class TickResponse:
-    """One served tick: draw-averaged filtered state + health."""
+    """One served tick: draw-averaged filtered state + health. A
+    ``shed=True`` response means the observation was NOT folded into
+    the filter (admission pressure, dispatch failure, detached series —
+    ``error`` says which): the degraded-not-raised overload outcome."""
 
     series_id: str
     probs: np.ndarray  # [K] posterior-mean filtered state probabilities
@@ -70,6 +96,61 @@ class TickResponse:
     healthy_draws: int
     degraded: bool
     latency_s: float
+    shed: bool = False
+    error: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class AdmissionPolicy:
+    """Explicit serving capacity (ROADMAP item 4): every ``None`` cap
+    is unbounded (the historical behavior). Pressure beyond a cap
+    sheds — counted in ``serve.shed_ticks`` / ``serve.rejected_attaches``
+    and surfaced as ``shed=True`` responses — it never raises.
+
+    - ``max_series``: attached (in-flight) series capacity; attach
+      items beyond it are rejected (counted, batch unaffected).
+    - ``max_queue_depth``: total pending-tick bound; a submit into a
+      full queue sheds the OLDEST pending tick (newest data wins for a
+      filter — the stale tick is the right one to drop).
+    - ``max_pending_per_series``: per-tenant quota (tenant = series);
+      an over-quota submit sheds that series' oldest queued tick.
+    - ``max_ticks_per_flush``: dispatch budget per flush; the remainder
+      stays queued (the queue bound above keeps the backlog finite).
+    """
+
+    max_series: Optional[int] = None
+    max_queue_depth: Optional[int] = None
+    max_pending_per_series: Optional[int] = None
+    max_ticks_per_flush: Optional[int] = None
+
+    def __post_init__(self):
+        for f in (
+            "max_series",
+            "max_queue_depth",
+            "max_pending_per_series",
+            "max_ticks_per_flush",
+        ):
+            v = getattr(self, f)
+            if v is not None and int(v) <= 0:
+                raise ValueError(f"{f} must be positive or None, got {v}")
+
+    @classmethod
+    def from_plan(cls, plan, *, max_series: Optional[int] = None, **kw):
+        """Planner-derived caps: the queue/flush budgets come from the
+        planner-owned bucket ladder (:meth:`hhmm_tpu.plan.Plan.
+        admission_caps`), so a capacity-bounded flush always drains in
+        already-compiled bucket shapes."""
+        return cls(max_series=max_series, **plan.admission_caps(**kw))
+
+
+def _looks_like_device_loss(e: Exception) -> bool:
+    """A dispatch failure that means the accelerator went away
+    (simulated by `robust/faults.py`, or a real XLA UNAVAILABLE) rather
+    than a malformed input."""
+    if isinstance(e, faults.SimulatedDeviceLoss):
+        return True
+    msg = str(e).upper()
+    return "UNAVAILABLE" in msg or "DEVICE LOST" in msg
 
 
 class MicroBatchScheduler:
@@ -85,6 +166,8 @@ class MicroBatchScheduler:
         metrics: Optional[ServeMetrics] = None,
         history_pad: int = 64,
         plan=None,
+        admission: Optional[AdmissionPolicy] = None,
+        pager=None,
     ):
         """``plan``: an optional :class:`hhmm_tpu.plan.Plan` — the
         topology-aware placement decision (`docs/sharding.md`). When
@@ -94,7 +177,15 @@ class MicroBatchScheduler:
         batch axis sharded over the plan's series mesh axis
         (``plan.place``). Whether a bucket shards is a pure function of
         its size, so the compile count stays flat after warmup exactly
-        as in the unsharded path."""
+        as in the unsharded path.
+
+        ``admission``: the explicit capacity model
+        (:class:`AdmissionPolicy`; ``"auto"`` derives the caps from the
+        plan's bucket ladder, ``None`` keeps every cap unbounded).
+        ``pager``: a :class:`hhmm_tpu.serve.pager.SnapshotPager` —
+        snapshot residency becomes budget-bounded, evictions detach,
+        and ``submit`` transparently pages unknown-but-registered
+        series in."""
         if buckets is None:
             buckets = plan.buckets if plan is not None else (8, 32, 128)
         if not buckets or any(b <= 0 for b in buckets):
@@ -107,6 +198,17 @@ class MicroBatchScheduler:
         self.registry = registry
         self.metrics = metrics if metrics is not None else ServeMetrics()
         self.history_pad = int(history_pad)
+        if admission == "auto":
+            if plan is None:
+                raise ValueError("admission='auto' needs a plan (its caps "
+                                 "derive from the planner bucket ladder)")
+            admission = AdmissionPolicy.from_plan(plan)
+        self.admission = admission
+        self.pager = pager
+        if pager is not None:
+            # eviction releases the series end-to-end: draw bank, stream
+            # state, staleness entry, queued ticks (shed) — detach()
+            pager.set_evict_listener(self.detach)
         self.n_draws: Optional[int] = None
         self._series: Dict[str, Dict[str, Any]] = {}
         # snapshot-staleness accounting (obs metrics plane): perf_counter
@@ -116,9 +218,14 @@ class MicroBatchScheduler:
         self._attach_t: Dict[str, float] = {}
         self._oldest_attach_t: Optional[float] = None
         self._pending: List[Tuple[str, Dict[str, Any], float]] = []
+        self._pending_count: Dict[str, int] = {}
         self._undelivered: List[TickResponse] = []
         self._draws_cache: Dict[Tuple[str, ...], jnp.ndarray] = {}
         self._obs_dtypes: Dict[str, Any] = {}
+        # the locked observation keyset: set by the first successful
+        # dispatch; later ticks with foreign keys shed-degrade instead
+        # of forcing new jit signatures (or failing the whole flush)
+        self._obs_keys_lock: Optional[Tuple[str, ...]] = None
         # every jitted serving kernel is registered with the process
         # compile registry (obs/telemetry.py): run manifests attribute
         # specialization counts per entry point, and check_guards
@@ -239,69 +346,74 @@ class MicroBatchScheduler:
         """Attach (or re-attach) one series. ``history``: optional dict
         of per-tick arrays [T_h] to warm-start the filter from (replayed
         through :func:`filter_scan`; ragged lengths across an
-        ``attach_many`` batch are padded with `batch/pad.py`)."""
-        self.attach_many([(series_id, snapshot, history)])
+        ``attach_many`` batch are padded with `batch/pad.py`). The
+        single-item form is strict: a rejected item raises (there is
+        nothing else in the batch to protect)."""
+        rejected = self.attach_many([(series_id, snapshot, history)])
+        if rejected:
+            raise ValueError(rejected[0][1])
 
     @traced("serve.attach")
-    def attach_many(self, items) -> None:
-        """Attach a batch of series in one padded replay dispatch.
+    def attach_many(self, items) -> List[Tuple[str, str]]:
+        """Attach a batch of series in padded replay dispatches.
         ``items``: iterable of ``(series_id, snapshot, history_or_None)``.
 
-        The whole batch is resolved and validated BEFORE any scheduler
-        state mutates (the flush() validate-before-pop discipline): a
-        bad item fails the attach with the draw-count lock, caches, and
-        series table untouched, so a corrected retry is not poisoned by
-        the failed attempt."""
-        # ---- pass 1: resolve + validate, no state mutation ----
+        Per-item degrade contract (the invariant-8 attach rung): a bad
+        item — invalid snapshot, admission capacity, a warm-replay
+        chunk failure — is REJECTED (returned as ``(series_id,
+        reason)``, counted in ``serve.rejected_attaches``) without
+        failing the rest of the batch: at fleet scale one poisoned
+        snapshot must not take down a thousand-series attach. Committed
+        items are committed atomically per item; the draw-count lock
+        moves only with an actually-committed attach, so a fully
+        rejected batch never poisons a corrected retry."""
+        items = list(items)
+        rejected: List[Tuple[str, str]] = []
         n_draws = self.n_draws
         resolved, keeps = [], []
         n_degraded_fits = 0
+        cap = None if self.admission is None else self.admission.max_series
+        projected = set(self._series)
         for series_id, snap, hist in items:
             if snap is None:  # a registry miss handed straight through
-                raise ValueError(
+                rejected.append((
+                    series_id,
                     f"no snapshot for series {series_id!r} (registry miss / "
-                    "corrupt entry?) — nothing to attach"
-                )
+                    "corrupt entry?) — nothing to attach",
+                ))
+                continue
             use, degraded, keep = self._resolve_snapshot(series_id, snap)
-            n_degraded_fits += int(not snap.healthy)
             if keep:
+                n_degraded_fits += 1  # keeps only happen on unhealthy fits
                 keeps.append(series_id)
                 continue
-            if self._model_spec is not None and use.spec != self._model_spec:
-                # a stale snapshot fitted under a different model
-                # class/config must fail loudly at attach, not be
-                # silently unpacked with the wrong bijectors
-                raise ValueError(
-                    f"snapshot for {series_id!r} was fitted with "
-                    f"{use.spec}, but this scheduler serves "
-                    f"{self._model_spec}"
-                )
+            reason = self._snapshot_reject_reason(series_id, use, n_draws)
+            if reason is not None:
+                rejected.append((series_id, reason))
+                continue
+            if (
+                cap is not None
+                and series_id not in projected
+                and len(projected) >= cap
+            ):
+                rejected.append((
+                    series_id,
+                    f"admission: max_series={cap} in-flight series reached",
+                ))
+                continue
+            projected.add(series_id)
             draws = np.asarray(use.draws)
-            if draws.ndim != 2:
-                raise ValueError(f"snapshot draws must be [D, dim], got {draws.shape}")
-            if draws.shape[1] != self.model.n_free:
-                raise ValueError(
-                    f"snapshot for {series_id!r} has dim {draws.shape[1]}; "
-                    f"the serving model has n_free={self.model.n_free}"
-                )
             if n_draws is None:
                 n_draws = draws.shape[0]
-            elif draws.shape[0] != n_draws:
-                raise ValueError(
-                    f"snapshot for {series_id!r} carries {draws.shape[0]} draws; "
-                    f"this scheduler serves {n_draws} (fixed for compile "
-                    "stability — thin with snapshot_from_fit(n_draws=...))"
-                )
-            resolved.append((series_id, jnp.asarray(draws), degraded, hist))
-        self._validate_histories(
-            [(s, h) for s, _, _, h in resolved if h is not None]
-        )
+            resolved.append(
+                (series_id, jnp.asarray(draws), degraded, hist, use,
+                 not snap.healthy)
+            )
 
-        # ---- pass 2: compute (still no scheduler-state mutation — a
-        # replay failure, e.g. a history missing a model data key that
-        # only surfaces inside build(), must leave everything intact) --
-        fresh = [(s, d, g) for s, d, g, h in resolved if h is None]
-        warm = [(s, d, g, h) for s, d, g, h in resolved if h is not None]
+        # ---- compute: fresh records are free; warm replays dispatch in
+        # keyset groups, and a failing chunk rejects ONLY its items ----
+        fresh = [(s, d, g) for s, d, g, h, _, _ in resolved if h is None]
+        warm = [(s, d, g, h) for s, d, g, h, _, _ in resolved if h is not None]
         new_recs: Dict[str, Dict[str, Any]] = {}
         for series_id, draws, degraded in fresh:
             new_recs[series_id] = {
@@ -313,25 +425,48 @@ class MicroBatchScheduler:
                 "rejected_fits": 0,
             }
         if warm:
-            new_recs.update(self._warm_records(warm))
-        if resolved:
+            recs, warm_rejected = self._warm_records(warm)
+            new_recs.update(recs)
+            rejected.extend(warm_rejected)
+        committed = set(new_recs)
+        if committed:
+            first = next(iter(committed))
             # pre-warm the shared [D, dim] unpack used by state(): its
             # one compile must land in the attach window, not surprise
             # the first post-warmup forecast (the compile-count metric
             # audits it alongside the dispatch kernels)
-            jax.block_until_ready(self._unpack_j(resolved[0][1]))
+            jax.block_until_ready(self._unpack_j(new_recs[first]["draws"]))
             self._note_signature(
                 "unpack",
-                tuple(resolved[0][1].shape),
-                str(resolved[0][1].dtype),
+                tuple(new_recs[first]["draws"].shape),
+                str(new_recs[first]["draws"].dtype),
             )
 
-        # ---- pass 3: commit ----
-        self.n_draws = n_draws
-        for _ in range(n_degraded_fits):  # counted only on a committed attach
+        # ---- commit ----
+        if committed:
+            self.n_draws = n_draws
+        # degraded fits counted ONLY for items that actually committed
+        # (keeps are commits of the keep decision): a warm-replay-
+        # rejected unhealthy snapshot is a rejected_attach, not a
+        # degraded one
+        n_degraded_fits += sum(
+            1
+            for sid, _, _, _, _, unhealthy in resolved
+            if unhealthy and sid in committed
+        )
+        for _ in range(n_degraded_fits):
             self.metrics.note_degraded_attach()
-        if resolved:  # keeps-only batches change no draw bank identity
-            self._draws_cache.clear()
+        if rejected:
+            self.metrics.note_rejected_attach(len(rejected))
+        if committed:
+            # only draw banks that actually changed invalidate their
+            # cached lane stacks — paging churn must not nuke the whole
+            # hot-path cache on every page-in
+            self._draws_cache = {
+                k: v
+                for k, v in self._draws_cache.items()
+                if not committed.intersection(k)
+            }
         for series_id in keeps:
             rec = self._series[series_id]
             rec["rejected_fits"] = rec.get("rejected_fits", 0) + 1
@@ -347,92 +482,285 @@ class MicroBatchScheduler:
             self._attach_t.setdefault(series_id, now)
         if self._attach_t:
             self._oldest_attach_t = min(self._attach_t.values())
-        if resolved:
+        if self.pager is not None:
+            # residency follows attachment (pager admission may evict a
+            # cold series, which detaches it — after commit, so the
+            # tables it mutates are consistent)
+            for series_id, _, _, _, use, _ in resolved:
+                if series_id in committed:
+                    self.pager.admit(series_id, use)
+        if committed:
             self._refresh_compile_count()
+        return rejected
 
-    @staticmethod
-    def _validate_histories(hists) -> None:
-        """Attach-batch history validation (runs in the no-mutation
-        pass): shared key set, and per-series consistent lengths across
-        keys — a shorter key would silently misalign against the padded
-        mask instead of erroring."""
-        if not hists:
-            return
-        keys = sorted(hists[0][1].keys())
-        for series_id, h in hists:
-            if sorted(h.keys()) != keys:
-                raise ValueError("histories in one attach batch must share keys")
+    def _snapshot_reject_reason(
+        self, series_id: str, use: PosteriorSnapshot, n_draws: Optional[int]
+    ) -> Optional[str]:
+        """Why this snapshot cannot serve here, or None if it can."""
+        if self._model_spec is not None and use.spec != self._model_spec:
+            # a stale snapshot fitted under a different model
+            # class/config must be rejected at attach, not silently
+            # unpacked with the wrong bijectors
+            return (
+                f"snapshot for {series_id!r} was fitted with {use.spec}, "
+                f"but this scheduler serves {self._model_spec}"
+            )
+        draws = np.asarray(use.draws)
+        if draws.ndim != 2:
+            return f"snapshot draws must be [D, dim], got {draws.shape}"
+        if draws.shape[1] != self.model.n_free:
+            return (
+                f"snapshot for {series_id!r} has dim {draws.shape[1]}; "
+                f"the serving model has n_free={self.model.n_free}"
+            )
+        if n_draws is not None and draws.shape[0] != n_draws:
+            return (
+                f"snapshot for {series_id!r} carries {draws.shape[0]} draws; "
+                f"this scheduler serves {n_draws} (fixed for compile "
+                "stability — thin with snapshot_from_fit(n_draws=...))"
+            )
+        return None
+
+    def _warm_records(self, warm):
+        """Run the padded history replays, grouped by history keyset.
+        Returns ``(records, rejected)``: a chunk whose replay raises
+        (e.g. a history missing a model data key that only surfaces
+        inside ``build()``) rejects its own items and nothing else."""
+        out: Dict[str, Dict[str, Any]] = {}
+        rejected: List[Tuple[str, str]] = []
+        groups: Dict[Tuple[str, ...], list] = {}
+        for series_id, draws, degraded, h in warm:
+            keys = tuple(sorted(h.keys()))
             lengths = {k: np.asarray(h[k]).shape[0] for k in keys}
             if len(set(lengths.values())) != 1:
-                raise ValueError(
+                # a shorter key would silently misalign against the
+                # padded mask instead of erroring
+                rejected.append((
+                    series_id,
                     f"history for {series_id!r} has inconsistent lengths "
-                    f"across keys: {lengths}"
-                )
+                    f"across keys: {lengths}",
+                ))
+                continue
+            groups.setdefault(keys, []).append((series_id, draws, degraded, h))
+        for keys, group in groups.items():
+            max_t = max(np.asarray(h[keys[0]]).shape[0] for _, _, _, h in group)
+            T_pad = -(-max_t // self.history_pad) * self.history_pad
+            for c0 in range(0, len(group), self.buckets[-1]):
+                chunk = group[c0 : c0 + self.buckets[-1]]
+                try:
+                    out.update(self._replay_chunk(chunk, list(keys), T_pad))
+                except Exception as e:  # degrade the chunk, not the batch
+                    reason = (
+                        f"warm replay failed: {type(e).__name__}: {e}"
+                    )
+                    rejected.extend((s, reason) for s, _, _, _ in chunk)
+        return out, rejected
 
-    def _warm_records(self, warm) -> Dict[str, Dict[str, Any]]:
-        """Run the padded history replays and return the series records
-        to commit — the caller commits them only after EVERY chunk (and
-        the rest of the attach batch) succeeded."""
-        out: Dict[str, Dict[str, Any]] = {}
-        keys = sorted(warm[0][3].keys())
-        max_t = max(np.asarray(h[keys[0]]).shape[0] for _, _, _, h in warm)
-        T_pad = -(-max_t // self.history_pad) * self.history_pad
-        for c0 in range(0, len(warm), self.buckets[-1]):
-            chunk = warm[c0 : c0 + self.buckets[-1]]
-            lanes = self._pad_lanes(chunk)
-            bn = len(lanes)
-            data_b: Dict[str, jnp.ndarray] = {}
-            mask = None
-            for k in keys:
-                padded, m = pad_ragged(
-                    [np.asarray(h[k]) for _, _, _, h in lanes], length=T_pad
-                )
-                data_b[k] = jnp.asarray(padded)
-                mask = m
-            data_b["mask"] = jnp.asarray(mask)
-            draws_b = jnp.stack([d for _, d, _, _ in lanes])
-            # the replay dispatch shards exactly like a tick flush of
-            # the same bucket size (one placement rule everywhere)
-            sharded = self.plan is not None and self.plan.shard_bucket(bn)
-            if sharded:
-                data_b = {k: self.plan.place(v) for k, v in data_b.items()}
-                draws_b = self.plan.place(draws_b)
-            with span("serve.replay") as sp:
-                sp.annotate(bucket=bn, T_pad=T_pad, sharded=sharded)
-                alpha, ll, okd = jax.block_until_ready(
-                    self._replay_j(draws_b, data_b)
-                )
-            self._note_signature(
-                "replay",
-                bn,
-                (T_pad,) + tuple(str(data_b[k].dtype) for k in keys),
+    def _replay_chunk(self, chunk, keys, T_pad) -> Dict[str, Dict[str, Any]]:
+        lanes = self._pad_lanes(chunk)
+        bn = len(lanes)
+        data_b: Dict[str, jnp.ndarray] = {}
+        mask = None
+        for k in keys:
+            padded, m = pad_ragged(
+                [np.asarray(h[k]) for _, _, _, h in lanes], length=T_pad
             )
-            for i, (series_id, draws, degraded, _) in enumerate(chunk):
-                out[series_id] = {
-                    "draws": draws,
-                    "alpha": alpha[i],
-                    "ll": ll[i],
-                    "ok": okd[i],
-                    "degraded_attach": degraded,
-                    "rejected_fits": 0,
-                }
+            data_b[k] = jnp.asarray(padded)
+            mask = m
+        data_b["mask"] = jnp.asarray(mask)
+        draws_b = jnp.stack([d for _, d, _, _ in lanes])
+        # the replay dispatch shards exactly like a tick flush of
+        # the same bucket size (one placement rule everywhere)
+        sharded = self.plan is not None and self.plan.shard_bucket(bn)
+        if sharded:
+            data_b = {k: self.plan.place(v) for k, v in data_b.items()}
+            draws_b = self.plan.place(draws_b)
+        with span("serve.replay") as sp:
+            sp.annotate(bucket=bn, T_pad=T_pad, sharded=sharded)
+            alpha, ll, okd = jax.block_until_ready(
+                self._replay_j(draws_b, data_b)
+            )
+        self._note_signature(
+            "replay",
+            bn,
+            (T_pad,) + tuple(str(data_b[k].dtype) for k in keys),
+        )
+        out: Dict[str, Dict[str, Any]] = {}
+        for i, (series_id, draws, degraded, _) in enumerate(chunk):
+            out[series_id] = {
+                "draws": draws,
+                "alpha": alpha[i],
+                "ll": ll[i],
+                "ok": okd[i],
+                "degraded_attach": degraded,
+                "rejected_fits": 0,
+            }
         return out
 
+    # ---- detach / paging ----
+
+    def detach(self, series_id: str) -> bool:
+        """Release EVERYTHING one series holds: its record (draw bank +
+        stream state), its staleness attach-time entry, its cached lane
+        stacks, its queued ticks (shed, counted), and its pager
+        residency. The pager's eviction path lands here; without it,
+        attached series grew without bound (ROADMAP item 4). Returns
+        False when the series was not attached."""
+        rec = self._series.pop(series_id, None)
+        self._pending_count.pop(series_id, None)
+        if self.pager is not None:
+            self.pager.discard(series_id)  # no-op if the pager evicted us
+        if rec is None:
+            return False
+        self._attach_t.pop(series_id, None)
+        self._oldest_attach_t = (
+            min(self._attach_t.values()) if self._attach_t else None
+        )
+        self._draws_cache = {
+            k: v for k, v in self._draws_cache.items() if series_id not in k
+        }
+        if any(p[0] == series_id for p in self._pending):
+            keep = []
+            for p in self._pending:
+                if p[0] == series_id:
+                    # _shed_now counts the shed AND keeps the parked-
+                    # response buffer under its capacity bound
+                    self._shed_now(p[0], p[2], "series detached")
+                else:
+                    keep.append(p)
+            self._pending = keep
+        return True
+
     # ---- ticking ----
+
+    def _resp_K(self) -> int:
+        """State dimension for synthesized (shed) responses."""
+        K = getattr(self.model, "K", None)
+        if K:
+            return int(K)
+        for rec in self._series.values():
+            if rec["alpha"] is not None:
+                return int(np.asarray(rec["alpha"]).shape[-1])
+        return 1
+
+    def _make_shed(
+        self, series_id: str, t_submit: float, error: str
+    ) -> TickResponse:
+        """A degraded-not-raised outcome: the observation was NOT
+        folded; ``probs`` are NaN (there is no honest state estimate
+        for a tick that never ran)."""
+        return TickResponse(
+            series_id=series_id,
+            probs=np.full(self._resp_K(), np.nan),
+            loglik=float("nan"),
+            healthy_draws=0,
+            degraded=True,
+            latency_s=time.perf_counter() - t_submit,
+            shed=True,
+            error=error,
+        )
+
+    def _shed_now(self, series_id: str, t_submit: float, error: str) -> None:
+        self.metrics.note_shed_tick()
+        self._undelivered.append(self._make_shed(series_id, t_submit, error))
+        # the parked-response buffer is itself capacity-bounded: a
+        # caller shedding forever without flushing must not grow it
+        # without bound (every shed stays counted in the metrics even
+        # when its response object is superseded)
+        pol = self.admission
+        if pol is not None and pol.max_queue_depth is not None:
+            cap = 4 * pol.max_queue_depth
+            while len(self._undelivered) > cap:
+                self._undelivered.pop(0)
+                self.metrics.note_superseded_response()
+
+    def _shed_oldest(self, series_id: Optional[str], reason: str) -> None:
+        """Shed the oldest pending tick (of ``series_id``, or overall) —
+        for a filter the newest observation is the valuable one, so the
+        stale end of the queue is the right place to cut."""
+        for i, p in enumerate(self._pending):
+            if series_id is None or p[0] == series_id:
+                del self._pending[i]
+                self._dec_pending(p[0])
+                self._shed_now(p[0], p[2], f"shed under pressure ({reason})")
+                return
+
+    def _dec_pending(self, series_id: str) -> None:
+        n = self._pending_count.get(series_id, 0) - 1
+        if n <= 0:
+            self._pending_count.pop(series_id, None)
+            if self.pager is not None:
+                self.pager.unpin(series_id)
+        else:
+            self._pending_count[series_id] = n
 
     def submit(self, series_id: str, obs: Dict[str, Any]) -> None:
         """Queue one tick for ``series_id``; runs at the next flush.
         ``obs``: dict of per-tick scalars (the model's data keys, e.g.
-        ``{"x": 4, "sign": 1}`` for Tayal)."""
+        ``{"x": 4, "sign": 1}`` for Tayal).
+
+        Hot-path degrade contract (check_guards invariant 8): an
+        unknown series sheds the tick (counted, delivered as a
+        ``shed=True`` response at the next flush) instead of raising —
+        unless a pager is attached and the series is registered, in
+        which case it is transparently paged in and attached cold.
+        Admission pressure (queue depth / per-series quota) sheds
+        oldest-first, never raises."""
+        now = time.perf_counter()
         if series_id not in self._series:
-            raise KeyError(f"series {series_id!r} is not attached")
-        self._pending.append((series_id, obs, time.perf_counter()))
+            if self.pager is None:
+                self._shed_now(series_id, now, "series not attached")
+                return
+            cap = None if self.admission is None else self.admission.max_series
+            if cap is not None and len(self._series) >= cap:
+                # shed BEFORE loading: an over-cap page-in must not pay
+                # the registry read, and must never evict an attached
+                # tenant on behalf of a series the cap will reject
+                self._shed_now(
+                    series_id,
+                    now,
+                    f"admission: max_series={cap} in-flight series reached",
+                )
+                return
+            # load WITHOUT admitting residency: attach validates first,
+            # so a rejected snapshot never leaks into the resident set
+            snap = self.pager.load(series_id)
+            if snap is None:
+                self._shed_now(
+                    series_id, now, "no servable snapshot to page in"
+                )
+                return
+            rej = self.attach_many([(series_id, snap, None)])
+            if rej:
+                self._shed_now(
+                    series_id, now, f"page-in attach rejected: {rej[0][1]}"
+                )
+                return
+        pol = self.admission
+        if pol is not None:
+            q = pol.max_pending_per_series
+            if q is not None and self._pending_count.get(series_id, 0) >= q:
+                # shed-over-quota: this series' own oldest tick yields
+                self._shed_oldest(
+                    series_id, f"per-series quota {q} (tenant={series_id!r})"
+                )
+            d = pol.max_queue_depth
+            if d is not None and len(self._pending) >= d:
+                self._shed_oldest(None, f"queue depth {d}")
+        self._pending.append((series_id, obs, now))
+        self._pending_count[series_id] = (
+            self._pending_count.get(series_id, 0) + 1
+        )
+        if self.pager is not None:
+            # a queued tick pins its snapshot: evicting it would shed
+            # the tick for no memory gain
+            self.pager.pin(series_id)
 
     def tick(self, obs_by_series: Dict[str, Dict[str, Any]]) -> Dict[str, TickResponse]:
         """Convenience: submit every (series, obs) pair and flush,
         returning the LATEST response per series (latest-wins). When
         the flush also delivers older responses for the same series
-        (queued ticks, or responses carried over a partial failure),
+        (queued ticks, or shed responses parked since the last flush),
         those are superseded — dropped, counted in
         ``metrics.superseded_responses`` — because the dict shape can
         only carry one response per series (re-parking them would
@@ -451,35 +779,41 @@ class MicroBatchScheduler:
 
     @traced("serve.flush")
     def flush(self) -> List[TickResponse]:
-        """Dispatch all pending ticks in bucketed micro-batches.
+        """Dispatch pending ticks in bucketed micro-batches, up to the
+        admission policy's per-flush budget (the remainder stays
+        queued; the bounded queue keeps the backlog finite).
 
         Multiple queued ticks for the same series dispatch as sequential
         waves (submission order preserved): each must fold into the
         filter from the state its predecessor produced, never from a
         shared stale prior.
 
-        Partial-failure contract: if a dispatch raises mid-flush (a
-        malformed observation value), already-dispatched waves have
-        committed their state atomically — their responses are KEPT and
-        delivered at the head of the next successful ``flush()`` (a
-        committed tick must never lose its response: re-submitting it
-        would double-fold the observation) — while every un-dispatched
-        tick is re-queued, retryable."""
+        Degrade contract (check_guards invariant 8): nothing that goes
+        wrong per-series or per-group escapes as an exception. A tick
+        whose observation keys don't match the locked keyset, a group
+        whose dispatch fails (malformed observation value, simulated or
+        real device loss), a tick for a series detached since
+        submission — each becomes a ``shed=True`` degraded
+        :class:`TickResponse`; every other group in the flush proceeds.
+        Dispatched groups commit their state atomically, so a degraded
+        group's series keep their pre-tick filter state (the caller may
+        re-submit the observation)."""
+        carried, self._undelivered = self._undelivered, []
         if not self._pending:
-            return []
-        # validate BEFORE popping or dispatching anything: a malformed
-        # tick must fail the flush cleanly (queue intact, retryable),
-        # not abort half-way with some series already advanced
-        obs_keys = sorted(self._pending[0][1].keys())
-        for series_id, obs, _ in self._pending:
-            if sorted(obs.keys()) != obs_keys:
-                raise ValueError(
-                    f"tick observation for {series_id!r} has keys "
-                    f"{sorted(obs.keys())}; this flush expects {obs_keys} "
-                    "(queue left intact)"
-                )
-        pending, self._pending = self._pending, []
+            return carried
         t0 = time.perf_counter()
+        pol = self.admission
+        budget = (
+            len(self._pending)
+            if pol is None or pol.max_ticks_per_flush is None
+            else int(pol.max_ticks_per_flush)
+        )
+        pending, self._pending = (
+            self._pending[:budget],
+            self._pending[budget:],
+        )
+        for p in pending:
+            self._dec_pending(p[0])
         waves: List[list] = []
         wave, seen = [], set()
         for p in pending:
@@ -490,46 +824,86 @@ class MicroBatchScheduler:
             seen.add(p[0])
         waves.append(wave)
         responses: List[TickResponse] = []
-        dispatched: set = set()
-        try:
-            for wave in waves:
-                # fresh/live split per wave: a first-ever tick in wave k
-                # makes its series live for wave k+1
-                fresh = [p for p in wave if self._series[p[0]]["alpha"] is None]
-                live = [p for p in wave if self._series[p[0]]["alpha"] is not None]
-                for group, kernel in ((fresh, "init"), (live, "update")):
-                    for c0 in range(0, len(group), self.buckets[-1]):
-                        chunk = group[c0 : c0 + self.buckets[-1]]
+        folded: List[Tuple[str, Dict[str, Any], float]] = []
+        for wave in waves:
+            # the observation keyset is the jit signature: ticks with
+            # foreign keys shed-degrade instead of retracing the warm
+            # kernels (or failing the whole flush). Before the first
+            # successful dispatch locks the keyset, the reference is
+            # the wave MAJORITY (first-seen tiebreak) — anchoring on
+            # the oldest tick would let a single typo'd producer shed
+            # every conforming tick in the wave
+            if self._obs_keys_lock is not None:
+                ref = self._obs_keys_lock
+            else:
+                counts: Dict[Tuple[str, ...], int] = {}
+                for p in wave:
+                    k = tuple(sorted(p[1].keys()))
+                    counts[k] = counts.get(k, 0) + 1
+                ref = max(counts, key=counts.get)
+            ok_wave = []
+            for p in wave:
+                keys = tuple(sorted(p[1].keys()))
+                if keys != ref:
+                    self.metrics.note_shed_tick()
+                    responses.append(
+                        self._make_shed(
+                            p[0],
+                            p[2],
+                            f"observation keys {list(keys)} do not match "
+                            f"this scheduler's locked keys {list(ref)}",
+                        )
+                    )
+                elif p[0] not in self._series:
+                    # detached between submit and flush
+                    self.metrics.note_shed_tick()
+                    responses.append(
+                        self._make_shed(p[0], p[2], "series detached")
+                    )
+                else:
+                    ok_wave.append(p)
+            # fresh/live split per wave: a first-ever tick in wave k
+            # makes its series live for wave k+1
+            fresh = [p for p in ok_wave if self._series[p[0]]["alpha"] is None]
+            live = [p for p in ok_wave if self._series[p[0]]["alpha"] is not None]
+            for group, kernel in ((fresh, "init"), (live, "update")):
+                for c0 in range(0, len(group), self.buckets[-1]):
+                    chunk = group[c0 : c0 + self.buckets[-1]]
+                    try:
                         responses.extend(self._dispatch(chunk, kernel))
-                        dispatched.update(id(p) for p in chunk)
-        except BaseException:
-            # a malformed observation value (wrong shape/dtype) can only
-            # surface inside a dispatch; that group commits no state, so
-            # re-queue every un-dispatched tick (retryable) before
-            # propagating. Already-dispatched waves advanced atomically:
-            # their metrics are recorded and their responses carried to
-            # the next flush (see the partial-failure contract above).
-            done = time.perf_counter()
-            for p in pending:
-                if id(p) in dispatched:
-                    self.metrics.observe_latency(done - p[2])
-            if dispatched:
-                self.metrics.observe_flush(len(dispatched), done - t0)
-            self._undelivered.extend(responses)
-            self._pending = [
-                p for p in pending if id(p) not in dispatched
-            ] + self._pending
-            raise
+                        folded.extend(chunk)
+                        if self._obs_keys_lock is None:
+                            self._obs_keys_lock = tuple(
+                                sorted(chunk[0][1].keys())
+                            )
+                    except Exception as e:
+                        # the group committed no state: degrade its
+                        # ticks into shed responses and keep flushing
+                        # the remaining groups (invariant 8)
+                        if _looks_like_device_loss(e):
+                            self.metrics.note_device_loss()
+                        self.metrics.note_dispatch_error(len(chunk))
+                        err = f"{type(e).__name__}: {e}"
+                        responses.extend(
+                            self._make_shed(
+                                s, ts, f"dispatch failed ({err})"
+                            )
+                            for s, _, ts in chunk
+                        )
         done = time.perf_counter()
-        for _, _, t_submit in pending:
+        for _, _, t_submit in folded:
             self.metrics.observe_latency(done - t_submit)
-        self.metrics.observe_flush(len(pending), done - t0)
+        self.metrics.observe_flush(len(folded), done - t0)
         if self._oldest_attach_t is not None:
             # age of the OLDEST serving posterior: the staleness gauge
             # + SLO watermark (serve/metrics.py)
             self.metrics.observe_staleness(done - self._oldest_attach_t)
+        if self.pager is not None:
+            # the drained ticks just unpinned their snapshots: bring
+            # residency back under the byte budget now, not at the next
+            # page-in (a pin-heavy flush may have overrun transiently)
+            self.pager.shrink_to_budget()
         self._refresh_compile_count()
-        carried, self._undelivered = self._undelivered, []
         return carried + responses
 
     def _dispatch(self, group, kernel: str) -> List[TickResponse]:
@@ -581,6 +955,10 @@ class MicroBatchScheduler:
                 jnp.stack([self._series[s]["draws"] for s in lane_key])
             )
             self._draws_cache[lane_key] = draws_b
+        # traffic-shaped fault surface (robust/faults.py): a simulated
+        # device loss fires here, inside the dispatch the flush path
+        # must degrade — exactly where a real XLA UNAVAILABLE would
+        faults.dispatch_fault()
         with span(f"serve.dispatch.{kernel}") as sp:
             sp.annotate(bucket=bn, sharded=sharded)
             if kernel == "init":

@@ -37,12 +37,19 @@ Further gate rules:
   never gates in either direction — a CPU fallback run regressing
   against a TPU run is a backend change, not a perf change;
 - **SLO attainment gates like throughput**: a record whose manifest
-  stanza carries an ``slo`` verdict (`bench.py --serve` embeds the
-  `serve/metrics.py` ``evaluate_slo`` result) fails the gate when the
-  previous comparable record ATTAINED its SLOs and this one does not —
-  the serving-objective analog of a throughput regression. A first
-  record that is already unmet is reported (never silently green) but
-  has no baseline to regress from, so it does not gate.
+  stanza carries an ``slo`` verdict (`bench.py --serve` /
+  ``--serve-storm`` embed the `serve/metrics.py` ``evaluate_slo``
+  result) fails the gate when the previous comparable record ATTAINED
+  its SLOs and this one does not — the serving-objective analog of a
+  throughput regression. A first record that is already unmet is
+  reported (never silently green) but has no baseline to regress from,
+  so it does not gate.
+- **Resilience gates the same way**: a record whose manifest stanza
+  carries a ``storm`` verdict (`bench.py --serve-storm`) fails the
+  gate when a comparable clean baseline (zero escaped faults) is
+  followed by a record with ``faults_escaped > 0`` — an injected fault
+  leaking out as an exception is a survival regression even if the
+  bench somehow exited 0.
 
 Exit codes: 0 clean (or nothing comparable), 1 regression, 2 usage/IO
 error. No jax import — this runs in CI guards and pre-push hooks.
@@ -160,6 +167,7 @@ def diff(
     last_by_metric: Dict[str, Dict[str, Any]] = {}
     last_by_key: Dict[Tuple, Dict[str, Any]] = {}
     last_slo_by_key: Dict[Tuple, bool] = {}
+    last_escaped_by_key: Dict[Tuple, int] = {}
     failures = 0
     for rnd in rounds:
         rec = rnd["record"]
@@ -249,6 +257,30 @@ def diff(
                 else:
                     row["status"] += "; SLO attained"
                 last_slo_by_key[key] = attained
+            # resilience rides the same key: a clean (zero-escape) storm
+            # baseline followed by escaped faults is a survival
+            # regression, gated like an attained -> unmet SLO transition
+            storm = (rec.get("manifest") or {}).get("storm")
+            if isinstance(storm, dict) and "faults_escaped" in storm:
+                try:
+                    esc = int(storm.get("faults_escaped") or 0)
+                except (TypeError, ValueError):
+                    esc = -1  # malformed: visible, never a clean baseline
+                prev_esc = last_escaped_by_key.get(key)
+                if prev_esc == 0 and esc != 0:
+                    failures += 1
+                    row["gated"] = True
+                    row["status"] += (
+                        f"; RESILIENCE REGRESSION: {esc} escaped fault(s) "
+                        "(baseline was clean)"
+                    )
+                elif esc != 0:
+                    row["status"] += (
+                        f"; {esc} escaped fault(s) (no clean baseline)"
+                    )
+                else:
+                    row["status"] += "; faults contained"
+                last_escaped_by_key[key] = esc
         if isinstance(value, (int, float)):
             last_by_metric[metric] = {"n": rnd["n"], "value": value}
         rows.append(row)

@@ -67,6 +67,17 @@ depend on:
    stanza). Consumers take a ``Plan`` (or a caller mesh wrapped via
    ``plan_for_mesh``); kernel shard_map bodies describe specs through
    ``core.compat.pspec``.
+8. **Serve hot paths degrade, never raise per-series**
+   (`docs/serving.md` "Overload & failure modes"): in
+   ``hhmm_tpu/serve/scheduler.py``, the hot-path entry points
+   (``tick`` / ``flush`` / ``submit`` / ``attach*``) (a) contain no
+   bare re-``raise`` — catching a per-series dispatch failure and
+   re-propagating it is exactly the overload behavior the shed path
+   exists to prevent — and (b) every ``self._dispatch(...)`` call
+   inside them sits under a ``try`` whose handler catches ``Exception``
+   (degrading the group into shed responses). A refactor that unwraps
+   the dispatch would let one malformed observation (or a device loss)
+   take down every other series' flush.
 
 Exit 0 when clean, 1 with one line per violation. Run by
 ``tests/test_robust.py`` (and re-asserted by ``tests/test_serve.py``,
@@ -135,6 +146,12 @@ AD_HOC_COUNT_RE = re.compile(r"(^|_)(counts?|counters?)$")
 SHARDING_CTORS = ("Mesh", "NamedSharding", "PartitionSpec")
 PLACEMENT_ALLOWED_PREFIXES = ("hhmm_tpu/plan/",)
 PLACEMENT_ALLOWED_FILES = ("hhmm_tpu/core/compat.py",)
+
+# invariant 8: the scheduler's hot-path entry points and the guarded
+# per-group dispatch call they must wrap
+SERVE_HOT_PATH_FILE = "hhmm_tpu/serve/scheduler.py"
+HOT_PATH_METHOD_RE = re.compile(r"^(tick|flush|submit|attach\w*)$")
+HOT_PATH_DISPATCH_ATTR = "_dispatch"
 
 
 def _bare_excepts(tree: ast.Module, rel: str, problems: List[str]) -> None:
@@ -375,6 +392,66 @@ def _check_placement_confinement(
             )
 
 
+def _handler_catches_exception(handler: ast.ExceptHandler) -> bool:
+    """True when the handler's type covers ``Exception`` (bare handlers
+    are already outlawed by invariant 1; BaseException would swallow
+    KeyboardInterrupt and is not accepted as a degrade handler)."""
+    t = handler.type
+    names = []
+    if isinstance(t, ast.Name):
+        names = [t.id]
+    elif isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    return "Exception" in names
+
+
+def _check_serve_hot_path(tree: ast.Module, rel: str, problems: List[str]) -> None:
+    """Invariant 8: hot-path entry points (tick/flush/submit/attach*)
+    in the scheduler (a) never bare-``raise`` (re-propagating a caught
+    per-series failure) and (b) keep every ``self._dispatch(...)`` call
+    under a try/except-``Exception`` degrade handler."""
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        for fn in [
+            n
+            for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and HOT_PATH_METHOD_RE.match(n.name)
+        ]:
+            guarded_spans: List[Tuple[int, int]] = []
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Raise) and node.exc is None:
+                    problems.append(
+                        f"{rel}:{node.lineno}: bare `raise` in serve hot path "
+                        f"`{fn.name}` — per-series failures must degrade "
+                        "into shed TickResponses, not propagate "
+                        "(docs/serving.md overload ladder)"
+                    )
+                if isinstance(node, ast.Try) and any(
+                    _handler_catches_exception(h) for h in node.handlers
+                ):
+                    lo = min(s.lineno for s in node.body)
+                    hi = max(
+                        getattr(s, "end_lineno", s.lineno) for s in node.body
+                    )
+                    guarded_spans.append((lo, hi))
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == HOT_PATH_DISPATCH_ATTR
+                ):
+                    if not any(
+                        lo <= node.lineno <= hi for lo, hi in guarded_spans
+                    ):
+                        problems.append(
+                            f"{rel}:{node.lineno}: `{HOT_PATH_DISPATCH_ATTR}` "
+                            f"call in serve hot path `{fn.name}` outside a "
+                            "try/except-Exception degrade handler — one "
+                            "malformed observation or device loss would "
+                            "fail every series in the flush"
+                        )
+
+
 def check(root: pathlib.Path) -> List[str]:
     problems: List[str] = []
     pkg = root / "hhmm_tpu"
@@ -396,6 +473,9 @@ def check(root: pathlib.Path) -> List[str]:
         # jax.jit entry point registers it with the telemetry registry
         if py.parent == serve_dir:
             _check_telemetry_registration(tree, rel, problems)
+        # invariant 8: scheduler hot paths degrade, never raise
+        if rel.replace("\\", "/") == SERVE_HOT_PATH_FILE:
+            _check_serve_hot_path(tree, rel, problems)
     for bench_name in ("bench.py", "bench_zoo.py"):
         bench = root / bench_name
         if bench.is_file():
@@ -521,7 +601,7 @@ def main(argv: List[str]) -> int:
         "online serve step guarded; semiring combines guarded; "
         "monotonic clocks only; serve/bench jits telemetry-registered; "
         "one shared metrics plane; placement objects confined to the "
-        "planner)"
+        "planner; serve hot paths degrade, never raise)"
     )
     return 0
 

@@ -307,6 +307,10 @@ class TestCompileTelemetry:
             "degraded_responses",
             "degraded_attaches",
             "superseded_responses",
+            "shed_ticks",
+            "rejected_attaches",
+            "dispatch_errors",
+            "device_loss_events",
             "compile_count",
         }
 
@@ -886,7 +890,9 @@ def _run_bench_diff(*argv):
     )
 
 
-def _write_fixture_rounds(d, values, stamped=True, traced=None, slo=None):
+def _write_fixture_rounds(
+    d, values, stamped=True, traced=None, slo=None, escaped=None
+):
     for n, v in enumerate(values, start=1):
         rec = {
             "metric": "fixture_throughput",
@@ -901,6 +907,10 @@ def _write_fixture_rounds(d, values, stamped=True, traced=None, slo=None):
                 "versions": {"jax": "0.0-test"},
                 "trace_enabled": bool(traced[n - 1]) if traced else False,
             }
+            if escaped is not None and escaped[n - 1] is not None:
+                rec["manifest"]["storm"] = {
+                    "faults_escaped": int(escaped[n - 1])
+                }
             if slo is not None and slo[n - 1] is not None:
                 attained = bool(slo[n - 1])
                 rec["manifest"]["slo"] = {
@@ -1014,6 +1024,30 @@ class TestBenchDiffSLO:
         proc = _run_bench_diff("--dir", str(tmp_path))
         assert proc.returncode == 1, proc.stdout
         assert proc.stdout.count("SLO REGRESSION") == 1
+
+
+class TestBenchDiffResilience:
+    """The `bench.py --serve-storm` ``storm`` stanza gates like SLOs:
+    clean baseline -> escaped faults is a survival regression."""
+
+    def test_escaped_after_clean_baseline_fails(self, tmp_path):
+        _write_fixture_rounds(tmp_path, [100.0, 100.0], escaped=[0, 2])
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 1, proc.stdout
+        assert "RESILIENCE REGRESSION" in proc.stdout
+
+    def test_clean_to_clean_passes(self, tmp_path):
+        _write_fixture_rounds(tmp_path, [100.0, 99.0], escaped=[0, 0])
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 0, proc.stdout
+        assert "faults contained" in proc.stdout
+
+    def test_first_escaped_reported_not_gated(self, tmp_path):
+        # no clean baseline to regress from: visible, not fatal
+        _write_fixture_rounds(tmp_path, [100.0, 99.0], escaped=[1, 1])
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 0, proc.stdout
+        assert "no clean baseline" in proc.stdout
 
 
 class TestObsReport:

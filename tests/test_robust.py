@@ -192,6 +192,65 @@ class TestCorruptKinds:
         np.testing.assert_array_equal(np.asarray(out["n"]), np.arange(3))
 
 
+class TestFaultPlanThreading:
+    """The fault-plan stack is THREAD-LOCAL (the kernels/dispatch.py
+    plan-scope discipline): a plan injected on one thread can never
+    leak into another thread's fit/serve path."""
+
+    def test_plan_never_leaks_across_threads(self):
+        import threading
+
+        ready = threading.Event()
+        release = threading.Event()
+        seen = {}
+
+        def other_thread():
+            # observed WHILE the main thread holds an active plan
+            ready.wait(5)
+            seen["active"] = faults.active()
+            seen["traffic"] = faults.traffic_active()
+            # and an injection HERE is invisible to the main thread
+            with faults.inject(FaultPlan(kind="nan_grad", step=1, chain=0)):
+                seen["own"] = faults.active()
+                release.set()
+
+        t = threading.Thread(target=other_thread)
+        with faults.inject(
+            faults.TrafficFaultPlan(device_loss_at_dispatch=0)
+        ):
+            with faults.inject(FaultPlan(kind="nan_logp", step=3, chain=1)):
+                t.start()
+                ready.set()
+                release.wait(5)
+                # the other thread's nan_grad plan must not shadow ours
+                assert faults.active().kind == "nan_logp"
+                assert faults.traffic_active().device_loss_at_dispatch == 0
+        t.join()
+        assert seen["active"] is None  # main thread's plans invisible
+        assert seen["traffic"] is None
+        assert seen["own"].kind == "nan_grad"
+        # and after every scope exits, this thread is clean
+        assert faults.active() is None and faults.traffic_active() is None
+
+    def test_inner_plan_wins_per_type(self):
+        with faults.inject(FaultPlan(kind="nan_logp", step=1, chain=0)):
+            with faults.inject(
+                faults.TrafficFaultPlan(slow_load_s=0.1, slow_load_every=1)
+            ):
+                with faults.inject(FaultPlan(kind="nan_grad", step=2, chain=1)):
+                    # innermost of EACH type wins; types don't shadow
+                    # each other
+                    assert faults.active().kind == "nan_grad"
+                    assert faults.traffic_active().slow_load_s == 0.1
+                assert faults.active().kind == "nan_logp"
+        assert faults.active() is None
+
+    def test_inject_rejects_foreign_types(self):
+        with pytest.raises(TypeError, match="FaultPlan"):
+            with faults.inject({"kind": "nan_grad"}):
+                pass
+
+
 class TestCheesGuard:
     def test_nan_grad_quarantines_one_chain_of_one_series(self):
         def lp_bc(q):
