@@ -154,6 +154,7 @@ WORKLOAD_FLAGS = (
     "scale_sweep",
     "sweep_samples",
     "assoc_sweep",
+    "profile_kernels",
     "plan_sweep",
     "plan_topologies",
     "serve",
@@ -950,6 +951,196 @@ def assoc_sweep(args, backend) -> None:
     emit_manifest(args, "assoc", assoc_record, model=model)
 
 
+def kernel_costs_path(args):
+    """DB target for ``--profile-kernels``: an explicit
+    ``--kernel-costs-out`` always wins; otherwise ``--quick`` runs are
+    steered to a SCRATCH DB (``results/kernel_costs.quick.json``,
+    gitignored) instead of the checked-in default — the checked-in
+    ``results/kernel_costs.json`` holds dispatch-grade measurements
+    (full reps/batch), and a reps=2/B=4 smoke row landing there would
+    go git-dirty and, if committed, decide "auto" dispatch
+    process-wide off 2-rep noise. ``None`` defers to
+    `obs/profile.py`'s default-path resolution (env override
+    included)."""
+    if args.kernel_costs_out is not None:
+        return args.kernel_costs_out
+    if args.quick:
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "results",
+            "kernel_costs.quick.json",
+        )
+        print(
+            f"# --quick: writing the scratch cost DB {path} (pass "
+            "--kernel-costs-out to target a specific DB; the checked-in "
+            "results/kernel_costs.json holds full-mode rows only)",
+            file=sys.stderr,
+            flush=True,
+        )
+        return path
+    return None
+
+
+def profile_kernels(args, backend) -> None:
+    """``--profile-kernels``: populate the kernel cost database
+    (`hhmm_tpu/obs/profile.py`, ``results/kernel_costs.json``) with
+    measured device-time + XLA cost-analysis rows for the sequential
+    vs associative-scan branches of the decode kernels, then audit
+    what `kernels/dispatch.py` now resolves for ``"auto"`` at those
+    exact points — DB-backed, table-backed, or unmeasured.
+
+    Every timing goes through the canonical ``device_time`` harness
+    (warmup/compile split, fresh pre-staged inputs per rep,
+    ``block_until_ready``, exact-order-statistic p50) and every row is
+    stamped with (device_kind, jax/jaxlib) so `scripts/bench_diff.py`
+    can gate device-time regressions between comparable records and a
+    TPU run of this same flag fills the TPU crossover without a code
+    change. Emits one ``hmm_kernel_profile_throughput`` record whose
+    manifest stanza carries the compact row table + dispatch audit."""
+    from hhmm_tpu.kernels import dispatch as kdispatch
+    from hhmm_tpu.obs import profile as obs_profile
+
+    rng = np.random.default_rng(7)
+    if args.quick:
+        points = [(2, 64), (2, 128), (4, 64)]
+        B, reps = 4, 2
+        kernel_names = ("filter", "ffbs")
+    else:
+        points = [(2, 512), (4, 1024), (8, 1024)]
+        B, reps = 64, 8
+        kernel_names = ("filter", "viterbi", "ffbs")
+
+    # the SHARED measurement surface (obs/profile.py): both cost-DB
+    # writers — this bench and scripts/tpu_assoc_probe.py — must time
+    # the exact same computation per (kernel, branch) key, or the DB's
+    # winner arbitration compares different programs
+    inputs = lambda K, T: obs_profile.dirichlet_hmm_inputs(rng, K, T, batch=B)
+    kernels = obs_profile.decode_kernel_pairs()
+    db = obs_profile.KernelCostDB(kernel_costs_path(args)).load()
+    device_kind = obs_manifest.device_info().get("device_kind")
+    rows_stanza = []
+    headline = None
+    import dataclasses as _dc
+
+    for K, T in points:
+        for name in kernel_names:
+            seq_fn, assoc_fn = kernels[name]
+            for branch, body in (("seq", seq_fn), ("assoc", assoc_fn)):
+                fn = telemetry.register_jit(
+                    f"bench.profile.{name}.{branch}", jax.jit(jax.vmap(body))
+                )
+                sets = [inputs(K, T) for _ in range(reps + 1)]
+                jax.block_until_ready(sets)
+                # ONE compile serves both the cost extraction and the
+                # timed executable (AOT lower+compile does not share
+                # the jit cache, so warming `fn` separately would pay
+                # every multi-second assoc compile twice)
+                t0 = perf_counter()
+                compiled = fn.lower(*sets[-1]).compile()
+                compile_s = perf_counter() - t0
+                timing = obs_profile.device_time(
+                    compiled, arg_sets=sets, reps=reps
+                )
+                # the harness's "warmup" on the compiled executable is
+                # a plain first run; the honest compile split is the
+                # AOT compile measured above
+                timing = _dc.replace(timing, compile_s=compile_s)
+                cost = obs_profile.cost_analysis(compiled)
+                roof = obs_profile.roofline(cost, timing.p50_s, device_kind)
+                row = db.put_row(
+                    kernel=name,
+                    branch=branch,
+                    K=K,
+                    T=T,
+                    B=B,
+                    dtype="float32",
+                    timing=timing,
+                    cost=cost,
+                    roofline_frac=roof,
+                    source="bench.profile_kernels",
+                    extra={"quick": True} if args.quick else None,
+                )
+                compact = {
+                    "kernel": name,
+                    "branch": branch,
+                    "K": K,
+                    "T": T,
+                    "B": B,
+                    "dtype": "float32",
+                    "p50_ms": round(timing.p50_s * 1e3, 4),
+                    "min_ms": round(timing.min_s * 1e3, 4),
+                    "compile_s": row["timing"]["compile_s"],
+                    "flops": (cost or {}).get("flops"),
+                    "bytes_accessed": (cost or {}).get("bytes_accessed"),
+                    "flops_frac": (roof or {}).get("flops_frac"),
+                    "timing_only": not cost,
+                }
+                rows_stanza.append(compact)
+                print(json.dumps(compact), file=sys.stderr, flush=True)
+                if name == "filter" and branch == "seq":
+                    headline = (B, timing.p50_s)
+        # incremental atomic save per (K, T) point — the probe's
+        # discipline: a crash on a late long-T assoc point must not
+        # discard the rows already measured
+        db.save()
+    # bind the freshly written DB as the active dispatch source: the
+    # audit below must describe what "auto" resolves to NOW, and a
+    # custom --kernel-costs-out path would otherwise go unread
+    obs_profile.set_db(db)
+    dispatch_audit = []
+    for K, T in points:
+        for name in kernel_names:
+            branch, source = kdispatch.resolve_auto(K, T, kernel=name)
+            dispatch_audit.append(
+                {
+                    "kernel": name,
+                    "K": K,
+                    "T": T,
+                    "auto": "assoc" if branch else "seq",
+                    "source": source,
+                }
+            )
+    stanza = {
+        "db_path": db.path,
+        "device_kind": device_kind,
+        "rows": rows_stanza,
+        "dispatch": dispatch_audit,
+    }
+    obs_manifest.note_stanza("kernel_costs", stanza)
+    record = stamp_record(
+        {
+            "metric": "hmm_kernel_profile_throughput",
+            # headline: the sequential batched filter at the last (K, T)
+            # point — calls-per-second form so the standard throughput
+            # gate binds; the per-row device times gate via the
+            # kernel_costs manifest stanza (scripts/bench_diff.py)
+            "value": round(headline[0] / headline[1], 1) if headline else None,
+            "unit": "series/sec",
+            "points": [{"K": K, "T": T} for K, T in points],
+            "kernels": list(kernel_names),
+            "batch": B,
+            "reps": reps,
+            "rows_written": len(rows_stanza),
+            "db_path": db.path,
+            "backend": backend["backend"],
+            "backend_fallback": backend["fallback"],
+            "device": str(jax.devices()[0]),
+            "quick": bool(args.quick),
+        },
+        args,
+    )
+    print(json.dumps(record))
+    print(
+        f"# kernel cost DB: {len(rows_stanza)} row(s) written to {db.path}; "
+        + ", ".join(
+            f"{d['kernel']}@K{d['K']}/T{d['T']}={d['auto']}[{d['source']}]"
+            for d in dispatch_audit
+        ),
+        file=sys.stderr,
+    )
+    emit_manifest(args, "profile_kernels", record)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--series", type=int, default=256)
@@ -1043,6 +1234,27 @@ def main() -> None:
         "with --quick) and emits a tayal_assoc_decode_throughput JSON "
         "record with the dispatch table's picks (kernels/dispatch.py; "
         "see docs/parallel_scan.md)",
+    )
+    ap.add_argument(
+        "--profile-kernels",
+        action="store_true",
+        help="run the kernel cost profiler instead of the fit bench: "
+        "time the sequential vs associative-scan decode kernels "
+        "(filter/FFBS, plus Viterbi in the full grid) through the "
+        "obs/profile.py device_time harness, extract XLA "
+        "cost_analysis FLOPs/bytes + roofline fractions, write the "
+        "rows into the kernel cost DB (results/kernel_costs.json — "
+        "the measured crossover source kernels/dispatch.py reads), "
+        "and emit a hmm_kernel_profile_throughput record whose "
+        "manifest stanza carries the row table + the dispatch "
+        "DB/table/unmeasured audit (see docs/observability.md)",
+    )
+    ap.add_argument(
+        "--kernel-costs-out",
+        default=None,
+        metavar="PATH",
+        help="kernel cost DB path for --profile-kernels (default: "
+        "results/kernel_costs.json, or $HHMM_TPU_KERNEL_COSTS)",
     )
     ap.add_argument(
         "--plan-sweep",
@@ -1228,6 +1440,7 @@ def main() -> None:
         and not args.quick
         and args.scale_sweep is None
         and not args.assoc_sweep
+        and not args.profile_kernels
     ):
         # no accelerator: the full gated bench is a TPU workload (hours
         # on CPU). Emit an honest degraded smoke record and exit 0 so
@@ -1252,6 +1465,10 @@ def main() -> None:
 
     if args.assoc_sweep:
         assoc_sweep(args, backend)
+        return
+
+    if args.profile_kernels:
+        profile_kernels(args, backend)
         return
 
     if args.serve:

@@ -332,9 +332,13 @@ def wf_trade(
     )
 
     def _tp_resolved(b_t: int) -> str:
+        # per-kernel DB families (obs/profile.py): the v component must
+        # resolve exactly as viterbi_dispatch does (kernel="viterbi"),
+        # or a DB whose viterbi winner differs from the filter pair's
+        # would stamp a cache key disagreeing with the branch run
         return (
             f"a{int(use_assoc(model.K, b_t, _tp_alpha))}"
-            f"v{int(use_assoc(model.K, b_t, time_parallel))}"
+            f"v{int(use_assoc(model.K, b_t, time_parallel, kernel='viterbi'))}"
         )
 
     sub = defaultdict(float)  # raw-float sub-profile; rounded once below

@@ -13,13 +13,24 @@ O(T·K³) work (semiring matrix products). Which wins is a measured
 - **small K, long T** (the zig-zag tick windows): the assoc form turns
   the longest serial dependency in the system into log-depth work.
 
-``scripts/tpu_assoc_probe.py`` measures the crossover per backend and
-writes `results/assoc_crossover.json`; the table below records the
-measured values (methodology and the full grids are in
-`docs/parallel_scan.md`). Every consumer takes ``time_parallel=`` —
-``"auto"`` (table lookup, the default), ``True`` (force assoc), or
-``False`` (force scan) — so callers can override per call. Shapes are
-static under ``jit``, so dispatch is plain Python with zero trace cost.
+Measured crossover sources, in priority order (``"auto"`` only —
+explicit ``True``/``False`` always wins, then an active plan scope):
+
+1. **the kernel cost database** (`hhmm_tpu/obs/profile.py`,
+   ``results/kernel_costs.json``) — rows written by
+   ``bench.py --profile-kernels`` and `scripts/tpu_assoc_probe.py`; a
+   populated row for this exact (kernel, K, T) on the CURRENT
+   ``device_kind`` decides the branch. A TPU probe run lands directly
+   in dispatch without a code change.
+2. **the checked-in ``ASSOC_CROSSOVER`` table** below — the hand-pasted
+   fallback for points/hosts the DB hasn't measured (methodology and
+   the full grids are in `docs/parallel_scan.md`).
+
+Every consumer takes ``time_parallel=`` — ``"auto"`` (measured lookup,
+the default), ``True`` (force assoc), or ``False`` (force scan) — so
+callers can override per call. Shapes are static under ``jit``, so
+dispatch is plain Python with zero trace cost (the DB read is memoized
+per (kernel, K, T) in `obs/profile.py`).
 """
 
 from __future__ import annotations
@@ -41,12 +52,14 @@ from hhmm_tpu.kernels.assoc import (
 from hhmm_tpu.kernels.ffbs import backward_sample, ffbs_fused
 from hhmm_tpu.kernels.filtering import backward_pass, forward_backward, forward_filter
 from hhmm_tpu.kernels.viterbi import viterbi
+from hhmm_tpu.obs import profile as obs_profile
 from hhmm_tpu.obs.trace import span
 
 __all__ = [
     "ASSOC_CROSSOVER",
     "plan_time_parallel",
     "use_assoc",
+    "resolve_auto",
     "forward_filter_dispatch",
     "backward_dispatch",
     "smooth_dispatch",
@@ -93,6 +106,12 @@ def _branch_span(name: str, branch: str, K: int, T: int):
 # on an unmeasured bet. `time_parallel=True` is the explicit opt-in;
 # a stale table is visible, not silent: `bench.py --assoc-sweep`
 # records `winner` next to `dispatch_auto` per (K, T) point.
+#
+# NOTE this table is now the FALLBACK: a populated kernel-cost-DB row
+# (obs/profile.py, results/kernel_costs.json) for the current
+# device_kind wins over it, so a TPU probe run fills the "tpu row"
+# through the DB without touching this constant (docs/parallel_scan.md
+# runbook). The table remains for hosts/points the DB hasn't measured.
 ASSOC_CROSSOVER = {
     "cpu": (),
     "tpu": (),
@@ -112,6 +131,24 @@ def _platform() -> str:
     if _PLATFORM_CACHE is None:
         _PLATFORM_CACHE = jax.default_backend()
     return _PLATFORM_CACHE
+
+
+# per-process device-kind cache (same rationale as _platform): the
+# kernel cost DB keys rows by device_kind — the finer identity the
+# backend name lacks ("tpu" says nothing about v4 vs v5e, and their
+# crossovers differ) — and it cannot change after backend init
+_DEVICE_KIND_CACHE: Optional[str] = None
+
+
+def _device_kind() -> Optional[str]:
+    global _DEVICE_KIND_CACHE
+    if _DEVICE_KIND_CACHE is None:
+        try:
+            devices = jax.devices()
+            _DEVICE_KIND_CACHE = devices[0].device_kind if devices else ""
+        except Exception:  # dead backend: dispatch still works off the table
+            _DEVICE_KIND_CACHE = ""
+    return _DEVICE_KIND_CACHE or None
 
 
 # planner override (hhmm_tpu/plan): while a Plan's dispatch_scope() is
@@ -144,27 +181,68 @@ def use_assoc(
     T: int,
     time_parallel: TimeParallel = "auto",
     platform: Optional[str] = None,
+    kernel: str = "filter",
 ) -> bool:
     """Resolve a ``time_parallel`` setting to a concrete choice for a
     (K, T) shape: explicit ``True``/``False`` pass through; ``"auto"``
-    defers to an active plan scope (:func:`plan_time_parallel`), else
-    consults the measured crossover table for the active backend."""
+    defers to an active plan scope (:func:`plan_time_parallel`), then
+    to a measured kernel-cost-DB row for the current device kind
+    (`obs/profile.py`), then to the checked-in crossover table for the
+    active backend. ``kernel`` names the DB row family this dispatch
+    belongs to (``"filter"`` / ``"viterbi"`` / ``"ffbs"``)."""
     if time_parallel is True or time_parallel is False:
         return time_parallel
     if time_parallel != "auto":
         raise ValueError(
             f"time_parallel must be True, False, or 'auto', got {time_parallel!r}"
         )
+    return resolve_auto(K, T, kernel=kernel, platform=platform)[0]
+
+
+def resolve_auto(
+    K: int,
+    T: int,
+    *,
+    kernel: str = "filter",
+    platform: Optional[str] = None,
+) -> Tuple[bool, str]:
+    """``(use_assoc, source)`` for an ``"auto"`` dispatch at (K, T):
+    the branch decision plus WHERE it came from — ``"plan"`` (an
+    active :func:`plan_time_parallel` scope), ``"db"`` (a measured
+    kernel-cost-DB row for this device kind), ``"table"`` (the
+    checked-in ``ASSOC_CROSSOVER`` fallback matched a row), or
+    ``"default"`` (nothing measured anywhere: the sequential scan).
+    The source is the observability surface — ``bench.py
+    --profile-kernels`` stamps it into its manifest stanza and
+    `scripts/obs_report.py` renders which branches are DB-backed vs
+    table-backed vs unmeasured."""
     plan_value = getattr(_PLAN_TLS, "value", None)
     if plan_value is not None:
-        return plan_value
+        return bool(plan_value), "plan"
+    # the DB holds rows keyed by THIS host's device kind — it can only
+    # answer for the local platform. A caller asking about a foreign
+    # platform (planner what-ifs, tests pinning a table) must get that
+    # platform's table, not the local hardware's measurement. And a
+    # kernel only ever resolves from ITS OWN measured rows — routing
+    # viterbi/ffbs onto assoc off a filter-only measurement would be
+    # exactly the unmeasured bet (per-draw [T-1, K, K]
+    # materialization, the round-4 HBM regression) the old
+    # both-kernels crossover rule existed to forbid. (backward/smooth
+    # dispatch under kernel="filter" deliberately: the backward pass
+    # IS the filter combine run in suffix order — same cost shape.)
+    if platform is None or platform == _platform():
+        hint = obs_profile.dispatch_winner(kernel, K, T, _device_kind())
+        if hint is not None:
+            return bool(hint), "db"
     table = ASSOC_CROSSOVER.get(
         platform or _platform(), ASSOC_CROSSOVER["default"]
     )
     for k_max, t_min in table:
         if K <= k_max:
-            return T >= t_min
-    return False
+            return T >= t_min, "table"
+    # fall-through (empty table, or K above every row): nothing
+    # measured for this point — the sequential scan, labeled as such
+    return False, "default"
 
 
 def forward_filter_dispatch(
@@ -214,7 +292,7 @@ def viterbi_dispatch(
     """:func:`~hhmm_tpu.kernels.viterbi.viterbi` contract with
     crossover routing."""
     T, K = log_obs.shape
-    if use_assoc(K, T, time_parallel):
+    if use_assoc(K, T, time_parallel, kernel="viterbi"):
         with _branch_span("viterbi", "assoc", K, T):
             return viterbi_assoc(log_pi, log_A, log_obs, mask)
     with _branch_span("viterbi", "seq", K, T):
@@ -268,7 +346,7 @@ def ffbs_dispatch(
     tp = time_parallel
     if tp == "auto" and _fused_ffbs_likely(log_pi, log_A, log_obs):
         tp = False
-    if use_assoc(K, T, tp):
+    if use_assoc(K, T, tp, kernel="ffbs"):
         with _branch_span("ffbs", "assoc", K, T):
             return ffbs_assoc_sample(
                 key, log_pi, log_A, log_obs, mask, gate_key, state_key

@@ -22,11 +22,17 @@ The cross-cutting layer that answers, for any run of the engine,
   compile counts, peak memory, metrics snapshot) written atomically
   next to results; the provenance record `scripts/bench_diff.py`
   gates regressions on.
+- `obs/profile.py` — the device-time plane: the one canonical
+  ``device_time`` harness (warmup/compile split, fresh pre-staged
+  inputs, ``block_until_ready``, exact-order-statistic p50/min), XLA
+  ``cost_analysis`` extraction + roofline fractions, and the
+  persistent kernel cost database (``results/kernel_costs.json``)
+  that `kernels/dispatch.py` reads as its measured crossover source.
 
 See `docs/observability.md`.
 """
 
-from hhmm_tpu.obs import manifest, metrics, telemetry, trace
+from hhmm_tpu.obs import manifest, metrics, profile, telemetry, trace
 from hhmm_tpu.obs.manifest import (
     MANIFEST_VERSION,
     collect_manifest,
@@ -54,6 +60,7 @@ from hhmm_tpu.obs.trace import Tracer, event, perf_counter, span, traced, tracer
 __all__ = [
     "manifest",
     "metrics",
+    "profile",
     "telemetry",
     "trace",
     "Counter",

@@ -1,0 +1,656 @@
+"""Device-time profiling plane: one timing harness, XLA cost analysis,
+and the persistent kernel cost database the dispatch layer reads.
+
+The obs stack so far sees the *host* side — spans (`obs/trace.py`),
+compile events (`obs/telemetry.py`), statistical health
+(`obs/metrics.py`) — but every *device*-time number in the repo was an
+ad-hoc ``perf_counter``-around-``block_until_ready`` loop scattered
+across `bench.py` / the probe scripts, and the measured crossover table
+`kernels/dispatch.py` bets real decode throughput on was a hand-pasted
+constant. This module makes device time a first-class observed
+artifact:
+
+1. **One canonical timing harness** — :func:`device_time`: explicit
+   warmup/compile split (the warmup call is timed separately and never
+   pollutes the measurement), fresh pre-staged device inputs per rep
+   (``arg_sets`` — the tunnel discipline of `scripts/tpu_*_probe.py`:
+   a memoizing device tunnel must never be handed a byte-identical
+   request inside the timed window), ``block_until_ready`` around every
+   timed call, and exact-order-statistic p50/min over the per-rep
+   durations (the `obs/trace.py` percentile discipline — no
+   interpolation, deterministic for a given duration sequence).
+   `scripts/check_guards.py` invariant 9 confines raw timing loops to
+   this module: everything under ``hhmm_tpu/`` times through here.
+
+2. **Static cost extraction** — :func:`cost_analysis`:
+   ``jitted.lower(*args).compile().cost_analysis()`` normalized across
+   jax versions (dict vs one-element list) and None-tolerant where XLA
+   doesn't report (CPU backends often return nothing useful; a missing
+   counter degrades the row to timing-only, never an exception), plus
+   :func:`roofline` utilization against a small per-``device_kind``
+   peak table (:data:`PEAKS` — the `bench.py` v5e constants promoted to
+   a shared table; entries are *documented spec sheets*, not
+   measurements, and an unknown device kind yields ``None`` rather
+   than a made-up fraction).
+
+3. **The kernel cost database** — :class:`KernelCostDB` over
+   ``results/kernel_costs.json``: rows keyed
+   ``(kernel, branch, K, T, B, dtype, device_kind, jax)`` — the
+   `obs/manifest.py` comparability discipline applied to kernel
+   timings — written atomically (`obs/trace.py`
+   ``atomic_write_text``) and loaded corrupt-tolerantly (a torn file is
+   quarantined aside as ``.corrupt`` and reads as empty, the
+   `batch/cache.py` rule). Writers: ``bench.py --profile-kernels``,
+   `scripts/tpu_assoc_probe.py`, and any TPU run of either — the DB is
+   self-populating. Reader: `kernels/dispatch.py` resolves ``"auto"``
+   from a populated row for the **current** ``device_kind`` before
+   falling back to the checked-in ``ASSOC_CROSSOVER`` table
+   (:func:`dispatch_winner`); a row measured on different hardware
+   never decides this host's dispatch.
+
+Importable without jax (the lazy-import discipline of `obs/trace.py` /
+`obs/manifest.py`): only :func:`device_time` and :func:`cost_analysis`
+touch jax, and only when called.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from hhmm_tpu.obs.trace import atomic_write_text, perf_counter
+
+__all__ = [
+    "KERNEL_COSTS_VERSION",
+    "DeviceTiming",
+    "device_time",
+    "cost_analysis",
+    "PEAKS",
+    "roofline",
+    "decode_kernel_pairs",
+    "dirichlet_hmm_inputs",
+    "row_key",
+    "KernelCostDB",
+    "default_db_path",
+    "active_db",
+    "set_db",
+    "refresh",
+    "dispatch_winner",
+]
+
+KERNEL_COSTS_VERSION = 1
+
+_ENV_DB_PATH = "HHMM_TPU_KERNEL_COSTS"
+
+
+# ---------------------------------------------------------------------------
+# 1. the canonical timing harness
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class DeviceTiming:
+    """One :func:`device_time` measurement. ``compile_s`` is the
+    warmup call (compile + first run) when a warmup ran, else ``None``
+    — it is reported, never folded into the rep statistics."""
+
+    reps: int
+    mean_s: float
+    p50_s: float
+    min_s: float
+    max_s: float
+    compile_s: Optional[float]
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "reps": self.reps,
+            "mean_s": round(self.mean_s, 9),
+            "p50_s": round(self.p50_s, 9),
+            "min_s": round(self.min_s, 9),
+            "max_s": round(self.max_s, 9),
+            "compile_s": (
+                None if self.compile_s is None else round(self.compile_s, 6)
+            ),
+        }
+
+
+def device_time(
+    fn,
+    *args,
+    reps: int = 5,
+    arg_sets: Optional[Sequence[Tuple]] = None,
+    warmup: bool = True,
+) -> DeviceTiming:
+    """Time ``fn`` on device: the one sanctioned
+    ``perf_counter``-around-``block_until_ready`` loop
+    (`scripts/check_guards.py` invariant 9).
+
+    ``arg_sets``: pre-staged argument tuples, one consumed per rep
+    (cycled when shorter) — fresh inputs defeat request memoization in
+    the device tunnel (the `tpu_assoc_probe.py` discipline). When
+    ``warmup`` and more than one set is given, the LAST set is the
+    warmup/compile set and the timed reps cycle the rest, matching the
+    probes' ``compile on set -1`` convention. Without ``arg_sets``,
+    every call reuses ``args`` (fine for warm re-timing of an
+    already-dispatched kernel, e.g. the scheduler's sampled flush
+    profiling — which passes ``warmup=False`` precisely because the
+    kernel is warm and must never be compiled again from a profile
+    probe).
+
+    The duration statistics are exact order statistics over the
+    per-rep wall times (p50 = ``sorted[ceil(0.5 n) - 1]``): p50 and min
+    are the robust reads for a device timing (the mean smears GC/tunnel
+    hiccups into the number the dispatch table bets on).
+    """
+    import jax  # lazy: profile.py must import without jax present
+
+    if reps <= 0:
+        raise ValueError(f"reps must be positive, got {reps}")
+    sets = list(arg_sets) if arg_sets is not None else None
+    if sets is not None and not sets:
+        raise ValueError("arg_sets must be non-empty when given")
+    compile_s: Optional[float] = None
+    if warmup:
+        wargs = sets[-1] if sets else args
+        t0 = perf_counter()
+        jax.block_until_ready(fn(*wargs))
+        compile_s = perf_counter() - t0
+    if sets is not None:
+        timed_sets = sets[:-1] if (warmup and len(sets) > 1) else sets
+    else:
+        timed_sets = None
+    durs: List[float] = []
+    for r in range(reps):
+        cargs = timed_sets[r % len(timed_sets)] if timed_sets else args
+        t0 = perf_counter()
+        jax.block_until_ready(fn(*cargs))
+        durs.append(perf_counter() - t0)
+    ordered = sorted(durs)
+    p50 = ordered[max(0, math.ceil(0.5 * len(ordered)) - 1)]
+    return DeviceTiming(
+        reps=reps,
+        mean_s=sum(durs) / len(durs),
+        p50_s=p50,
+        min_s=ordered[0],
+        max_s=ordered[-1],
+        compile_s=compile_s,
+    )
+
+
+# ---------------------------------------------------------------------------
+# 2. static cost extraction + roofline
+# ---------------------------------------------------------------------------
+
+
+def cost_analysis(fn, *args) -> Dict[str, Optional[float]]:
+    """FLOPs / bytes-accessed for one call signature, from XLA's own
+    compiled-module cost analysis. ``fn`` may be an already-compiled
+    AOT executable (``jitted.lower(...).compile()`` — its own
+    ``cost_analysis()`` is read directly, no recompile), a jitted
+    callable (its ``.lower`` is used), or a plain function (jitted
+    here). Returns a dict with ``flops`` / ``bytes_accessed`` /
+    ``transcendentals`` — any of which may be ``None`` — or ``{}``
+    when the backend reports nothing at all. Never raises: a missing
+    cost model degrades the caller's row to timing-only, it must not
+    kill a profiling sweep."""
+    try:
+        if hasattr(fn, "cost_analysis"):  # AOT Compiled: zero extra work
+            ca = fn.cost_analysis()
+        else:
+            import jax
+
+            jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+            ca = jitted.lower(*args).compile().cost_analysis()
+    except Exception:
+        return {}
+    # older jax returns a one-element list of dicts, newer a flat dict
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return {}
+
+    def pick(*names: str) -> Optional[float]:
+        for n in names:
+            v = ca.get(n)
+            if isinstance(v, (int, float)) and v == v and v >= 0:
+                return float(v)
+        return None
+
+    out = {
+        "flops": pick("flops"),
+        # XLA spells it with a space; tolerate either
+        "bytes_accessed": pick("bytes accessed", "bytes_accessed"),
+        "transcendentals": pick("transcendentals"),
+    }
+    if all(v is None for v in out.values()):
+        return {}
+    return out
+
+
+# Per-device-kind peaks for roofline fractions. Spec-sheet numbers
+# (documented estimates, not measurements): the v5e row is the
+# `bench.py` utilization-model constant pair (f32 MXU peak — the dtype
+# the workloads run in — and HBM bandwidth); the cpu row is a deliberate
+# order-of-magnitude host figure so CPU rows carry a comparable-ish
+# fraction rather than nothing. Unknown device kinds get NO roofline
+# (None beats a made-up denominator).
+PEAKS: Dict[str, Dict[str, float]] = {
+    "TPU v5 lite": {"flops_per_s": 98.5e12, "bytes_per_s": 819e9},
+    "TPU v5e": {"flops_per_s": 98.5e12, "bytes_per_s": 819e9},
+    "TPU v4": {"flops_per_s": 137.5e12, "bytes_per_s": 1228e9},
+    "cpu": {"flops_per_s": 1e11, "bytes_per_s": 5e10},
+}
+
+
+def roofline(
+    cost: Optional[Dict[str, Any]],
+    seconds: Optional[float],
+    device_kind: Optional[str],
+) -> Optional[Dict[str, Any]]:
+    """Achieved-over-peak fractions for one timed call. None-tolerant
+    end to end: no cost counters, no timing, or an unknown device kind
+    all yield ``None`` (a timing-only row), never a fake fraction."""
+    if not cost or not seconds or seconds <= 0 or not device_kind:
+        return None
+    peak = PEAKS.get(device_kind) or PEAKS.get(str(device_kind).lower())
+    if peak is None:
+        return None
+    flops = cost.get("flops")
+    bts = cost.get("bytes_accessed")
+    out: Dict[str, Any] = {"peak_source": device_kind}
+    out["flops_frac"] = (
+        None if flops is None else round(flops / seconds / peak["flops_per_s"], 8)
+    )
+    out["bytes_frac"] = (
+        None if bts is None else round(bts / seconds / peak["bytes_per_s"], 8)
+    )
+    if out["flops_frac"] is None and out["bytes_frac"] is None:
+        return None
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the shared measurement surface for the DB writers
+# ---------------------------------------------------------------------------
+
+
+def decode_kernel_pairs() -> Dict[str, Tuple[Any, Any]]:
+    """``{kernel_name: (seq_fn, assoc_fn)}`` — the decode kernels every
+    cost-DB writer times, defined ONCE. `bench.py --profile-kernels`
+    and `scripts/tpu_assoc_probe.py` both feed rows into the same DB
+    under these (kernel, branch) keys, and :meth:`KernelCostDB.winner`
+    arbitrates across writers — so both writers MUST measure the exact
+    same computation per key (same blocked-on output, same FFBS
+    pre-drawn-uniform convention). Each fn takes
+    ``(log_pi, log_A, log_obs, mask)``. Lazy kernel imports: this
+    module sits below ``kernels/`` in the import graph
+    (`kernels/dispatch.py` imports it)."""
+    import jax
+
+    from hhmm_tpu.kernels import (
+        ffbs_assoc_sample,
+        ffbs_fused,
+        forward_filter,
+        forward_filter_assoc,
+        viterbi,
+        viterbi_assoc,
+    )
+
+    return {
+        "filter": (
+            lambda lp, lA, lo, m: forward_filter(lp, lA, lo, m)[1],
+            lambda lp, lA, lo, m: forward_filter_assoc(lp, lA, lo, m)[1],
+        ),
+        "viterbi": (
+            lambda lp, lA, lo, m: viterbi(lp, lA, lo, m)[0],
+            lambda lp, lA, lo, m: viterbi_assoc(lp, lA, lo, m)[0],
+        ),
+        "ffbs": (
+            lambda lp, lA, lo, m: ffbs_fused(
+                jax.random.PRNGKey(0), lp, lA, lo, m
+            )[0],
+            lambda lp, lA, lo, m: ffbs_assoc_sample(
+                jax.random.PRNGKey(0), lp, lA, lo, m
+            )[0],
+        ),
+    }
+
+
+def dirichlet_hmm_inputs(rng, K: int, T: int, batch: Optional[int] = None):
+    """One fresh f32 input set ``(log_pi, log_A, log_obs, mask)`` for
+    the decode-kernel pairs, staged on device (H2D happens here,
+    outside any timed window) — the shared input convention of both DB
+    writers. ``batch=None`` gives the single-series shapes."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    shp = () if batch is None else (int(batch),)
+    log_pi = jnp.asarray(
+        np.log(rng.dirichlet(np.ones(K), shp or None)), jnp.float32
+    )
+    log_A = jnp.asarray(
+        np.log(rng.dirichlet(np.ones(K), shp + (K,))), jnp.float32
+    )
+    log_obs = jnp.asarray(rng.normal(size=shp + (T, K)) - 1.0, jnp.float32)
+    mask = jnp.ones(shp + (T,), jnp.float32)
+    return log_pi, log_A, log_obs, mask
+
+
+# ---------------------------------------------------------------------------
+# 3. the kernel cost database
+# ---------------------------------------------------------------------------
+
+
+def default_db_path() -> str:
+    """``results/kernel_costs.json`` at the repo root (the package's
+    grandparent), overridable with ``HHMM_TPU_KERNEL_COSTS`` — tests
+    and probe runs point writers at a scratch DB without patching."""
+    env = os.environ.get(_ENV_DB_PATH, "").strip()
+    if env:
+        return env
+    root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(root, "results", "kernel_costs.json")
+
+
+def row_key(
+    kernel: str,
+    branch: str,
+    K: int,
+    T: int,
+    B: int,
+    dtype: str,
+    device_kind: Optional[str],
+    jax_version: Optional[str],
+) -> str:
+    """The row identity: one measured (kernel, branch, shape, dtype,
+    device, jax) point. The stack fields make rows comparable the way
+    `scripts/bench_diff.py` makes bench records comparable — a row
+    measured under a different jax never silently overwrites this
+    one's timing."""
+    return "|".join(
+        [
+            str(kernel),
+            str(branch),
+            f"K{int(K)}",
+            f"T{int(T)}",
+            f"B{int(B)}",
+            str(dtype),
+            str(device_kind),
+            str(jax_version),
+        ]
+    )
+
+
+class KernelCostDB:
+    """Persistent, atomic, manifest-stamped kernel cost store. One JSON
+    file, ``{"version": 1, "rows": {key: row}}``; see module docstring
+    for the writer/reader roster. Not thread-hot: writers are benches
+    and probes, the dispatch read path goes through the module-level
+    memoized :func:`dispatch_winner`."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = str(path) if path else default_db_path()
+        self._rows: Dict[str, Dict[str, Any]] = {}
+        self._loaded = False
+
+    # ---- persistence ----
+
+    def load(self) -> "KernelCostDB":
+        """Read the file (idempotent). Missing → empty; torn/garbage →
+        quarantined aside as ``.corrupt`` with one stderr line and read
+        as empty — a corrupt DB must degrade dispatch to the static
+        table, never wedge it (the `obs/manifest.py` load rule)."""
+        self._loaded = True
+        if not os.path.exists(self.path):
+            return self
+        try:
+            with open(self.path) as f:
+                d = json.load(f)
+            if (
+                not isinstance(d, dict)
+                or "version" not in d
+                or not isinstance(d.get("rows"), dict)
+            ):
+                raise ValueError("not a kernel cost DB (no version/rows)")
+        except (OSError, ValueError) as e:
+            print(
+                f"# kernel_costs: dropping corrupt DB "
+                f"{os.path.basename(self.path)} ({type(e).__name__}: {e})",
+                file=sys.stderr,
+                flush=True,
+            )
+            try:
+                os.replace(self.path, self.path + ".corrupt")
+            except OSError:
+                pass
+            return self
+        self._rows = {str(k): v for k, v in d["rows"].items() if isinstance(v, dict)}
+        return self
+
+    def save(self) -> None:
+        """Atomic write (temp + fsync + replace via the shared
+        `obs/trace.py` writer) so a reader — including a concurrently
+        dispatching process — can never observe a half-written DB."""
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)), exist_ok=True)
+        doc = {
+            "version": KERNEL_COSTS_VERSION,
+            "updated": time.strftime("%F %T"),
+            "rows": {k: self._rows[k] for k in sorted(self._rows)},
+        }
+        atomic_write_text(self.path, json.dumps(doc, indent=1, sort_keys=False) + "\n")
+        _invalidate_winner_cache()
+
+    # ---- rows ----
+
+    def rows(self) -> Dict[str, Dict[str, Any]]:
+        if not self._loaded:
+            self.load()
+        return dict(self._rows)
+
+    def put_row(
+        self,
+        *,
+        kernel: str,
+        branch: str,
+        K: int,
+        T: int,
+        B: int,
+        dtype: str,
+        timing: Optional[DeviceTiming] = None,
+        cost: Optional[Dict[str, Any]] = None,
+        roofline_frac: Optional[Dict[str, Any]] = None,
+        device_kind: Optional[str] = None,
+        source: str = "unknown",
+        extra: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        """Insert/replace one measured row, stamped with the current
+        stack identity (`obs/manifest.py` ``stack_versions`` /
+        ``device_info`` — jax-tolerant, so a stamp on a jax-less host
+        simply records less). Returns the stored row (key included)."""
+        from hhmm_tpu.obs.manifest import device_info, stack_versions
+
+        if not self._loaded:
+            self.load()
+        versions = stack_versions()
+        dev = device_info()
+        dk = device_kind if device_kind is not None else dev.get("device_kind")
+        key = row_key(kernel, branch, K, T, B, dtype, dk, versions.get("jax"))
+        row: Dict[str, Any] = {
+            "key": key,
+            "kernel": str(kernel),
+            "branch": str(branch),
+            "K": int(K),
+            "T": int(T),
+            "B": int(B),
+            "dtype": str(dtype),
+            "device_kind": dk,
+            "backend": dev.get("backend"),
+            "jax": versions.get("jax"),
+            "jaxlib": versions.get("jaxlib"),
+            "timing": timing.to_json() if timing is not None else None,
+            "cost": cost if cost else None,
+            "roofline": roofline_frac if roofline_frac else None,
+            "source": str(source),
+            "ts": time.strftime("%F %T"),
+        }
+        if extra:
+            row.update(extra)
+        self._rows[key] = row
+        _invalidate_winner_cache()
+        return row
+
+    # ---- dispatch-facing reads ----
+
+    def matching(
+        self, kernel: str, K: int, T: int, device_kind: Optional[str]
+    ) -> List[Dict[str, Any]]:
+        """Rows for one (kernel, K, T) on one device kind — the only
+        match axes dispatch cares about; B/dtype/jax variants all
+        qualify and :meth:`winner` arbitrates among them."""
+        if not self._loaded:
+            self.load()
+        out = []
+        for row in self._rows.values():
+            if (
+                row.get("kernel") == kernel
+                and row.get("K") == int(K)
+                and row.get("T") == int(T)
+                and row.get("device_kind") == device_kind
+            ):
+                out.append(row)
+        return out
+
+    def winner(
+        self, kernel: str, K: int, T: int, device_kind: Optional[str]
+    ) -> Optional[str]:
+        """``"assoc"`` / ``"seq"`` / ``None``: the measured branch
+        winner at one (kernel, K, T) point on ``device_kind``.
+
+        Branches are only compared within one (B, dtype, jax) stamp —
+        the comparability rule: a seq row timed at B=64 must not race
+        an assoc row timed single-series. Among complete pairs the
+        LARGEST batch wins the arbitration (the batched crossover is
+        the honest dispatch default — `docs/parallel_scan.md`), ties
+        broken by the NEWEST measurement (row ``ts``; the "%F %T"
+        stamp sorts lexicographically in time order — a re-probe after
+        a jax upgrade must outrank the obsolete pair, and a naive jax
+        version-string compare would rank "0.4.9" over "0.4.30").
+        Timing-only rows need a finite ``p50_s``; anything less yields
+        ``None`` (unmeasured — the caller falls back to the static
+        table)."""
+        if device_kind is None:
+            return None
+        pairs: Dict[Tuple[int, str, str], Dict[str, float]] = {}
+        pair_ts: Dict[Tuple[int, str, str], str] = {}
+        for row in self.matching(kernel, K, T, device_kind):
+            t = row.get("timing") or {}
+            p50 = t.get("p50_s")
+            if not isinstance(p50, (int, float)) or not math.isfinite(p50) or p50 <= 0:
+                continue
+            base = (int(row.get("B") or 0), str(row.get("dtype")), str(row.get("jax")))
+            pairs.setdefault(base, {})[str(row.get("branch"))] = float(p50)
+            ts = str(row.get("ts") or "")
+            if ts > pair_ts.get(base, ""):
+                pair_ts[base] = ts
+        complete = [
+            (base, d) for base, d in pairs.items() if "seq" in d and "assoc" in d
+        ]
+        if not complete:
+            return None
+        complete.sort(
+            key=lambda it: (it[0][0], pair_ts.get(it[0], ""), it[0][1], it[0][2])
+        )
+        _, best = complete[-1]
+        return "assoc" if best["assoc"] < best["seq"] else "seq"
+
+
+# ---------------------------------------------------------------------------
+# module-level DB binding (what kernels/dispatch.py reads)
+# ---------------------------------------------------------------------------
+
+_DB_LOCK = threading.Lock()
+_ACTIVE_DB: Optional[KernelCostDB] = None
+_WINNER_CACHE: Dict[Tuple[str, int, int, Optional[str]], Optional[str]] = {}
+_MISSING = object()
+
+
+def _invalidate_winner_cache() -> None:
+    # under the same lock the miss path computes-and-stores under: an
+    # invalidation can never interleave between a stale compute and its
+    # cache write (the last-writer-clobber class the plan scope and
+    # fault stack already guard against)
+    with _DB_LOCK:
+        _WINNER_CACHE.clear()
+
+
+def active_db() -> KernelCostDB:
+    """The process-wide DB the dispatch layer consults — loaded lazily
+    from :func:`default_db_path` on first use."""
+    global _ACTIVE_DB
+    with _DB_LOCK:
+        if _ACTIVE_DB is None:
+            _ACTIVE_DB = KernelCostDB().load()
+        return _ACTIVE_DB
+
+
+def set_db(db) -> None:
+    """Re-bind the active DB: a :class:`KernelCostDB`, a path, or
+    ``None`` to restore the default-path binding. The injection point
+    for tests (flip a dispatch winner with a scratch DB) and for
+    ``bench.py --profile-kernels --kernel-costs-out``."""
+    global _ACTIVE_DB
+    loaded = (
+        db
+        if db is None or isinstance(db, KernelCostDB)
+        else KernelCostDB(str(db)).load()
+    )
+    with _DB_LOCK:
+        _ACTIVE_DB = loaded
+        _WINNER_CACHE.clear()
+
+
+def refresh() -> None:
+    """Re-read the active DB from disk (a probe or bench in this or
+    another process just wrote rows) and drop the memoized winners."""
+    global _ACTIVE_DB
+    with _DB_LOCK:
+        path = None if _ACTIVE_DB is None else _ACTIVE_DB.path
+        _WINNER_CACHE.clear()
+    if path is not None:
+        set_db(KernelCostDB(path).load())
+
+
+def dispatch_winner(
+    kernel: str, K: int, T: int, device_kind: Optional[str]
+) -> Optional[bool]:
+    """The dispatch-facing read: ``True`` (assoc) / ``False`` (seq)
+    when the DB holds a measured winner for this exact (kernel, K, T)
+    on this host's device kind, else ``None`` (fall back to the static
+    table). Memoized — `kernels/dispatch.py` calls this once per draw
+    per kernel at trace time and the answer cannot change between DB
+    writes. The miss path computes AND stores under ``_DB_LOCK`` — the
+    same lock every invalidation (:func:`set_db` / :func:`refresh` /
+    row writes) clears under — so a concurrent rebind can never
+    interleave between a stale compute and its cache write and pin the
+    pre-refresh answer; the hit path stays lock-free."""
+    global _ACTIVE_DB
+    ck = (str(kernel), int(K), int(T), device_kind)
+    w = _WINNER_CACHE.get(ck, _MISSING)
+    if w is _MISSING:
+        with _DB_LOCK:
+            w = _WINNER_CACHE.get(ck, _MISSING)
+            if w is _MISSING:
+                if _ACTIVE_DB is None:
+                    _ACTIVE_DB = KernelCostDB().load()
+                w = _ACTIVE_DB.winner(kernel, K, T, device_kind)
+                _WINNER_CACHE[ck] = w
+    if w is None:
+        return None
+    return w == "assoc"

@@ -305,14 +305,29 @@ class Plan:
 
 def _resolve_branch(shape: WorkloadShape, sp_ways: int, time_parallel, platform):
     """The time-parallel kernel branch this plan resolves to, via the
-    measured crossover table (`kernels/dispatch.py`)."""
+    measured crossover sources (`kernels/dispatch.py`: kernel cost DB,
+    then the checked-in table).
+
+    The plan's branch is ONE decision pinned onto EVERY kernel that
+    dispatches under ``plan.dispatch_scope()`` — so it is resolved at
+    the conservative bar: assoc only when ALL the decode families the
+    pin will govern (filter, viterbi, ffbs) resolve assoc for this
+    (K, T). A partial-family DB win must not route the others into
+    per-draw [T-1, K, K] operator materialization (the round-4 HBM
+    regression) through the planner pin — the same unmeasured bet the
+    per-kernel dispatch rule forbids at the direct call sites. (On a
+    table-only host every family reads the same table row, so this
+    reduces exactly to the pre-DB behavior.)"""
     if sp_ways > 1:
         return "seqshard"
     from hhmm_tpu.kernels.dispatch import use_assoc
 
     return (
         "assoc"
-        if use_assoc(shape.K, shape.T, time_parallel, platform)
+        if all(
+            use_assoc(shape.K, shape.T, time_parallel, platform, kernel=k)
+            for k in ("filter", "viterbi", "ffbs")
+        )
         else "scan"
     )
 

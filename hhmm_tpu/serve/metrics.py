@@ -75,6 +75,11 @@ class ServeMetrics:
         self._rejected_attaches = obs_metrics.Counter()
         self._dispatch_errors = obs_metrics.Counter()
         self._device_loss_events = obs_metrics.Counter()
+        # sampled flush profiling (obs/profile.py device_time through
+        # the scheduler's profile_every knob): how many flushes were
+        # re-timed; the per-(kernel, bucket) device-time gauges go to
+        # the shared plane directly in note_flush_profile
+        self._profiled_flushes = obs_metrics.Counter()
         # snapshot staleness (ROADMAP item 3): seconds since the oldest
         # serving snapshot was attached, written by the scheduler per
         # flush; the peak is the SLO-facing watermark for the window
@@ -100,6 +105,7 @@ class ServeMetrics:
             ("serve.dispatch_errors", self._dispatch_errors),
             ("serve.device_loss_events", self._device_loss_events),
             ("serve.snapshot_staleness_seconds", self._staleness),
+            ("serve.profiled_flushes", self._profiled_flushes),
         ):
             obs_metrics.attach(name, inst)
 
@@ -228,6 +234,23 @@ class ServeMetrics:
         """A dispatch failure classified as device loss (simulated or
         real UNAVAILABLE) was absorbed by the flush path."""
         self._device_loss_events.inc()
+
+    @property
+    def profiled_flushes(self) -> int:
+        return int(self._profiled_flushes.get())
+
+    def note_flush_profile(self, kernel: str, bucket: int, p50_s: float) -> None:
+        """One sampled flush re-timed its dispatched kernel through the
+        `obs/profile.py` harness. The per-(kernel, bucket) device time
+        goes to the shared plane as a labeled gauge — profiling only
+        runs with the tracer on (scheduler contract), which is exactly
+        when the registry's instrument route is live; the counter is an
+        attached product metric either way. NOT in ``summary()`` — its
+        schema is frozen."""
+        self._profiled_flushes.inc()
+        obs_metrics.gauge(
+            "serve.flush_device_time_ms", kernel=kernel, bucket=bucket
+        ).set(round(float(p50_s) * 1e3, 4))
 
     @property
     def compile_count(self) -> int:

@@ -68,7 +68,9 @@ import jax.numpy as jnp
 
 from hhmm_tpu.batch.pad import pad_ragged
 from hhmm_tpu.core.lmath import safe_log_normalize
+from hhmm_tpu.obs import profile as obs_profile
 from hhmm_tpu.obs.telemetry import register_jit
+from hhmm_tpu.obs.trace import enabled as trace_enabled
 from hhmm_tpu.obs.trace import span, traced
 from hhmm_tpu.robust import faults
 from hhmm_tpu.robust.guards import finite_mask, guard_update
@@ -168,6 +170,7 @@ class MicroBatchScheduler:
         plan=None,
         admission: Optional[AdmissionPolicy] = None,
         pager=None,
+        profile_every: int = 0,
     ):
         """``plan``: an optional :class:`hhmm_tpu.plan.Plan` — the
         topology-aware placement decision (`docs/sharding.md`). When
@@ -185,7 +188,19 @@ class MicroBatchScheduler:
         ``pager``: a :class:`hhmm_tpu.serve.pager.SnapshotPager` —
         snapshot residency becomes budget-bounded, evictions detach,
         and ``submit`` transparently pages unknown-but-registered
-        series in."""
+        series in.
+
+        ``profile_every``: sampled flush profiling (`obs/profile.py`,
+        the kernel cost plane) — every Nth flush re-times the flush's
+        LAST dispatched kernel through the canonical ``device_time``
+        harness on the same already-staged inputs. 0 (the default)
+        disables it, and it only ever fires while the tracer is
+        enabled (``HHMM_TPU_TRACE=1``): untraced production serving
+        pays one attribute read per flush. Because the re-timed call
+        repeats an already-dispatched signature it can NEVER add an
+        XLA compile (asserted in ``tests/test_profile.py``); the p50
+        lands in the ``serve.flush_device_time_ms{kernel=,bucket=}``
+        gauge + a ``serve.flush_profile`` span."""
         if buckets is None:
             buckets = plan.buckets if plan is not None else (8, 32, 128)
         if not buckets or any(b <= 0 for b in buckets):
@@ -205,6 +220,16 @@ class MicroBatchScheduler:
             admission = AdmissionPolicy.from_plan(plan)
         self.admission = admission
         self.pager = pager
+        self.profile_every = int(profile_every or 0)
+        if self.profile_every < 0:
+            raise ValueError(
+                f"profile_every must be >= 0, got {profile_every}"
+            )
+        self._profile_seq = 0
+        # (kernel name, bucket, jitted fn, staged args) of the newest
+        # successful dispatch — what a sampled profile re-times; holds
+        # one flush's device arrays at most (replaced per dispatch)
+        self._last_dispatch: Optional[Tuple[str, int, Any, tuple]] = None
         if pager is not None:
             # eviction releases the series end-to-end: draw bank, stream
             # state, staleness entry, queued ticks (shed) — detach()
@@ -903,8 +928,48 @@ class MicroBatchScheduler:
             # residency back under the byte budget now, not at the next
             # page-in (a pin-heavy flush may have overrun transiently)
             self.pager.shrink_to_budget()
+        self._maybe_profile_flush()
         self._refresh_compile_count()
         return carried + responses
+
+    def _maybe_profile_flush(self) -> None:
+        """Sampled flush profiling (the kernel cost plane's serving
+        probe): every ``profile_every``-th flush with a successful
+        dispatch re-times that dispatch through
+        :func:`hhmm_tpu.obs.profile.device_time` — warm signature,
+        same staged inputs, ``warmup=False`` — so the read is pure
+        device re-execution time with zero compile risk. Gated on the
+        tracer: profiling is debug telemetry, and untraced serving
+        must pay nothing beyond this method's first two checks.
+        Telemetry never raises into the hot path."""
+        if not self.profile_every or self._last_dispatch is None:
+            return
+        if not trace_enabled():
+            # tracer turned off since the dispatch stored its target:
+            # release the pinned arrays rather than holding them for a
+            # profiler that can no longer fire
+            self._last_dispatch = None
+            return
+        self._profile_seq += 1
+        if self._profile_seq % self.profile_every:
+            return
+        kernel, bucket, fn, fargs = self._last_dispatch
+        # one sample per dispatch: a run of dispatch-less flushes (all
+        # shed) must not keep re-profiling a stale kernel and counting
+        # phantom profiled flushes — consume the target and release
+        # its pinned device arrays
+        self._last_dispatch = None
+        try:
+            timing = obs_profile.device_time(fn, *fargs, reps=2, warmup=False)
+        except Exception:  # a profile probe must never shed real ticks
+            return
+        self.metrics.note_flush_profile(kernel, bucket, timing.p50_s)
+        with span("serve.flush_profile") as sp:
+            sp.annotate(
+                kernel=kernel,
+                bucket=bucket,
+                p50_ms=round(timing.p50_s * 1e3, 4),
+            )
 
     def _dispatch(self, group, kernel: str) -> List[TickResponse]:
         if not group:
@@ -961,17 +1026,28 @@ class MicroBatchScheduler:
         faults.dispatch_fault()
         with span(f"serve.dispatch.{kernel}") as sp:
             sp.annotate(bucket=bn, sharded=sharded)
+            # the per-lane state stacking stays INSIDE the span: it is
+            # part of what a dispatch costs, and the span table must
+            # keep measuring the same region across refactors
             if kernel == "init":
-                out = self._init_j(draws_b, obs_b)
+                fn, fargs = self._init_j, (draws_b, obs_b)
             else:
                 alpha_b = place(
                     jnp.stack([self._series[s]["alpha"] for s, _, _ in lanes])
                 )
                 ll_b = place(jnp.stack([self._series[s]["ll"] for s, _, _ in lanes]))
                 ok_b = place(jnp.stack([self._series[s]["ok"] for s, _, _ in lanes]))
-                out = self._update_j(draws_b, alpha_b, ll_b, ok_b, obs_b)
-            alpha, ll, okd, probs, mean_ll = jax.block_until_ready(out)
+                fn, fargs = self._update_j, (draws_b, alpha_b, ll_b, ok_b, obs_b)
+            alpha, ll, okd, probs, mean_ll = jax.block_until_ready(fn(*fargs))
         self._obs_dtypes.update(dtype_locks)  # dispatch succeeded
+        if self.profile_every and trace_enabled():
+            # the sampled-flush profile target: this exact warm
+            # signature with these exact staged inputs (re-timing it
+            # cannot compile). Held ONLY when profiling can actually
+            # fire (knob set AND tracer on) — otherwise a production
+            # scheduler would pin a flush's device arrays for a
+            # profiler that will never run.
+            self._last_dispatch = (kernel, bn, fn, fargs)
         # dtype-aware signature: the fallback compile audit (no
         # _cache_size on the jitted fn) must see dtype-promotion
         # retraces, not just bucket shapes

@@ -50,6 +50,16 @@ Further gate rules:
   followed by a record with ``faults_escaped > 0`` — an injected fault
   leaking out as an exception is a survival regression even if the
   bench somehow exited 0.
+- **Kernel device time gates inverted**: a record whose manifest
+  stanza carries a ``kernel_costs`` table (`bench.py
+  --profile-kernels`, `hhmm_tpu/obs/profile.py`) fails the gate when
+  a row's measured ``p50_ms`` GREW by more than the threshold against
+  the same row (kernel/branch/K/T/B/dtype) of the previous comparable
+  record — device time is lower-is-better, so the throughput
+  threshold applies with the sign flipped. Rows without a measured
+  p50 (unmeasured) ride along ungated, and rows whose XLA cost
+  analysis came back empty are reported as timing-only (they still
+  gate on time — only the roofline column is blind).
 
 Exit codes: 0 clean (or nothing comparable), 1 regression, 2 usage/IO
 error. No jax import — this runs in CI guards and pre-push hooks.
@@ -168,6 +178,7 @@ def diff(
     last_by_key: Dict[Tuple, Dict[str, Any]] = {}
     last_slo_by_key: Dict[Tuple, bool] = {}
     last_escaped_by_key: Dict[Tuple, int] = {}
+    last_costs_by_key: Dict[Tuple, Dict[str, float]] = {}
     failures = 0
     for rnd in rounds:
         rec = rnd["record"]
@@ -281,6 +292,60 @@ def diff(
                 else:
                     row["status"] += "; faults contained"
                 last_escaped_by_key[key] = esc
+            # kernel device time rides the same key, gated INVERTED:
+            # a measured row whose p50 grew past the threshold against
+            # the previous comparable record's same row is a device-
+            # time regression (obs/profile.py cost plane)
+            kc = (rec.get("manifest") or {}).get("kernel_costs")
+            if isinstance(kc, dict) and isinstance(kc.get("rows"), list):
+                prev_rows = last_costs_by_key.get(key) or {}
+                cur_rows: Dict[str, float] = {}
+                regressions = []
+                n_gated_rows = n_unmeasured = n_timing_only = 0
+                for kr in kc["rows"]:
+                    if not isinstance(kr, dict):
+                        continue
+                    rk = "|".join(
+                        str(kr.get(f))
+                        for f in ("kernel", "branch", "K", "T", "B", "dtype")
+                    )
+                    p50 = kr.get("p50_ms")
+                    if not isinstance(p50, (int, float)) or p50 <= 0:
+                        n_unmeasured += 1
+                        continue
+                    if kr.get("timing_only"):
+                        n_timing_only += 1
+                    cur_rows[rk] = float(p50)
+                    pv = prev_rows.get(rk)
+                    if pv:
+                        n_gated_rows += 1
+                        delta = 100.0 * (p50 - pv) / pv
+                        if delta > threshold_pct:
+                            regressions.append(f"{rk} {delta:+.1f}%")
+                if regressions:
+                    failures += 1
+                    row["gated"] = True
+                    row["status"] += (
+                        "; DEVICE-TIME REGRESSION: "
+                        + ", ".join(regressions)
+                        + f" (threshold +{threshold_pct:g}%)"
+                    )
+                elif n_gated_rows:
+                    row["status"] += f"; kernel costs ok ({n_gated_rows} row(s))"
+                elif cur_rows:
+                    row["status"] += (
+                        f"; kernel-cost baseline ({len(cur_rows)} row(s))"
+                    )
+                if n_unmeasured:
+                    row["status"] += (
+                        f"; {n_unmeasured} unmeasured kernel row(s) ungated"
+                    )
+                if n_timing_only:
+                    row["status"] += (
+                        f"; {n_timing_only} timing-only kernel row(s)"
+                    )
+                if cur_rows:
+                    last_costs_by_key[key] = cur_rows
         if isinstance(value, (int, float)):
             last_by_metric[metric] = {"n": rnd["n"], "value": value}
         rows.append(row)

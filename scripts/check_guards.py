@@ -78,6 +78,22 @@ depend on:
    (degrading the group into shed responses). A refactor that unwraps
    the dispatch would let one malformed observation (or a device loss)
    take down every other series' flush.
+9. **One timing harness** (`hhmm_tpu/obs/profile.py`,
+   `docs/observability.md` "kernel cost plane"): no raw
+   ``perf_counter``-around-``block_until_ready`` timing loop anywhere
+   under ``hhmm_tpu/`` outside ``obs/profile.py`` — the shape
+   ``t0 = perf_counter(); for ...: block_until_ready(...); dt =
+   perf_counter() - t0``. Every such loop re-derives the
+   warmup/compile split, fresh-input, and order-statistic discipline
+   by hand; device timings must come from ``obs.profile.device_time``
+   so their numbers are comparable with the kernel cost DB rows
+   dispatch bets on. Per-iteration clock reads inside the loop (phase
+   *attribution*, e.g. `apps/tayal/wf.py`'s decode sub-profile) are
+   fine — the flag is specifically a clocked batch of synced calls
+   with no clock read per call. ``bench.py`` and the
+   ``scripts/tpu_*_probe.py`` drivers are exempt (their timed loops
+   are the measurement products themselves, and the probes now route
+   through the harness anyway — migrated where trivial).
 
 Exit 0 when clean, 1 with one line per violation. Run by
 ``tests/test_robust.py`` (and re-asserted by ``tests/test_serve.py``,
@@ -152,6 +168,10 @@ PLACEMENT_ALLOWED_FILES = ("hhmm_tpu/core/compat.py",)
 SERVE_HOT_PATH_FILE = "hhmm_tpu/serve/scheduler.py"
 HOT_PATH_METHOD_RE = re.compile(r"^(tick|flush|submit|attach\w*)$")
 HOT_PATH_DISPATCH_ATTR = "_dispatch"
+
+# invariant 9: raw timing loops confined to the profiling harness —
+# the one module allowed to clock a batch of synced device calls
+TIMING_HARNESS_FILE = "hhmm_tpu/obs/profile.py"
 
 
 def _bare_excepts(tree: ast.Module, rel: str, problems: List[str]) -> None:
@@ -452,6 +472,103 @@ def _check_serve_hot_path(tree: ast.Module, rel: str, problems: List[str]) -> No
                         )
 
 
+def _perf_counter_names(tree: ast.Module) -> set:
+    """Bare names bound to ``perf_counter`` (``from time import
+    perf_counter``, ``from hhmm_tpu.obs.trace import perf_counter``,
+    any alias) — the attribute spelling is matched structurally."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name == "perf_counter":
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+def _is_perf_counter_call(node: ast.AST, pc_names: set) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Name) and fn.id in pc_names:
+        return True
+    return isinstance(fn, ast.Attribute) and fn.attr == "perf_counter"
+
+
+def _is_block_until_ready_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Name) and fn.id == "block_until_ready":
+        return True
+    return isinstance(fn, ast.Attribute) and fn.attr == "block_until_ready"
+
+
+def _own_scope_nodes(node: ast.AST) -> List[ast.AST]:
+    """All descendants of ``node`` EXCLUDING nested function bodies —
+    a nested def is its own timing scope (it is analyzed as its own
+    function), so its clock reads and loops must not bleed into the
+    enclosing function's line-number bracketing."""
+    out: List[ast.AST] = []
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        out.append(n)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _check_timing_harness(tree: ast.Module, rel: str, problems: List[str]) -> None:
+    """Invariant 9: flag every ``For``/``While`` loop that (a) syncs
+    device work (``block_until_ready`` in its body), (b) reads no clock
+    per iteration (so it is a timed BATCH, not per-call attribution),
+    and (c) sits between a ``perf_counter`` read before it and one
+    after it in the same function scope — the hand-rolled
+    timing-harness shape that belongs in ``obs.profile.device_time``.
+    Each function is analyzed over its OWN scope only (nested defs are
+    separate scopes), so a loop is neither double-reported through its
+    enclosing function nor bracketed by clock reads that never time
+    it."""
+    if rel.replace("\\", "/") == TIMING_HARNESS_FILE:
+        return
+    pc_names = _perf_counter_names(tree)
+    fns = [
+        n
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for fn in fns:
+        own = _own_scope_nodes(fn)
+        pc_lines = [
+            n.lineno for n in own if _is_perf_counter_call(n, pc_names)
+        ]
+        if len(pc_lines) < 2:
+            continue
+        for loop in own:
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            body_nodes = [
+                n for s in loop.body for n in [s, *_own_scope_nodes(s)]
+            ]
+            if not any(_is_block_until_ready_call(n) for n in body_nodes):
+                continue
+            if any(_is_perf_counter_call(n, pc_names) for n in body_nodes):
+                continue  # per-iteration clock read: attribution, fine
+            end = getattr(loop, "end_lineno", loop.lineno)
+            if any(l < loop.lineno for l in pc_lines) and any(
+                l > end for l in pc_lines
+            ):
+                problems.append(
+                    f"{rel}:{loop.lineno}: raw perf_counter-around-"
+                    "block_until_ready timing loop — device timings "
+                    "must go through hhmm_tpu.obs.profile.device_time "
+                    "(the one harness with the warmup/compile split and "
+                    "order-statistic discipline; see "
+                    "docs/observability.md kernel cost plane)"
+                )
+
+
 def check(root: pathlib.Path) -> List[str]:
     problems: List[str] = []
     pkg = root / "hhmm_tpu"
@@ -469,6 +586,8 @@ def check(root: pathlib.Path) -> List[str]:
         _check_metrics_discipline(tree, rel, problems)
         # invariant 7: placement objects only from the planner
         _check_placement_confinement(tree, rel, problems)
+        # invariant 9: timing loops confined to the profiling harness
+        _check_timing_harness(tree, rel, problems)
         # invariant 5b over the serving layer: every module with a
         # jax.jit entry point registers it with the telemetry registry
         if py.parent == serve_dir:
@@ -601,7 +720,8 @@ def main(argv: List[str]) -> int:
         "online serve step guarded; semiring combines guarded; "
         "monotonic clocks only; serve/bench jits telemetry-registered; "
         "one shared metrics plane; placement objects confined to the "
-        "planner; serve hot paths degrade, never raise)"
+        "planner; serve hot paths degrade, never raise; timing loops "
+        "confined to the obs/profile.py harness)"
     )
     return 0
 

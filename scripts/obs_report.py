@@ -12,9 +12,15 @@ SLO attainment). This script folds all of it into one readable report:
   == compile ==      backend compiles, per-phase seconds, per-entry-point
                      jit cache sizes, component scopes
   == memory ==       per-device peak watermarks (where exposed)
+  == kernel costs == the `obs/profile.py` cost plane: per-kernel device
+                     time, FLOPs, roofline fraction, and which dispatch
+                     branches are DB-backed vs table-backed vs unmeasured
   == convergence ==  the per-chunk interim R̂/ESS/divergence/quarantine
                      trajectory a traced `batch/fit.py` run emits
-  == serving ==      tick latency, throughput, staleness, drift alarms
+  == serving ==      tick latency, throughput, staleness, drift alarms,
+                     overload/resilience counters (shed/pager/device loss)
+  == storm ==        the `bench.py --serve-storm` verdict: faults
+                     injected/escaped + survival gates
   == slo ==          per-check PASS/FAIL + overall attainment
 
 Inputs: the full manifest JSON (``bench.py --manifest-out`` /
@@ -208,6 +214,91 @@ def render_memory(man: Dict[str, Any], out) -> None:
     _table(("device", "bytes_in_use", "peak_bytes", "limit"), rows, out)
 
 
+def _record_manifest(man: Dict[str, Any]) -> Dict[str, Any]:
+    """The embedded record's compact manifest stanza, for stanzas
+    (`slo`, `storm`, `kernel_costs`) that `bench.py` attaches to the
+    record rather than the full manifest's top level."""
+    rec = man.get("record")
+    if isinstance(rec, dict) and isinstance(rec.get("manifest"), dict):
+        return rec["manifest"]
+    return {}
+
+
+def render_kernel_costs(man: Dict[str, Any], out) -> None:
+    """The `obs/profile.py` cost plane: measured device time + XLA cost
+    analysis per kernel/branch, and the dispatch-source audit — which
+    ``"auto"`` branches rest on a measured DB row, which on the
+    checked-in table, which on nothing (`kernels/dispatch.py`)."""
+    _section("kernel costs", out)
+    kc = man.get("kernel_costs") or _record_manifest(man).get("kernel_costs")
+    if not isinstance(kc, dict):
+        print("  (no kernel-cost rows in this run)", file=out)
+        return
+    rows = []
+    for r in kc.get("rows") or []:
+        if not isinstance(r, dict):
+            continue
+        frac = r.get("flops_frac")
+        rows.append(
+            (
+                f"{_fmt(r.get('kernel'))}[{_fmt(r.get('branch'))}]",
+                _fmt(r.get("K")),
+                _fmt(r.get("T")),
+                _fmt(r.get("B")),
+                _fmt(r.get("dtype")),
+                _fmt(r.get("p50_ms")),
+                _fmt(r.get("flops")),
+                "-" if not isinstance(frac, (int, float)) else f"{100 * frac:.4g}%",
+                "timing-only" if r.get("timing_only") else "",
+            )
+        )
+    _table(
+        ("kernel", "K", "T", "B", "dtype", "p50_ms", "flops", "flops_peak", ""),
+        rows,
+        out,
+    )
+    src_label = {
+        "db": "DB-backed",
+        "table": "table-backed",
+        "plan": "plan-pinned",
+        "default": "unmeasured (scan default)",
+    }
+    for d in kc.get("dispatch") or []:
+        if not isinstance(d, dict):
+            continue
+        print(
+            f"  auto {_fmt(d.get('kernel'))} K={_fmt(d.get('K'))} "
+            f"T={_fmt(d.get('T'))}: {_fmt(d.get('auto'))} "
+            f"({src_label.get(d.get('source'), _fmt(d.get('source')))})",
+            file=out,
+        )
+    if kc.get("db_path"):
+        print(f"  cost DB: {kc['db_path']}", file=out)
+
+
+def render_storm(man: Dict[str, Any], out) -> None:
+    """The ``--serve-storm`` stanza (`bench.py`): injected-fault plan,
+    escaped-fault count, and the survival gates — the section this
+    report silently dropped before it learned the PR 7 schema."""
+    storm = man.get("storm") or _record_manifest(man).get("storm")
+    if not isinstance(storm, dict):
+        return  # not a storm run: no section (unlike slo, storms are rare)
+    _section("storm", out)
+    esc = storm.get("faults_escaped")
+    print(f"  faults escaped: {_fmt(esc)}", file=out)
+    inj = storm.get("faults_injected") or {}
+    if isinstance(inj, dict):
+        for name, spec in sorted(inj.items()):
+            print(f"  injected {name}: {_fmt(spec)}", file=out)
+    failed = storm.get("gates_failed")
+    if failed:
+        for g in failed:
+            print(f"  gate FAILED: {g}", file=out)
+        print("  verdict: FAILED", file=out)
+    else:
+        print("  verdict: SURVIVED", file=out)
+
+
 def render_convergence(metrics: Dict[str, Dict[str, Any]], out) -> None:
     _section("convergence (interim, per fit chunk)", out)
     by_chunk: Dict[str, Dict[str, Any]] = {}
@@ -272,6 +363,17 @@ def render_serving(metrics: Dict[str, Dict[str, Any]], out) -> None:
         ("serve.superseded_responses", "superseded responses"),
         ("serve.snapshot_staleness_seconds", "snapshot staleness (s)"),
         ("serve.drift_alarms", "drift alarms"),
+        # the PR 7 overload/failure ladder: every rung is a counted,
+        # degraded-not-raised outcome — render them or the report
+        # claims a storm run served clean traffic
+        ("serve.shed_ticks", "shed ticks"),
+        ("serve.rejected_attaches", "rejected attaches"),
+        ("serve.dispatch_errors", "dispatch errors"),
+        ("serve.device_loss_events", "device loss events"),
+        ("serve.pager_evictions", "pager evictions"),
+        ("serve.pager_reloads", "pager reloads"),
+        ("serve.pager_resident_bytes", "pager resident bytes"),
+        ("serve.profiled_flushes", "profiled flushes"),
     ]
     seen = False
     for key, label in simple:
@@ -315,8 +417,10 @@ def render(man: Dict[str, Any], metrics: Dict[str, Dict[str, Any]], out) -> None
     render_spans(man, out)
     render_compile(man, out)
     render_memory(man, out)
+    render_kernel_costs(man, out)
     render_convergence(metrics, out)
     render_serving(metrics, out)
+    render_storm(man, out)
     render_slo(man, out)
 
 

@@ -13,13 +13,28 @@ dispatch defaults). Fresh pre-generated device inputs per timed call
 (host RNG + H2D outside the window), ``block_until_ready`` + host
 reduction — the tunnel-discipline rules of `tpu_pack2_probe.py`.
 
-Writes `results/assoc_crossover.json`: per-point ms/call for both
-branches plus a derived ``crossover`` block — for each K, the smallest
-grid T where assoc wins both the filter and Viterbi timings (batched) —
-in the exact ``(K_max, T_min)`` row shape of
-``kernels/dispatch.ASSOC_CROSSOVER``, ready to paste. Run with
-``--cpu`` on a CI host (records the cpu table) or on TPU hardware
-(records the tpu table). Wall target < 4 min.
+Writes TWO artifacts from one measurement:
+
+- **the kernel cost database** (`hhmm_tpu/obs/profile.py`,
+  ``results/kernel_costs.json`` by default): every timed point lands
+  as a (kernel, branch, K, T, B, dtype, device_kind, jax)-keyed row
+  through the shared atomic writer — the rows `kernels/dispatch.py`
+  reads as its measured crossover source. A run of this probe ON TPU
+  HARDWARE therefore fills the empty TPU crossover directly: the next
+  process on that device kind dispatches from the measurement, no
+  table paste required.
+- **`results/assoc_crossover.json`** (the human-readable note, kept):
+  per-point ms/call for both branches plus the derived ``crossover``
+  block — for each K, the smallest grid T where assoc wins both the
+  batched filter and Viterbi — in the exact ``(K_max, T_min)`` row
+  shape of ``kernels/dispatch.ASSOC_CROSSOVER``, ready to paste as
+  the checked-in fallback for hosts without a DB.
+
+All timing goes through the canonical ``device_time`` harness
+(`obs/profile.py`: warmup/compile split, fresh pre-staged inputs,
+``block_until_ready``, exact-order-statistic p50) — the discipline
+this script used to hand-roll. Run with ``--cpu`` on a CI host or on
+TPU hardware. Wall target < 4 min.
 """
 
 from __future__ import annotations
@@ -50,81 +65,67 @@ def main():
         "--Ts", nargs="*", type=int, default=[128, 256, 512, 1024, 2048, 4096]
     )
     ap.add_argument("--Ks", nargs="*", type=int, default=[2, 4, 8])
+    ap.add_argument(
+        "--kernel-costs-out",
+        default=None,
+        metavar="PATH",
+        help="kernel cost DB to write the measured rows into (default: "
+        "results/kernel_costs.json, or $HHMM_TPU_KERNEL_COSTS)",
+    )
     args = ap.parse_args()
 
     import jax
 
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
 
     if not args.cpu:
         assert jax.default_backend() == "tpu", jax.default_backend()
 
-    from hhmm_tpu.kernels import (
-        ffbs_assoc_sample,
-        ffbs_fused,
-        forward_filter,
-        forward_filter_assoc,
-        viterbi,
-        viterbi_assoc,
-    )
+    from hhmm_tpu.obs import profile as obs_profile
 
     backend = jax.default_backend()
+    devices = jax.devices()
+    device_kind = devices[0].device_kind if devices else None
     rng = np.random.default_rng(7)
     B, reps = args.batch, args.reps
+    db = obs_profile.KernelCostDB(args.kernel_costs_out).load()
 
     def timed(fn, arg_sets):
-        """Mean seconds/call over ``reps`` calls with fresh inputs each
-        (arg_sets pre-staged on device; compile on set -1)."""
-        out = fn(*arg_sets[-1])
-        jax.block_until_ready(out)
-        # monotonic clock only (check_guards invariant 5a): a wall-clock
-        # step here would corrupt the measured crossover table that
-        # kernels/dispatch.py bets real decode throughput on
-        t0 = time.perf_counter()
-        for r in range(reps):
-            jax.block_until_ready(fn(*arg_sets[r]))
-        return (time.perf_counter() - t0) / reps
+        """Seconds/call through the canonical harness
+        (`obs/profile.py` ``device_time``: compile on set -1, fresh
+        pre-staged inputs per rep, ``block_until_ready``, monotonic
+        clock — check_guards invariant 5a/9). Returns the full
+        :class:`~hhmm_tpu.obs.profile.DeviceTiming` so the DB rows
+        keep p50/min while the human-readable record keeps the mean
+        (its historical field)."""
+        return obs_profile.device_time(fn, arg_sets=arg_sets, reps=reps)
 
-    def inputs(K, T, batch=None):
-        shp = () if batch is None else (batch,)
-        log_pi = jnp.asarray(
-            np.log(rng.dirichlet(np.ones(K), shp or None)), jnp.float32
-        )
-        log_A = jnp.asarray(
-            np.log(rng.dirichlet(np.ones(K), shp + (K,))), jnp.float32
-        )
-        log_obs = jnp.asarray(rng.normal(size=shp + (T, K)) - 1.0, jnp.float32)
-        mask = jnp.ones(shp + (T,), jnp.float32)
-        return log_pi, log_A, log_obs, mask
+    # the SHARED measurement surface (obs/profile.py): this probe and
+    # `bench.py --profile-kernels` write the same cost DB, so both must
+    # measure the exact same computation per (kernel, branch) key
+    inputs = lambda K, T, batch=None: obs_profile.dirichlet_hmm_inputs(
+        rng, K, T, batch=batch
+    )
 
+    # stamped like a bench record (obs/manifest.py discipline): without
+    # device_kind + jax versions a future TPU run could not land in the
+    # dispatch-readable DB keyed on exactly those fields
+    from hhmm_tpu.obs.manifest import stack_versions
+
+    versions = stack_versions()
     rec = {
         "device": str(jax.devices()[0]),
         "backend": backend,
+        "device_kind": device_kind,
+        "jax_version": versions.get("jax"),
+        "jaxlib_version": versions.get("jaxlib"),
         "ts": time.strftime("%F %T"),
         "reps": reps,
         "batch": B,
         "points": [],
     }
-    kernels = {
-        "filter": (
-            lambda lp, lA, lo, m: forward_filter(lp, lA, lo, m)[1],
-            lambda lp, lA, lo, m: forward_filter_assoc(lp, lA, lo, m)[1],
-        ),
-        "viterbi": (
-            lambda lp, lA, lo, m: viterbi(lp, lA, lo, m)[0],
-            lambda lp, lA, lo, m: viterbi_assoc(lp, lA, lo, m)[0],
-        ),
-        "ffbs": (
-            lambda lp, lA, lo, m: ffbs_fused(
-                jax.random.PRNGKey(0), lp, lA, lo, m
-            )[0],
-            lambda lp, lA, lo, m: ffbs_assoc_sample(
-                jax.random.PRNGKey(0), lp, lA, lo, m
-            )[0],
-        ),
-    }
+    kernels = obs_profile.decode_kernel_pairs()
     for K in args.Ks:
         for T in args.Ts:
             point = {"K": K, "T": T}
@@ -140,10 +141,32 @@ def main():
                     )
                     t_seq = timed(f_seq, sets)
                     t_assoc = timed(f_assoc, sets)
-                    point[f"{name}{tag}_seq_ms"] = round(t_seq * 1e3, 3)
-                    point[f"{name}{tag}_assoc_ms"] = round(t_assoc * 1e3, 3)
-                    point[f"{name}{tag}_speedup"] = round(t_seq / t_assoc, 3)
+                    point[f"{name}{tag}_seq_ms"] = round(t_seq.mean_s * 1e3, 3)
+                    point[f"{name}{tag}_assoc_ms"] = round(
+                        t_assoc.mean_s * 1e3, 3
+                    )
+                    point[f"{name}{tag}_speedup"] = round(
+                        t_seq.mean_s / t_assoc.mean_s, 3
+                    )
+                    # the same measurement lands in the dispatch-readable
+                    # cost DB (single series recorded as B=1)
+                    for branch, timing in (("seq", t_seq), ("assoc", t_assoc)):
+                        db.put_row(
+                            kernel=name,
+                            branch=branch,
+                            K=K,
+                            T=T,
+                            B=batch or 1,
+                            dtype="float32",
+                            timing=timing,
+                            device_kind=device_kind,
+                            source="tpu_assoc_probe",
+                        )
             rec["points"].append(point)
+            # incremental atomic save: a mid-grid OOM/preemption (the
+            # long-T assoc points are exactly where TPUs fall over)
+            # must not discard the minutes of rows already measured
+            db.save()
             print(json.dumps(point), flush=True)
 
     # derived dispatch rows: per K, smallest grid T where assoc wins
@@ -161,10 +184,15 @@ def main():
         crossover.append({"K_max": K, "T_min": t_min})
     rec["crossover"] = {
         "rows": crossover,
-        "note": "paste non-null rows into kernels/dispatch.ASSOC_CROSSOVER"
-        f"[{backend!r}] as ((K_max, T_min), ...)",
+        "note": "the kernel cost DB is now the dispatch source of truth "
+        "for this device_kind (docs/parallel_scan.md runbook); "
+        "optionally paste non-null rows into "
+        f"kernels/dispatch.ASSOC_CROSSOVER[{backend!r}] as "
+        "((K_max, T_min), ...) as the DB-less fallback",
     }
     print(json.dumps(rec["crossover"]))
+    db.save()
+    print(f"wrote {len(db.rows())} rows to {db.path}")
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "w") as f:
         json.dump(rec, f, indent=1)
