@@ -251,13 +251,22 @@ def serve_bench(args, backend, degraded) -> None:
     flat (every flush lands in an already-compiled bucket shape); a
     non-flat count fails the bench (exit 1), the serving analog of the
     agreement gate. Emits one JSON record with latency percentiles and
-    ticks/sec alongside the fit benches."""
+    ticks/sec alongside the fit benches.
+
+    Request plane (`hhmm_tpu/obs/request.py`): the replay runs under an
+    explicitly-enabled lifecycle recorder with series spread over four
+    tenants, so the record decomposes steady-state tick latency into
+    queue/device/other shares per tenant (the ``request`` manifest
+    stanza `scripts/bench_diff.py` gates queue-share growth on); a
+    missing decomposition fails the bench exactly like a post-warmup
+    recompile."""
     import tempfile
 
     from __graft_entry__ import _tayal_batch
     from hhmm_tpu.batch import fit_batched
     from hhmm_tpu.infer import GibbsConfig
     from hhmm_tpu.models import TayalHHMM
+    from hhmm_tpu.obs.request import RequestRecorder
     from hhmm_tpu.serve import (
         MicroBatchScheduler,
         ServeMetrics,
@@ -311,13 +320,18 @@ def serve_bench(args, backend, degraded) -> None:
             ),
         )
 
-    # attach from the registry, filter warm-started on the fitted history
+    # attach from the registry, filter warm-started on the fitted
+    # history. Series spread over four tenants (explicit attach tenant;
+    # scheduling is tenant-agnostic, so this is behavior-preserving)
+    # gives the request-plane decomposition real per-tenant rows.
     metrics = ServeMetrics()
+    recorder = RequestRecorder(enabled=True, window_s=600.0)
     sched = MicroBatchScheduler(
         model,
         buckets=(8, 64, max(64, B)),
         registry=registry,
         metrics=metrics,
+        recorder=recorder,
     )
     t0 = perf_counter()
     sched.attach_many(
@@ -326,6 +340,7 @@ def serve_bench(args, backend, degraded) -> None:
                 name,
                 registry.load(name),
                 {"x": x_np[i, :n_hist], "sign": s_np[i, :n_hist]},
+                f"tenant{i % 4}",
             )
             for i, name in enumerate(names)
         ]
@@ -342,14 +357,22 @@ def serve_bench(args, backend, degraded) -> None:
     replay(n_hist, n_hist + warm_n)
     compiles_warm = metrics.compile_count
     # steady-state measurement window: the percentiles and ticks/sec in
-    # the emitted record must describe the same (post-warmup) regime
+    # the emitted record must describe the same (post-warmup) regime —
+    # the request-plane window resets with the throughput window so its
+    # shares decompose the same steady state
     metrics.reset_throughput_window()
+    recorder.reset_window()
     t0 = perf_counter()
     replay(n_hist + warm_n, n_hist + ticks)
     replay_s = perf_counter() - t0
     compiles_after_warmup = metrics.compile_count - compiles_warm
     n_timed = (ticks - warm_n) * B
     summary = metrics.summary()
+    # request-plane decomposition: queue/device/other shares per tenant
+    # over the steady-state window (the acceptance surface)
+    request_stanza = recorder.stanza()
+    req_overall = request_stanza["overall"]
+    req_fair = request_stanza["fairness"]
     # SLO attainment (serve/metrics.py): the explicit serving objectives
     # — p99 tick latency, snapshot staleness, recompile budget — judged
     # over the steady-state window and embedded in the manifest stanza
@@ -394,6 +417,10 @@ def serve_bench(args, backend, degraded) -> None:
             "degraded_responses": summary["degraded_responses"],
             "compile_count": summary["compile_count"],
             "compiles_after_warmup": compiles_after_warmup,
+            "queue_share": req_overall["queue_share"],
+            "device_share": req_overall["device_share"],
+            "other_share": req_overall["other_share"],
+            "fairness_p99_spread_ms": req_fair["p99_spread_ms"],
             "slo_attained": slo["attained"],
             "backend": backend["backend"],
             "backend_fallback": backend["fallback"],
@@ -403,8 +430,11 @@ def serve_bench(args, backend, degraded) -> None:
         model=model,
     )
     # the stanza is the bench_diff-visible surface: attainment plus the
-    # per-check verdicts ride inside it (stamp_record built the stanza)
+    # per-check verdicts ride inside it (stamp_record built the stanza);
+    # the request stanza rides the same way (queue-share / fairness-
+    # spread growth gate, scripts/bench_diff.py)
     serve_record["manifest"]["slo"] = slo
+    serve_record["manifest"]["request"] = request_stanza
     print(json.dumps(serve_record))
     print(
         "# serve SLO "
@@ -421,6 +451,24 @@ def serve_bench(args, backend, degraded) -> None:
         print(
             f"# serve bench FAILED: {compiles_after_warmup} XLA compiles "
             "after warmup (bucketed dispatch must be compile-stable)",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+    # the decomposition gate: every tenant's steady-state latency must
+    # decompose into finite queue/device/other shares — a None share
+    # means the lifecycle recorder went dark mid-bench
+    share_keys = ("queue_share", "device_share", "other_share")
+    bad = [
+        t
+        for t, row in request_stanza["tenants"].items()
+        if not all(isinstance(row[k], (int, float)) for k in share_keys)
+    ]
+    if bad or not all(
+        isinstance(req_overall[k], (int, float)) for k in share_keys
+    ):
+        print(
+            "# serve bench FAILED: request-plane latency decomposition "
+            f"missing (tenants without shares: {bad or ['<overall>']})",
             file=sys.stderr,
         )
         sys.exit(1)
@@ -449,11 +497,23 @@ def serve_storm(args, backend, degraded) -> None:
     embedded in the record's manifest stanza exactly like the
     ``--serve`` bench, so `scripts/bench_diff.py` gates attained→unmet
     transitions; a ``storm`` stanza (faults escaped / injected) rides
-    along for the resilience gate."""
+    along for the resilience gate.
+
+    Fairness arms (`hhmm_tpu/obs/request.py`): the storm's series split
+    into two tenants (``hot``/``quiet``). A short BALANCED probe (even
+    traffic, no faults) measures the baseline per-tenant p99 spread;
+    the storm window itself runs SKEWED — every hot-tenant series
+    submits multiple waves per round while quiet submits one — so the
+    hot tenant's later waves starve behind its own backlog. The
+    fairness gate requires the skewed window's spread STRICTLY above
+    the balanced probe's (the spread metric must actually detect
+    starvation), and the ``request`` stanza rides the manifest for the
+    `scripts/bench_diff.py` fairness-spread/queue-share growth gate."""
     import tempfile
 
     from __graft_entry__ import _tayal_batch
     from hhmm_tpu.models import TayalHHMM
+    from hhmm_tpu.obs.request import RequestRecorder
     from hhmm_tpu.robust import faults
     from hhmm_tpu.serve import (
         AdmissionPolicy,
@@ -502,11 +562,18 @@ def serve_storm(args, backend, degraded) -> None:
     budget = n_resident * snap_bytes
     pager = SnapshotPager(registry, budget_bytes=budget)
     metrics = ServeMetrics()
+    recorder = RequestRecorder(enabled=True, window_s=600.0)
     window = min(192, max(8, (3 * n_resident) // 4))
+    # the pending quota is keyed by TENANT (request plane; default
+    # tenant = series preserves the old semantics) — generous here so
+    # the storm exercises depth shedding (tenant-labeled either way);
+    # the flush budget equals the window: a skewed flood's FIFO tail
+    # stays queued for the next flush — the within-flush starvation
+    # the fairness spread must detect
     policy = AdmissionPolicy(
         max_queue_depth=max(256, window + window // 3),
-        max_pending_per_series=2,
-        max_ticks_per_flush=512,
+        max_pending_per_series=4 * window,
+        max_ticks_per_flush=max(8, window),
     )
     sched = MicroBatchScheduler(
         model,
@@ -515,6 +582,7 @@ def serve_storm(args, backend, degraded) -> None:
         metrics=metrics,
         admission=policy,
         pager=pager,
+        recorder=recorder,
     )
 
     # tick observations from a shared Tayal pool (series i reads pool
@@ -531,15 +599,41 @@ def serve_storm(args, backend, degraded) -> None:
 
     escaped = 0
 
-    def drive_round(r: int, mult: int, stride: int = 64) -> None:
+    def tenant_of(i: int) -> str:
+        return "hot" if i % 2 == 0 else "quiet"
+
+    def drive_round(r: int, mult: int, stride: int = 64, skew: bool = False) -> None:
+        """One load-generator round. ``skew=True`` is the two-tenant
+        starvation shape: the hot tenant floods the FIFO queue first
+        (``mult + 2`` waves per hot series), the quiet tenant's single
+        wave lands at the back — the flush budget dispatches the hot
+        bulk and strands the quiet tail for the NEXT flush, which is
+        exactly the FIFO-within-budget unfairness ROADMAP item 4 still
+        owes a fix for. Every round flushes twice so the stranded tail
+        completes (with its starved latency on the record) instead of
+        being depth-shed by the next round's flood."""
         nonlocal escaped
         start = (r * stride) % n_reg
         idx = [(start + k) % n_reg for k in range(window)]
         try:
-            for j in range(mult):  # round-robin: waves stay batched
-                for i in idx:
-                    sched.submit(names[i], obs_for(i, r * mult + j))
+            if skew:
+                hot = [i for i in idx if tenant_of(i) == "hot"]
+                quiet = [i for i in idx if tenant_of(i) != "hot"]
+                for j in range(mult + 2):
+                    for i in hot:
+                        sched.submit(
+                            names[i], obs_for(i, r * 8 + j), tenant="hot"
+                        )
+                for i in quiet:
+                    sched.submit(names[i], obs_for(i, r * 8), tenant="quiet")
+            else:
+                for j in range(mult):  # round-robin: waves stay batched
+                    for i in idx:
+                        sched.submit(
+                            names[i], obs_for(i, r * 8 + j), tenant=tenant_of(i)
+                        )
             sched.flush()
+            sched.flush()  # drain the budget remainder (the starved tail)
         except Exception as e:  # an injected fault ESCAPED the serve layer
             escaped += 1
             print(
@@ -566,10 +660,29 @@ def serve_storm(args, backend, degraded) -> None:
                 escaped += 1
                 print(f"# serve-storm: warmup escape: {e}", file=sys.stderr)
     warmup_s = perf_counter() - t0
+
+    # ---- balanced fairness probe (no faults, even two-tenant
+    # traffic): the spread baseline the skewed storm window must
+    # strictly exceed. Same bucket shapes as warmup — no new compiles.
+    # Drain any warmup remainder first: the flush budget can strand
+    # warmup ticks in the queue, and folding those (whole-warmup queue
+    # ages, per-series tenants) into the probe window would corrupt
+    # the balanced baseline.
+    for _ in range(1024):
+        if not sched.flush():
+            break
+    recorder.reset_window()
+    for r in (0, 1):
+        drive_round(r, 1)
+    spread_balanced = recorder.p99_spread_ms()
+    recorder.reset_window()
+
     compiles_warm = metrics.compile_count
     metrics.reset_throughput_window()
 
-    # ---- the storm: every traffic fault active for the whole window
+    # ---- the storm: every traffic fault active for the whole window,
+    # traffic SKEWED onto the hot tenant (its later waves starve
+    # behind its own backlog — what the spread metric must detect)
     plan = faults.TrafficFaultPlan(
         burst_factor=4,
         burst_every=5,
@@ -582,9 +695,14 @@ def serve_storm(args, backend, degraded) -> None:
     t0 = perf_counter()
     with faults.inject(plan):
         for r in range(1, rounds + 1):
-            drive_round(r, plan.burst_multiplier(r))
+            drive_round(r, plan.burst_multiplier(r), skew=True)
     storm_s = perf_counter() - t0
     compiles_after_warmup = metrics.compile_count - compiles_warm
+    # ONE stanza read: the record field, the fairness gate, and the
+    # bench_diff-gated manifest stanza must all see the same spread
+    # (two independent reads could disagree at the window edge)
+    request_stanza = recorder.stanza()
+    spread_skewed = request_stanza["fairness"]["p99_spread_ms"]
 
     summary = metrics.summary()
     pstats = pager.stats()
@@ -622,9 +740,23 @@ def serve_storm(args, backend, degraded) -> None:
         )
     if summary["device_loss_events"] == 0:
         failures.append("device-loss fault was never absorbed (not injected?)")
+    # the fairness gate: the skewed two-tenant window's p99 spread must
+    # sit STRICTLY above the balanced probe's — a spread metric that
+    # cannot see deliberate starvation is not a starvation detector
+    if spread_skewed is None or (
+        spread_balanced is not None and spread_skewed <= spread_balanced
+    ):
+        failures.append(
+            "fairness spread did not detect the skewed-tenant storm "
+            f"(skewed={spread_skewed} ms, balanced={spread_balanced} ms)"
+        )
 
     storm_stanza = {
         "faults_escaped": escaped,
+        "fairness": {
+            "balanced_p99_spread_ms": spread_balanced,
+            "skewed_p99_spread_ms": spread_skewed,
+        },
         "faults_injected": {
             "burst": {"factor": plan.burst_factor, "every": plan.burst_every},
             "slow_load": {"s": plan.slow_load_s, "every": plan.slow_load_every},
@@ -664,6 +796,9 @@ def serve_storm(args, backend, degraded) -> None:
             "pager": pstats,
             "compiles_after_warmup": compiles_after_warmup,
             "faults_escaped": escaped,
+            "fairness_p99_spread_ms": spread_skewed,
+            "fairness_p99_spread_balanced_ms": spread_balanced,
+            "queue_share": request_stanza["overall"]["queue_share"],
             "slo_attained": slo["attained"],
             "backend": backend["backend"],
             "backend_fallback": backend["fallback"],
@@ -674,6 +809,7 @@ def serve_storm(args, backend, degraded) -> None:
     )
     record["manifest"]["slo"] = slo
     record["manifest"]["storm"] = storm_stanza
+    record["manifest"]["request"] = request_stanza
     print(json.dumps(record))
     print(
         "# serve-storm "
@@ -683,6 +819,7 @@ def serve_storm(args, backend, degraded) -> None:
         f"{pstats['peak_resident_bytes']}/{budget}B "
         f"device_loss={summary['device_loss_events']} escaped={escaped} "
         f"compiles_after_warmup={compiles_after_warmup} "
+        f"spread={spread_skewed}ms(balanced {spread_balanced}ms) "
         + ("SLO ATTAINED" if slo["attained"] else "SLO UNMET"),
         file=sys.stderr,
     )

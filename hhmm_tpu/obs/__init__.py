@@ -22,6 +22,12 @@ The cross-cutting layer that answers, for any run of the engine,
   compile counts, peak memory, metrics snapshot) written atomically
   next to results; the provenance record `scripts/bench_diff.py`
   gates regressions on.
+- `obs/request.py` — the request plane: per-tick lifecycle tracing
+  for the serving layer (``TickTrace`` stamps at enqueue → admit →
+  bucket-assign → dispatch → device-complete → respond), per-tenant
+  rolling-window latency attribution, and the fairness observables
+  (``serve.request.*``: p99 spread, queue age, flush interleaving)
+  the multi-tenant scheduler work is gated on.
 - `obs/profile.py` — the device-time plane: the one canonical
   ``device_time`` harness (warmup/compile split, fresh pre-staged
   inputs, ``block_until_ready``, exact-order-statistic p50/min), XLA
@@ -32,7 +38,8 @@ The cross-cutting layer that answers, for any run of the engine,
 See `docs/observability.md`.
 """
 
-from hhmm_tpu.obs import manifest, metrics, profile, telemetry, trace
+from hhmm_tpu.obs import manifest, metrics, profile, request, telemetry, trace
+from hhmm_tpu.obs.request import RequestRecorder, TickTrace
 from hhmm_tpu.obs.manifest import (
     MANIFEST_VERSION,
     collect_manifest,
@@ -61,8 +68,11 @@ __all__ = [
     "manifest",
     "metrics",
     "profile",
+    "request",
     "telemetry",
     "trace",
+    "RequestRecorder",
+    "TickTrace",
     "Counter",
     "Gauge",
     "Histogram",

@@ -46,6 +46,7 @@ from typing import Any, Dict, Optional, Sequence
 import numpy as np
 
 from hhmm_tpu.obs import metrics as obs_metrics
+from hhmm_tpu.obs import request as obs_request
 from hhmm_tpu.obs import telemetry
 
 __all__ = ["ServeMetrics", "SLOSpec", "evaluate_slo"]
@@ -108,6 +109,15 @@ class ServeMetrics:
             ("serve.profiled_flushes", self._profiled_flushes),
         ):
             obs_metrics.attach(name, inst)
+        # tenant label values this instance has already created on the
+        # plane — the memory behind the SHARED cardinality bound
+        # (`obs/request.py` ``bounded_tenant_label``): with the default
+        # tenant = series at fleet scale, an unbounded label set would
+        # grow the registry one instrument per shedding series forever
+        self._tenant_labels: set = set()
+
+    def _tenant_label(self, tenant) -> str:
+        return obs_request.bounded_tenant_label(tenant, self._tenant_labels)
 
     # ---- frozen read API (pre-registry attribute names) ----
 
@@ -212,23 +222,46 @@ class ServeMetrics:
         (latest-wins); the filter state still folded that tick."""
         self._superseded_responses.inc()
 
-    def note_shed_tick(self, n: int = 1) -> None:
+    def note_shed_tick(self, n: int = 1, tenant: Optional[str] = None) -> None:
         """``n`` ticks were shed — dropped under admission pressure or
         degraded by a dispatch failure — each surfaced as a
         ``shed=True`` :class:`~hhmm_tpu.serve.scheduler.TickResponse`,
-        never an exception."""
+        never an exception. With a ``tenant`` (the request-plane key,
+        `obs/request.py`; default tenant = series) the shed is ALSO
+        counted under a ``serve.shed_ticks{tenant=...}`` label on the
+        shared plane, so a hot tenant's pressure shedding a quiet one
+        is attributable — the labeled route is the registry's gated
+        accessor (no-op while the plane is disabled, and the bound's
+        exact-label slots are only consumed while it is enabled); the
+        unlabeled attached counter stays the always-on product total.
+        Label cardinality is bounded (`obs/request.py`
+        ``DEFAULT_MAX_TENANTS``, overflow fold) — tenant = series at
+        fleet scale must not grow the registry one instrument per
+        shedding series."""
         self._shed_ticks.inc(n)
+        if tenant is not None and obs_metrics.enabled():
+            obs_metrics.counter(
+                "serve.shed_ticks", tenant=self._tenant_label(tenant)
+            ).inc(n)
 
     def note_rejected_attach(self, n: int = 1) -> None:
         """``n`` attach items were rejected (admission capacity or
         per-item validation) without failing the rest of the batch."""
         self._rejected_attaches.inc(n)
 
-    def note_dispatch_error(self, n_ticks: int = 1) -> None:
+    def note_dispatch_error(
+        self, n_ticks: int = 1, tenants: Optional[Sequence[str]] = None
+    ) -> None:
         """One dispatch group failed; its ``n_ticks`` ticks degraded
-        into shed responses."""
+        into shed responses. ``tenants``: the failed ticks' tenant
+        keys, for the per-tenant shed label (one count each)."""
         self._dispatch_errors.inc()
         self._shed_ticks.inc(n_ticks)
+        if tenants and obs_metrics.enabled():
+            for t in tenants:
+                obs_metrics.counter(
+                    "serve.shed_ticks", tenant=self._tenant_label(t)
+                ).inc()
 
     def note_device_loss(self) -> None:
         """A dispatch failure classified as device loss (simulated or

@@ -53,11 +53,24 @@ the historical implicit unboundedness —
   snapshot residency is an LRU cache under a byte budget — an evicted
   series is ``detach``\\ ed (draw bank, stream state, staleness entry
   all released) and transparently re-attached on its next ``submit``.
+
+Request plane (`hhmm_tpu/obs/request.py`, docs/observability.md
+"request plane"): every tick carries an optional
+:class:`~hhmm_tpu.obs.request.TickTrace` with monotonic stamps at
+enqueue → admit → bucket-assign → dispatch → device-complete → respond,
+so end-to-end latency decomposes into queue/batch-formation/device/
+post-process shares, attributed per **tenant** (``submit``/``attach``
+take a tenant key; the default tenant = series is behavior-preserving).
+The recorder follows the `obs/trace.py` discipline — disabled serving
+pays one attribute read + one branch per lifecycle call — and ALL of
+this module's clock reads route through ``obs_request.now`` (the
+check_guards invariant-10 confinement: no raw ``perf_counter`` in the
+serve layer).
 """
 
 from __future__ import annotations
 
-import time
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -69,6 +82,7 @@ import jax.numpy as jnp
 from hhmm_tpu.batch.pad import pad_ragged
 from hhmm_tpu.core.lmath import safe_log_normalize
 from hhmm_tpu.obs import profile as obs_profile
+from hhmm_tpu.obs import request as obs_request
 from hhmm_tpu.obs.telemetry import register_jit
 from hhmm_tpu.obs.trace import enabled as trace_enabled
 from hhmm_tpu.obs.trace import span, traced
@@ -83,6 +97,15 @@ from hhmm_tpu.serve.registry import (
 )
 
 __all__ = ["TickResponse", "AdmissionPolicy", "MicroBatchScheduler"]
+
+# explicit series->tenant bindings retained (LRU): bindings must
+# survive pager eviction (a paged-out series re-attaches under its
+# tenant's quota), but a fleet attaching ephemeral uuid series ids
+# with explicit tenants must not grow the map without bound — the
+# coldest binding is dropped past the cap (that series would simply
+# re-bind on its next explicit attach, or serve under the default
+# tenant = series)
+TENANT_BINDINGS_CAP = 65536
 
 
 @dataclass(frozen=True)
@@ -114,8 +137,11 @@ class AdmissionPolicy:
     - ``max_queue_depth``: total pending-tick bound; a submit into a
       full queue sheds the OLDEST pending tick (newest data wins for a
       filter — the stale tick is the right one to drop).
-    - ``max_pending_per_series``: per-tenant quota (tenant = series);
-      an over-quota submit sheds that series' oldest queued tick.
+    - ``max_pending_per_series``: per-**tenant** quota, keyed by the
+      request-plane tenant (`obs/request.py`; default tenant = series,
+      which keeps the historical per-series behavior bit-for-bit); an
+      over-quota submit sheds that tenant's oldest queued tick, and
+      the shed is counted under a ``serve.shed_ticks{tenant=}`` label.
     - ``max_ticks_per_flush``: dispatch budget per flush; the remainder
       stays queued (the queue bound above keeps the backlog finite).
     """
@@ -171,6 +197,7 @@ class MicroBatchScheduler:
         admission: Optional[AdmissionPolicy] = None,
         pager=None,
         profile_every: int = 0,
+        recorder: Optional[obs_request.RequestRecorder] = None,
     ):
         """``plan``: an optional :class:`hhmm_tpu.plan.Plan` — the
         topology-aware placement decision (`docs/sharding.md`). When
@@ -200,7 +227,14 @@ class MicroBatchScheduler:
         repeats an already-dispatched signature it can NEVER add an
         XLA compile (asserted in ``tests/test_profile.py``); the p50
         lands in the ``serve.flush_device_time_ms{kernel=,bucket=}``
-        gauge + a ``serve.flush_profile`` span."""
+        gauge + a ``serve.flush_profile`` span.
+
+        ``recorder``: the request-plane lifecycle recorder
+        (:class:`hhmm_tpu.obs.request.RequestRecorder`). ``None``
+        constructs one that follows the tracer flag — untraced
+        production serving pays one attribute read + branch per
+        lifecycle call; benches pass an explicitly-enabled recorder to
+        decompose untraced steady-state latency."""
         if buckets is None:
             buckets = plan.buckets if plan is not None else (8, 32, 128)
         if not buckets or any(b <= 0 for b in buckets):
@@ -220,6 +254,9 @@ class MicroBatchScheduler:
             admission = AdmissionPolicy.from_plan(plan)
         self.admission = admission
         self.pager = pager
+        self.recorder = (
+            recorder if recorder is not None else obs_request.RequestRecorder()
+        )
         self.profile_every = int(profile_every or 0)
         if self.profile_every < 0:
             raise ValueError(
@@ -242,8 +279,18 @@ class MicroBatchScheduler:
         # publishes (ROADMAP item 3's cheap staleness signal)
         self._attach_t: Dict[str, float] = {}
         self._oldest_attach_t: Optional[float] = None
-        self._pending: List[Tuple[str, Dict[str, Any], float]] = []
+        # pending entries: (series_id, obs, t_submit, tenant, trace) —
+        # trace is the request-plane TickTrace (None while disabled)
+        self._pending: List[Tuple[str, Dict[str, Any], float, str, Any]] = []
         self._pending_count: Dict[str, int] = {}
+        # per-TENANT pending occupancy: the admission quota key (the
+        # per-series count above stays the pager pin/unpin key)
+        self._pending_tenant_count: Dict[str, int] = {}
+        # series -> tenant, set by an explicit attach tenant; absent
+        # means the default tenant = series (behavior-preserving).
+        # Survives detach (pager evictions must not strip a series'
+        # tenant) but LRU-bounded at TENANT_BINDINGS_CAP.
+        self._tenant_of: "OrderedDict[str, str]" = OrderedDict()
         self._undelivered: List[TickResponse] = []
         self._draws_cache: Dict[Tuple[str, ...], jnp.ndarray] = {}
         self._obs_dtypes: Dict[str, Any] = {}
@@ -367,21 +414,33 @@ class MicroBatchScheduler:
         # no healthy fallback anywhere: serve the degraded draws, flagged
         return snap, True, False
 
-    def attach(self, series_id: str, snapshot: PosteriorSnapshot, history=None):
+    def attach(
+        self,
+        series_id: str,
+        snapshot: PosteriorSnapshot,
+        history=None,
+        tenant: Optional[str] = None,
+    ):
         """Attach (or re-attach) one series. ``history``: optional dict
         of per-tick arrays [T_h] to warm-start the filter from (replayed
         through :func:`filter_scan`; ragged lengths across an
-        ``attach_many`` batch are padded with `batch/pad.py`). The
-        single-item form is strict: a rejected item raises (there is
-        nothing else in the batch to protect)."""
-        rejected = self.attach_many([(series_id, snapshot, history)])
+        ``attach_many`` batch are padded with `batch/pad.py`).
+        ``tenant``: the request-plane attribution/quota key
+        (`obs/request.py`); ``None`` keeps the default tenant = series.
+        The single-item form is strict: a rejected item raises (there
+        is nothing else in the batch to protect)."""
+        rejected = self.attach_many([(series_id, snapshot, history, tenant)])
         if rejected:
             raise ValueError(rejected[0][1])
 
     @traced("serve.attach")
     def attach_many(self, items) -> List[Tuple[str, str]]:
         """Attach a batch of series in padded replay dispatches.
-        ``items``: iterable of ``(series_id, snapshot, history_or_None)``.
+        ``items``: iterable of ``(series_id, snapshot, history_or_None)``
+        or ``(series_id, snapshot, history_or_None, tenant_or_None)`` —
+        an explicit tenant binds the series to that request-plane key
+        for latency attribution and the admission quota (default:
+        tenant = series).
 
         Per-item degrade contract (the invariant-8 attach rung): a bad
         item — invalid snapshot, admission capacity, a warm-replay
@@ -392,14 +451,20 @@ class MicroBatchScheduler:
         items are committed atomically per item; the draw-count lock
         moves only with an actually-committed attach, so a fully
         rejected batch never poisons a corrected retry."""
-        items = list(items)
+        items = [
+            (it[0], it[1], it[2], it[3] if len(it) > 3 else None)
+            for it in (tuple(it) for it in items)
+        ]
         rejected: List[Tuple[str, str]] = []
         n_draws = self.n_draws
         resolved, keeps = [], []
         n_degraded_fits = 0
+        tenant_by_sid = {
+            sid: tenant for sid, _, _, tenant in items if tenant is not None
+        }
         cap = None if self.admission is None else self.admission.max_series
         projected = set(self._series)
-        for series_id, snap, hist in items:
+        for series_id, snap, hist, _ in items:
             if snap is None:  # a registry miss handed straight through
                 rejected.append((
                     series_id,
@@ -496,11 +561,21 @@ class MicroBatchScheduler:
             rec = self._series[series_id]
             rec["rejected_fits"] = rec.get("rejected_fits", 0) + 1
         self._series.update(new_recs)
+        # request-plane tenant binding: an explicit tenant commits with
+        # its series (keeps re-bind too — the keep IS the commit of the
+        # keep decision); absent stays the default tenant = series
+        for series_id in list(committed) + keeps:
+            t = tenant_by_sid.get(series_id)
+            if t is not None:
+                self._tenant_of[series_id] = str(t)
+                self._tenant_of.move_to_end(series_id)
+        while len(self._tenant_of) > TENANT_BINDINGS_CAP:
+            self._tenant_of.popitem(last=False)
         # staleness clock: a committed (re-)attach refreshes the series'
         # posterior age; a kept (rejected-fit) series keeps aging on its
         # previously attached snapshot — exactly the drift the gauge
         # must surface
-        now = time.perf_counter()
+        now = obs_request.now()
         for series_id in new_recs:
             self._attach_t[series_id] = now
         for series_id in keeps:
@@ -638,6 +713,12 @@ class MicroBatchScheduler:
         if rec is None:
             return False
         self._attach_t.pop(series_id, None)
+        # the tenant binding deliberately SURVIVES detach: the pager's
+        # eviction path lands here, and a paged-out series must come
+        # back under its tenant's quota/attribution (a hot tenant must
+        # not escape its quota pool by having series page out and back
+        # in). The entry is one small string per explicitly-tenanted
+        # series; a later attach with a different tenant rebinds.
         self._oldest_attach_t = (
             min(self._attach_t.values()) if self._attach_t else None
         )
@@ -650,7 +731,10 @@ class MicroBatchScheduler:
                 if p[0] == series_id:
                     # _shed_now counts the shed AND keeps the parked-
                     # response buffer under its capacity bound
-                    self._shed_now(p[0], p[2], "series detached")
+                    self._dec_tenant(p[3])
+                    self._shed_now(
+                        p[0], p[2], "series detached", tenant=p[3], trace=p[4]
+                    )
                 else:
                     keep.append(p)
             self._pending = keep
@@ -680,13 +764,26 @@ class MicroBatchScheduler:
             loglik=float("nan"),
             healthy_draws=0,
             degraded=True,
-            latency_s=time.perf_counter() - t_submit,
+            latency_s=obs_request.now() - t_submit,
             shed=True,
             error=error,
         )
 
-    def _shed_now(self, series_id: str, t_submit: float, error: str) -> None:
-        self.metrics.note_shed_tick()
+    def _shed_now(
+        self,
+        series_id: str,
+        t_submit: float,
+        error: str,
+        tenant: Optional[str] = None,
+        trace=None,
+    ) -> None:
+        # with a live trace, the metrics label is the RECORDER-folded
+        # tenant: the shed counter and the request stanza must agree
+        # about which tenants are "overflow" (one fold decision per
+        # tick, made at enqueue)
+        label = trace.tenant if trace is not None else tenant
+        self.metrics.note_shed_tick(tenant=label)
+        self.recorder.shed(trace, error)
         self._undelivered.append(self._make_shed(series_id, t_submit, error))
         # the parked-response buffer is itself capacity-bounded: a
         # caller shedding forever without flushing must not grow it
@@ -699,15 +796,24 @@ class MicroBatchScheduler:
                 self._undelivered.pop(0)
                 self.metrics.note_superseded_response()
 
-    def _shed_oldest(self, series_id: Optional[str], reason: str) -> None:
-        """Shed the oldest pending tick (of ``series_id``, or overall) —
+    def _shed_oldest(self, tenant: Optional[str], reason: str) -> None:
+        """Shed the oldest pending tick (of ``tenant``, or overall) —
         for a filter the newest observation is the valuable one, so the
-        stale end of the queue is the right place to cut."""
+        stale end of the queue is the right place to cut. Quota
+        pressure sheds within the offending tenant only: a hot tenant's
+        burst must never evict a quiet tenant's queued tick."""
         for i, p in enumerate(self._pending):
-            if series_id is None or p[0] == series_id:
+            if tenant is None or p[3] == tenant:
                 del self._pending[i]
                 self._dec_pending(p[0])
-                self._shed_now(p[0], p[2], f"shed under pressure ({reason})")
+                self._dec_tenant(p[3])
+                self._shed_now(
+                    p[0],
+                    p[2],
+                    f"shed under pressure ({reason})",
+                    tenant=p[3],
+                    trace=p[4],
+                )
                 return
 
     def _dec_pending(self, series_id: str) -> None:
@@ -719,22 +825,49 @@ class MicroBatchScheduler:
         else:
             self._pending_count[series_id] = n
 
-    def submit(self, series_id: str, obs: Dict[str, Any]) -> None:
+    def _dec_tenant(self, tenant: str) -> None:
+        n = self._pending_tenant_count.get(tenant, 0) - 1
+        if n <= 0:
+            self._pending_tenant_count.pop(tenant, None)
+        else:
+            self._pending_tenant_count[tenant] = n
+
+    def submit(
+        self, series_id: str, obs: Dict[str, Any], tenant: Optional[str] = None
+    ) -> None:
         """Queue one tick for ``series_id``; runs at the next flush.
         ``obs``: dict of per-tick scalars (the model's data keys, e.g.
-        ``{"x": 4, "sign": 1}`` for Tayal).
+        ``{"x": 4, "sign": 1}`` for Tayal). ``tenant``: the
+        request-plane attribution/quota key for this tick — ``None``
+        falls back to the series' attach-time tenant, then to the
+        series id itself (the behavior-preserving default).
 
         Hot-path degrade contract (check_guards invariant 8): an
         unknown series sheds the tick (counted, delivered as a
         ``shed=True`` response at the next flush) instead of raising —
         unless a pager is attached and the series is registered, in
         which case it is transparently paged in and attached cold.
-        Admission pressure (queue depth / per-series quota) sheds
+        Admission pressure (queue depth / per-tenant quota) sheds
         oldest-first, never raises."""
-        now = time.perf_counter()
+        now = obs_request.now()
+        if tenant is None:
+            bound = self._tenant_of.get(series_id)
+            if bound is None:
+                tenant = series_id
+            else:
+                tenant = bound
+                # using a binding refreshes its LRU recency: "coldest"
+                # must mean least-recently-USED, or an actively-serving
+                # series' binding would be evicted by attach order and
+                # its traffic would escape its tenant's quota pool
+                self._tenant_of.move_to_end(series_id)
+        trace = self.recorder.enqueue(series_id, tenant)
         if series_id not in self._series:
             if self.pager is None:
-                self._shed_now(series_id, now, "series not attached")
+                self._shed_now(
+                    series_id, now, "series not attached",
+                    tenant=tenant, trace=trace,
+                )
                 return
             cap = None if self.admission is None else self.admission.max_series
             if cap is not None and len(self._series) >= cap:
@@ -745,6 +878,8 @@ class MicroBatchScheduler:
                     series_id,
                     now,
                     f"admission: max_series={cap} in-flight series reached",
+                    tenant=tenant,
+                    trace=trace,
                 )
                 return
             # load WITHOUT admitting residency: attach validates first,
@@ -752,29 +887,39 @@ class MicroBatchScheduler:
             snap = self.pager.load(series_id)
             if snap is None:
                 self._shed_now(
-                    series_id, now, "no servable snapshot to page in"
+                    series_id, now, "no servable snapshot to page in",
+                    tenant=tenant, trace=trace,
                 )
                 return
             rej = self.attach_many([(series_id, snap, None)])
             if rej:
                 self._shed_now(
-                    series_id, now, f"page-in attach rejected: {rej[0][1]}"
+                    series_id,
+                    now,
+                    f"page-in attach rejected: {rej[0][1]}",
+                    tenant=tenant,
+                    trace=trace,
                 )
                 return
         pol = self.admission
         if pol is not None:
             q = pol.max_pending_per_series
-            if q is not None and self._pending_count.get(series_id, 0) >= q:
-                # shed-over-quota: this series' own oldest tick yields
+            if q is not None and self._pending_tenant_count.get(tenant, 0) >= q:
+                # shed-over-quota: this TENANT's own oldest tick yields
+                # (default tenant = series keeps the historical
+                # per-series behavior bit-for-bit)
                 self._shed_oldest(
-                    series_id, f"per-series quota {q} (tenant={series_id!r})"
+                    tenant, f"per-tenant quota {q} (tenant={tenant!r})"
                 )
             d = pol.max_queue_depth
             if d is not None and len(self._pending) >= d:
                 self._shed_oldest(None, f"queue depth {d}")
-        self._pending.append((series_id, obs, now))
+        self._pending.append((series_id, obs, now, tenant, trace))
         self._pending_count[series_id] = (
             self._pending_count.get(series_id, 0) + 1
+        )
+        self._pending_tenant_count[tenant] = (
+            self._pending_tenant_count.get(tenant, 0) + 1
         )
         if self.pager is not None:
             # a queued tick pins its snapshot: evicting it would shed
@@ -826,7 +971,7 @@ class MicroBatchScheduler:
         carried, self._undelivered = self._undelivered, []
         if not self._pending:
             return carried
-        t0 = time.perf_counter()
+        t0 = obs_request.now()
         pol = self.admission
         budget = (
             len(self._pending)
@@ -839,6 +984,11 @@ class MicroBatchScheduler:
         )
         for p in pending:
             self._dec_pending(p[0])
+            self._dec_tenant(p[3])
+        # request plane: the drained ticks are admitted NOW (the
+        # remainder keeps aging in the queue — that wait is exactly the
+        # queue-share the lifecycle decomposition must attribute)
+        self.recorder.admit([p[4] for p in pending])
         waves: List[list] = []
         wave, seen = [], set()
         for p in pending:
@@ -849,7 +999,8 @@ class MicroBatchScheduler:
             seen.add(p[0])
         waves.append(wave)
         responses: List[TickResponse] = []
-        folded: List[Tuple[str, Dict[str, Any], float]] = []
+        # drained-entry shape: (series_id, obs, t_submit, tenant, trace)
+        folded: List[Tuple[str, Dict[str, Any], float, str, Any]] = []
         for wave in waves:
             # the observation keyset is the jit signature: ticks with
             # foreign keys shed-degrade instead of retracing the warm
@@ -870,18 +1021,21 @@ class MicroBatchScheduler:
             for p in wave:
                 keys = tuple(sorted(p[1].keys()))
                 if keys != ref:
-                    self.metrics.note_shed_tick()
-                    responses.append(
-                        self._make_shed(
-                            p[0],
-                            p[2],
-                            f"observation keys {list(keys)} do not match "
-                            f"this scheduler's locked keys {list(ref)}",
-                        )
+                    err = (
+                        f"observation keys {list(keys)} do not match "
+                        f"this scheduler's locked keys {list(ref)}"
                     )
+                    self.metrics.note_shed_tick(
+                        tenant=p[4].tenant if p[4] is not None else p[3]
+                    )
+                    self.recorder.shed(p[4], err)
+                    responses.append(self._make_shed(p[0], p[2], err))
                 elif p[0] not in self._series:
                     # detached between submit and flush
-                    self.metrics.note_shed_tick()
+                    self.metrics.note_shed_tick(
+                        tenant=p[4].tenant if p[4] is not None else p[3]
+                    )
+                    self.recorder.shed(p[4], "series detached")
                     responses.append(
                         self._make_shed(p[0], p[2], "series detached")
                     )
@@ -907,17 +1061,27 @@ class MicroBatchScheduler:
                         # the remaining groups (invariant 8)
                         if _looks_like_device_loss(e):
                             self.metrics.note_device_loss()
-                        self.metrics.note_dispatch_error(len(chunk))
+                        self.metrics.note_dispatch_error(
+                            len(chunk),
+                            tenants=[
+                                p[4].tenant if p[4] is not None else p[3]
+                                for p in chunk
+                            ],
+                        )
                         err = f"{type(e).__name__}: {e}"
+                        for p in chunk:
+                            self.recorder.shed(
+                                p[4], f"dispatch failed ({err})"
+                            )
                         responses.extend(
                             self._make_shed(
                                 s, ts, f"dispatch failed ({err})"
                             )
-                            for s, _, ts in chunk
+                            for s, _, ts, _, _ in chunk
                         )
-        done = time.perf_counter()
-        for _, _, t_submit in folded:
-            self.metrics.observe_latency(done - t_submit)
+        done = obs_request.now()
+        for p in folded:
+            self.metrics.observe_latency(done - p[2])
         self.metrics.observe_flush(len(folded), done - t0)
         if self._oldest_attach_t is not None:
             # age of the OLDEST serving posterior: the staleness gauge
@@ -929,6 +1093,9 @@ class MicroBatchScheduler:
             # page-in (a pin-heavy flush may have overrun transiently)
             self.pager.shrink_to_budget()
         self._maybe_profile_flush()
+        # request plane: publish this flush's fairness observables
+        # (tenant interleaving, max queue-age at dispatch, p99 spread)
+        self.recorder.flush_done()
         self._refresh_compile_count()
         return carried + responses
 
@@ -964,6 +1131,9 @@ class MicroBatchScheduler:
         except Exception:  # a profile probe must never shed real ticks
             return
         self.metrics.note_flush_profile(kernel, bucket, timing.p50_s)
+        # the request plane's pure-device refinement: the same warm
+        # re-timed p50 (zero added compiles by construction)
+        self.recorder.note_device_time(kernel, bucket, timing.p50_s)
         with span("serve.flush_profile") as sp:
             sp.annotate(
                 kernel=kernel,
@@ -976,11 +1146,15 @@ class MicroBatchScheduler:
             return []
         lanes = self._pad_lanes(group)
         bn = len(lanes)
+        # request-plane stamps go on the GROUP's traces (padded lanes
+        # repeat entries; stamping lanes would double-stamp)
+        traces = [p[4] for p in group]
+        self.recorder.stage(traces, "bucket")
         obs_keys = sorted(group[0][1].keys())  # validated by flush()
         obs_b = {}
         dtype_locks: Dict[str, Any] = {}
         for k in obs_keys:
-            arr = jnp.asarray(np.stack([np.asarray(obs[k]) for _, obs, _ in lanes]))
+            arr = jnp.asarray(np.stack([np.asarray(p[1][k]) for p in lanes]))
             # canonical per-key dtype: a producer oscillating between
             # numpy and Python scalars (same value domain) must not
             # change the jit signature and retrace the warm kernel.
@@ -1003,7 +1177,7 @@ class MicroBatchScheduler:
         # the draw bank is immutable between attaches: cache the stacked
         # [bucket, D, dim] array per lane membership so the per-tick hot
         # path ships only the arrays that actually change (alpha/ll/ok)
-        lane_key = tuple(s for s, _, _ in lanes)
+        lane_key = tuple(p[0] for p in lanes)
         # planner-chosen sharded flush: big buckets commit their batch
         # axis onto the plan's series mesh axis before dispatch; whether
         # a bucket shards depends only on its size, so the jit signature
@@ -1033,11 +1207,16 @@ class MicroBatchScheduler:
                 fn, fargs = self._init_j, (draws_b, obs_b)
             else:
                 alpha_b = place(
-                    jnp.stack([self._series[s]["alpha"] for s, _, _ in lanes])
+                    jnp.stack([self._series[p[0]]["alpha"] for p in lanes])
                 )
-                ll_b = place(jnp.stack([self._series[s]["ll"] for s, _, _ in lanes]))
-                ok_b = place(jnp.stack([self._series[s]["ok"] for s, _, _ in lanes]))
+                ll_b = place(jnp.stack([self._series[p[0]]["ll"] for p in lanes]))
+                ok_b = place(jnp.stack([self._series[p[0]]["ok"] for p in lanes]))
                 fn, fargs = self._update_j, (draws_b, alpha_b, ll_b, ok_b, obs_b)
+            # batch formation ends here: everything before this stamp
+            # (lane padding, dtype locks, state stacking) is the
+            # request plane's "form" share; the synced call below is
+            # its "device" share
+            self.recorder.stage(traces, "dispatch")
             alpha, ll, okd, probs, mean_ll = jax.block_until_ready(fn(*fargs))
         self._obs_dtypes.update(dtype_locks)  # dispatch succeeded
         if self.profile_every and trace_enabled():
@@ -1054,9 +1233,11 @@ class MicroBatchScheduler:
         self._note_signature(
             kernel, bn, tuple(str(obs_b[k].dtype) for k in obs_keys)
         )
-        done = time.perf_counter()
+        done = obs_request.now()
+        # device-complete: reuse the post-sync read (no second clock)
+        self.recorder.stage(traces, "device", t=done)
         responses = []
-        for i, (series_id, _, t_submit) in enumerate(group):
+        for i, (series_id, _, t_submit, _, _) in enumerate(group):
             rec = self._series[series_id]
             rec["alpha"], rec["ll"], rec["ok"] = alpha[i], ll[i], okd[i]
             n_ok = int(np.asarray(okd[i]).sum())
@@ -1073,6 +1254,8 @@ class MicroBatchScheduler:
                     latency_s=done - t_submit,
                 )
             )
+        # respond: the post-process share ends with the built responses
+        self.recorder.complete_group(traces, kernel=kernel, bucket=bn)
         return responses
 
     # ---- introspection ----

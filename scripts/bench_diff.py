@@ -50,6 +50,21 @@ Further gate rules:
   followed by a record with ``faults_escaped > 0`` — an injected fault
   leaking out as an exception is a survival regression even if the
   bench somehow exited 0.
+- **Request-plane health gates inverted too**: a record whose manifest
+  stanza carries a ``request`` stanza (`hhmm_tpu/obs/request.py`,
+  embedded by ``bench.py --serve`` / ``--serve-storm``) fails the gate
+  when its fairness p99 spread (``fairness.p99_spread_ms``) or overall
+  queue share (``overall.queue_share``) GREW by more than the
+  threshold against the previous comparable record — spread growth is
+  tenant starvation creeping in, queue-share growth is latency
+  migrating out of the device and into the pending queue; both are
+  lower-is-better, so the throughput threshold applies with the sign
+  flipped. A zero/absent baseline cannot gate, and neither can a
+  baseline below the noise floor (spread < 5 ms / queue share < 0.05):
+  unlike the large stable throughput values this gate mirrors, a
+  near-zero spread is cross-tenant scheduling jitter, and relative
+  growth on jitter would false-fail CI (both cases report as the
+  request-plane baseline instead).
 - **Kernel device time gates inverted**: a record whose manifest
   stanza carries a ``kernel_costs`` table (`bench.py
   --profile-kernels`, `hhmm_tpu/obs/profile.py`) fails the gate when
@@ -179,6 +194,7 @@ def diff(
     last_slo_by_key: Dict[Tuple, bool] = {}
     last_escaped_by_key: Dict[Tuple, int] = {}
     last_costs_by_key: Dict[Tuple, Dict[str, float]] = {}
+    last_request_by_key: Dict[Tuple, Dict[str, Optional[float]]] = {}
     failures = 0
     for rnd in rounds:
         rec = rnd["record"]
@@ -292,6 +308,66 @@ def diff(
                 else:
                     row["status"] += "; faults contained"
                 last_escaped_by_key[key] = esc
+            # the request plane rides the same key, gated INVERTED
+            # (lower is better): fairness-spread growth is tenant
+            # starvation creeping in, queue-share growth is latency
+            # migrating into the pending queue (obs/request.py)
+            req = (rec.get("manifest") or {}).get("request")
+            if isinstance(req, dict):
+                cur: Dict[str, Optional[float]] = {}
+                # (observable, noise floor a baseline must clear to
+                # gate): relative growth on a jitter-scale baseline
+                # is not a regression signal
+                floors = {"fairness-spread": 5.0, "queue-share": 0.05}
+                for label, obs in (
+                    (
+                        "fairness-spread",
+                        (req.get("fairness") or {}).get("p99_spread_ms"),
+                    ),
+                    (
+                        "queue-share",
+                        (req.get("overall") or {}).get("queue_share"),
+                    ),
+                ):
+                    cur[label] = (
+                        float(obs) if isinstance(obs, (int, float)) else None
+                    )
+                prev_req = last_request_by_key.get(key) or {}
+                regressions = []
+                n_req_gated = 0
+                for label, v in cur.items():
+                    pv = prev_req.get(label)
+                    if v is None or not pv or pv < floors[label]:
+                        continue  # unmeasured / noise-floor baseline
+                    n_req_gated += 1
+                    delta = 100.0 * (v - pv) / pv
+                    if delta > threshold_pct:
+                        regressions.append(f"{label} {delta:+.1f}%")
+                if regressions:
+                    failures += 1
+                    row["gated"] = True
+                    row["status"] += (
+                        "; REQUEST-PLANE REGRESSION: "
+                        + ", ".join(regressions)
+                        + f" (threshold +{threshold_pct:g}%)"
+                    )
+                elif n_req_gated:
+                    row["status"] += (
+                        f"; request plane ok ({n_req_gated} observable(s))"
+                    )
+                elif any(v is not None for v in cur.values()):
+                    row["status"] += "; request-plane baseline"
+                if any(v is not None for v in cur.values()):
+                    # merge per label: a record missing ONE observable
+                    # (e.g. a spread that was None this round) must not
+                    # erase the other's measured baseline — the next
+                    # measured value still gates against the last
+                    # measured one
+                    merged = dict(prev_req)
+                    merged.update(
+                        {l: v for l, v in cur.items() if v is not None}
+                    )
+                    last_request_by_key[key] = merged
             # kernel device time rides the same key, gated INVERTED:
             # a measured row whose p50 grew past the threshold against
             # the previous comparable record's same row is a device-

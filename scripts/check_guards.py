@@ -94,6 +94,19 @@ depend on:
    ``scripts/tpu_*_probe.py`` drivers are exempt (their timed loops
    are the measurement products themselves, and the probes now route
    through the harness anyway — migrated where trivial).
+10. **Serve-layer clocks route through the request plane**
+   (`hhmm_tpu/obs/request.py`, `docs/observability.md` "request
+   plane"): no raw ``perf_counter`` read anywhere under
+   ``hhmm_tpu/serve/`` — neither the bare imported name nor the
+   ``time.perf_counter()`` / ``trace.perf_counter()`` attribute
+   spelling. The serve hot paths used to sprinkle ad-hoc
+   ``perf_counter`` deltas (one end-to-end stamp per tick); those all
+   migrated into the per-tick lifecycle recorder
+   (``TickTrace``/``RequestRecorder``), whose stamps decompose latency
+   into queue/batch-formation/device/post-process shares per tenant. A
+   new raw read in the serve layer would be a timing the request plane
+   cannot see — route it through ``obs_request.now`` or a recorder
+   stage stamp instead.
 
 Exit 0 when clean, 1 with one line per violation. Run by
 ``tests/test_robust.py`` (and re-asserted by ``tests/test_serve.py``,
@@ -172,6 +185,11 @@ HOT_PATH_DISPATCH_ATTR = "_dispatch"
 # invariant 9: raw timing loops confined to the profiling harness —
 # the one module allowed to clock a batch of synced device calls
 TIMING_HARNESS_FILE = "hhmm_tpu/obs/profile.py"
+
+# invariant 10: the serve layer reads no raw clocks — every timing
+# read under hhmm_tpu/serve/ routes through the request plane
+# (hhmm_tpu/obs/request.py: `now` or a lifecycle recorder stamp)
+SERVE_DIR_PREFIX = "hhmm_tpu/serve/"
 
 
 def _bare_excepts(tree: ast.Module, rel: str, problems: List[str]) -> None:
@@ -569,6 +587,28 @@ def _check_timing_harness(tree: ast.Module, rel: str, problems: List[str]) -> No
                 )
 
 
+def _check_serve_clock_confinement(
+    tree: ast.Module, rel: str, problems: List[str]
+) -> None:
+    """Invariant 10: flag every ``perf_counter`` call under
+    ``hhmm_tpu/serve/`` — the bare imported name and the attribute
+    spelling both. The serve layer's clock reads belong to the
+    request-plane lifecycle recorder (`hhmm_tpu/obs/request.py`), where
+    per-tick stamps stay decomposable and tenant-attributable."""
+    if not rel.replace("\\", "/").startswith(SERVE_DIR_PREFIX):
+        return
+    pc_names = _perf_counter_names(tree)
+    for node in ast.walk(tree):
+        if _is_perf_counter_call(node, pc_names):
+            problems.append(
+                f"{rel}:{node.lineno}: raw `perf_counter` read in the "
+                "serve layer — per-tick timing must route through the "
+                "request-plane lifecycle recorder (hhmm_tpu.obs.request "
+                "`now`/stage stamps; see docs/observability.md request "
+                "plane)"
+            )
+
+
 def check(root: pathlib.Path) -> List[str]:
     problems: List[str] = []
     pkg = root / "hhmm_tpu"
@@ -588,6 +628,8 @@ def check(root: pathlib.Path) -> List[str]:
         _check_placement_confinement(tree, rel, problems)
         # invariant 9: timing loops confined to the profiling harness
         _check_timing_harness(tree, rel, problems)
+        # invariant 10: serve-layer clocks confined to the request plane
+        _check_serve_clock_confinement(tree, rel, problems)
         # invariant 5b over the serving layer: every module with a
         # jax.jit entry point registers it with the telemetry registry
         if py.parent == serve_dir:
@@ -721,7 +763,8 @@ def main(argv: List[str]) -> int:
         "monotonic clocks only; serve/bench jits telemetry-registered; "
         "one shared metrics plane; placement objects confined to the "
         "planner; serve hot paths degrade, never raise; timing loops "
-        "confined to the obs/profile.py harness)"
+        "confined to the obs/profile.py harness; serve-layer clocks "
+        "confined to the obs/request.py plane)"
     )
     return 0
 

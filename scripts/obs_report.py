@@ -12,6 +12,9 @@ SLO attainment). This script folds all of it into one readable report:
   == compile ==      backend compiles, per-phase seconds, per-entry-point
                      jit cache sizes, component scopes
   == memory ==       per-device peak watermarks (where exposed)
+  == plan ==         the execution planner's resolved layout (PR 6,
+                     `hhmm_tpu/plan/`): mesh axes, chunk, bucket ladder,
+                     time-parallel branch, idle-device rationale
   == kernel costs == the `obs/profile.py` cost plane: per-kernel device
                      time, FLOPs, roofline fraction, and which dispatch
                      branches are DB-backed vs table-backed vs unmeasured
@@ -19,8 +22,12 @@ SLO attainment). This script folds all of it into one readable report:
                      trajectory a traced `batch/fit.py` run emits
   == serving ==      tick latency, throughput, staleness, drift alarms,
                      overload/resilience counters (shed/pager/device loss)
+  == request timeline == the `obs/request.py` plane: per-tenant tick
+                     latency decomposed into queue/device/other shares,
+                     windowed p50/p99, sheds, and the fairness
+                     observables (p99 spread, queue age, interleaving)
   == storm ==        the `bench.py --serve-storm` verdict: faults
-                     injected/escaped + survival gates
+                     injected/escaped + survival gates, fairness arms
   == slo ==          per-check PASS/FAIL + overall attainment
 
 Inputs: the full manifest JSON (``bench.py --manifest-out`` /
@@ -224,6 +231,120 @@ def _record_manifest(man: Dict[str, Any]) -> Dict[str, Any]:
     return {}
 
 
+def render_plan(man: Dict[str, Any], out) -> None:
+    """The execution planner's resolved-layout stanza (`hhmm_tpu/plan/`
+    ``note_stanza("plan", ...)``, landed in PR 6): which mesh/chunk/
+    branch actually ran, and why devices idled if any did."""
+    plan = man.get("plan") or _record_manifest(man).get("plan")
+    if not isinstance(plan, dict):
+        return  # not a planned run: no section
+    _section("plan", out)
+    wl = plan.get("workload") or {}
+    if wl:
+        print(
+            "  workload: "
+            + " ".join(f"{k}={_fmt(wl.get(k))}" for k in ("B", "T", "C", "K")),
+            file=out,
+        )
+    mesh = plan.get("mesh")
+    if isinstance(mesh, dict) and mesh:
+        mesh_s = " x ".join(f"{k}:{v}" for k, v in mesh.items())
+    else:
+        mesh_s = "none (single device)"
+    print(
+        f"  mesh: {mesh_s}  (devices used "
+        f"{_fmt(plan.get('devices_used'))}/{_fmt(plan.get('devices'))} "
+        f"on {_fmt(plan.get('platform'))})",
+        file=out,
+    )
+    chunk, req = plan.get("chunk"), plan.get("chunk_requested")
+    chunk_s = _fmt(chunk)
+    if req is not None and req != chunk:
+        chunk_s += f" (requested {_fmt(req)}, rounded to the series ways)"
+    print(f"  chunk: {chunk_s}", file=out)
+    buckets = plan.get("buckets")
+    if buckets:
+        print(
+            f"  serve buckets: {buckets} (shard from "
+            f"{_fmt(plan.get('shard_min_bucket'))} lanes)",
+            file=out,
+        )
+    print(f"  time-parallel branch: {_fmt(plan.get('branch'))}", file=out)
+    if plan.get("reason"):
+        print(f"  rationale: {plan['reason']}", file=out)
+
+
+def _pct(v: Any) -> str:
+    return f"{100 * v:.1f}%" if isinstance(v, (int, float)) else "-"
+
+
+def render_request(man: Dict[str, Any], out) -> None:
+    """The request plane (`hhmm_tpu/obs/request.py`): per-tenant
+    lifecycle decomposition + fairness observables."""
+    req = man.get("request") or _record_manifest(man).get("request")
+    if not isinstance(req, dict):
+        return  # no lifecycle recorder in this run: no section
+    _section("request timeline", out)
+    rows = []
+    for tenant, t in sorted((req.get("tenants") or {}).items()):
+        if not isinstance(t, dict):
+            continue
+        rows.append(
+            (
+                tenant,
+                _fmt(t.get("ticks")),
+                _fmt(t.get("sheds")),
+                _fmt(t.get("p50_ms")),
+                _fmt(t.get("p99_ms")),
+                _pct(t.get("queue_share")),
+                _pct(t.get("device_share")),
+                _pct(t.get("other_share")),
+                _fmt(t.get("max_queue_depth")),
+            )
+        )
+    _table(
+        (
+            "tenant",
+            "ticks",
+            "sheds",
+            "p50_ms",
+            "p99_ms",
+            "queue",
+            "device",
+            "other",
+            "max_q",
+        ),
+        rows,
+        out,
+    )
+    omitted = req.get("tenants_omitted")
+    if omitted:
+        print(f"  (+{omitted} tenant(s) omitted from the stanza)", file=out)
+    overall = req.get("overall") or {}
+    if overall:
+        print(
+            f"  overall: {_fmt(overall.get('ticks'))} ticks, "
+            f"{_fmt(overall.get('sheds'))} sheds — queue "
+            f"{_pct(overall.get('queue_share'))}, device "
+            f"{_pct(overall.get('device_share'))}, other "
+            f"{_pct(overall.get('other_share'))} "
+            f"(window {_fmt(req.get('window_s'))} s)",
+            file=out,
+        )
+    fair = req.get("fairness") or {}
+    if fair:
+        print(
+            f"  fairness: p99 spread {_fmt(fair.get('p99_spread_ms'))} ms, "
+            f"max queue-age at dispatch {_fmt(fair.get('max_queue_age_ms'))} "
+            f"ms, {_fmt(fair.get('mean_flush_tenants'))} tenants/flush over "
+            f"{_fmt(fair.get('flushes'))} flushes",
+            file=out,
+        )
+    profiled = req.get("profiled_device_ms") or {}
+    for k, v in sorted(profiled.items()):
+        print(f"  warm device re-time {k}: {_fmt(v)} ms", file=out)
+
+
 def render_kernel_costs(man: Dict[str, Any], out) -> None:
     """The `obs/profile.py` cost plane: measured device time + XLA cost
     analysis per kernel/branch, and the dispatch-source audit — which
@@ -278,14 +399,23 @@ def render_kernel_costs(man: Dict[str, Any], out) -> None:
 
 def render_storm(man: Dict[str, Any], out) -> None:
     """The ``--serve-storm`` stanza (`bench.py`): injected-fault plan,
-    escaped-fault count, and the survival gates — the section this
-    report silently dropped before it learned the PR 7 schema."""
+    escaped-fault count, the survival gates — the section this
+    report silently dropped before it learned the PR 7 schema — and
+    the two-tenant fairness arms (balanced probe vs skewed storm)."""
     storm = man.get("storm") or _record_manifest(man).get("storm")
     if not isinstance(storm, dict):
         return  # not a storm run: no section (unlike slo, storms are rare)
     _section("storm", out)
     esc = storm.get("faults_escaped")
     print(f"  faults escaped: {_fmt(esc)}", file=out)
+    fair = storm.get("fairness")
+    if isinstance(fair, dict):
+        print(
+            "  fairness arms: skewed p99 spread "
+            f"{_fmt(fair.get('skewed_p99_spread_ms'))} ms vs balanced "
+            f"{_fmt(fair.get('balanced_p99_spread_ms'))} ms",
+            file=out,
+        )
     inj = storm.get("faults_injected") or {}
     if isinstance(inj, dict):
         for name, spec in sorted(inj.items()):
@@ -417,9 +547,11 @@ def render(man: Dict[str, Any], metrics: Dict[str, Dict[str, Any]], out) -> None
     render_spans(man, out)
     render_compile(man, out)
     render_memory(man, out)
+    render_plan(man, out)
     render_kernel_costs(man, out)
     render_convergence(metrics, out)
     render_serving(metrics, out)
+    render_request(man, out)
     render_storm(man, out)
     render_slo(man, out)
 

@@ -26,6 +26,8 @@ jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import json
+
 import numpy as np
 import pytest
 
@@ -36,6 +38,76 @@ def pytest_configure(config):
         "slow: multi-minute tests (full apps, SBC suites, batch engines); "
         "`pytest -m 'not slow'` is the fast iteration subset (~13 min)",
     )
+
+
+# ---- tier-1 duration ledger ----
+#
+# The tier-1 suite runs under a hard 870 s timeout (ROADMAP "Tier-1
+# verify"); historically the only signal that the suite outgrew its
+# budget was the timeout itself killing the run at N%. This ledger
+# records every non-slow test's measured duration (setup + call +
+# teardown) and persists it at session end, so the slow-marked
+# headroom guard (`tests/test_durations.py`) can fail LOUDLY when the
+# measured total crosses 800 s — before the 870 s ceiling is
+# rediscovered by timeout. Persistence is guarded (`_should_persist`):
+# only a CLEAN session (exitstatus 0) that exercised a meaningful
+# slice of the suite — and at least ~80% of whatever the previous
+# ledger covered — may replace the measurement. A one-file iteration
+# run, an aborted/failed session, or a partial subset must not clobber
+# the full ledger with an understated total the guard would then
+# vacuously pass.
+
+DURATIONS_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    ".tier1_durations.json",
+)
+_MIN_TESTS_TO_PERSIST = 100
+_nonslow_durations = {}
+
+
+def _should_persist(exitstatus, n_new, prev_n):
+    """Whether a finished session may replace the duration ledger.
+    Pure decision logic (unit-tested in `tests/test_durations.py`)."""
+    if exitstatus != 0:
+        return False  # aborted/failed run: totals are understated
+    if n_new < _MIN_TESTS_TO_PERSIST:
+        return False  # one-file iteration run
+    if prev_n and n_new < 0.8 * prev_n:
+        return False  # multi-file subset vs a fuller prior measurement
+    return True
+
+
+def pytest_runtest_logreport(report):
+    if "slow" in report.keywords:
+        return
+    _nonslow_durations[report.nodeid] = (
+        _nonslow_durations.get(report.nodeid, 0.0) + report.duration
+    )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    prev_n = 0
+    try:
+        with open(DURATIONS_PATH) as f:
+            prev_n = int(json.load(f).get("n_tests", 0))
+    except (OSError, ValueError):
+        pass
+    if not _should_persist(exitstatus, len(_nonslow_durations), prev_n):
+        return
+    ledger = {
+        "total_s": round(sum(_nonslow_durations.values()), 3),
+        "n_tests": len(_nonslow_durations),
+        "tests": {
+            k: round(v, 3) for k, v in _nonslow_durations.items()
+        },
+    }
+    try:
+        tmp = DURATIONS_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(ledger, f, indent=0, sort_keys=True)
+        os.replace(tmp, DURATIONS_PATH)
+    except OSError:
+        pass  # a read-only checkout must not fail the suite
 
 
 @pytest.fixture

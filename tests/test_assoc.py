@@ -179,6 +179,7 @@ class TestAssoc:
         np.testing.assert_allclose(np.asarray(a), np.asarray(a_ref), rtol=2e-5, atol=1e-5)
         assert float(ll) == float(ll_ref) == -np.inf
 
+    @pytest.mark.slow  # measured multi-second on the single-core tier-1 host (.tier1_durations.json); full-suite coverage only
     def test_impossible_state_grads_finite(self, rng):
         """An all-(−inf) COLUMN (state impossible at every step) makes
         the prefix products carry fully-(−inf) columns; the guarded
@@ -396,6 +397,7 @@ class TestFFBSAssoc:
         assert (np.asarray(z) == np.asarray(z_ref)).all()
         np.testing.assert_allclose(float(ll), float(ll_ref), rtol=1e-5)
 
+    @pytest.mark.slow  # measured multi-second on the single-core tier-1 host (.tier1_durations.json); full-suite coverage only
     def test_key_parity_with_ffbs_fused(self, rng):
         """Same PRNG key → same uniforms → same draws as ffbs_fused, so
         the dispatch layer swaps them freely."""
@@ -408,6 +410,7 @@ class TestFFBSAssoc:
         assert (np.asarray(z_f) == np.asarray(z_a)).all()
         np.testing.assert_allclose(float(ll_f), float(ll_a), rtol=1e-5)
 
+    @pytest.mark.slow  # measured multi-second on the single-core tier-1 host (.tier1_durations.json); full-suite coverage only
     def test_f64(self):
         rng = np.random.default_rng(6)
         with jax.experimental.enable_x64():
@@ -464,6 +467,7 @@ class TestDispatch:
             else:
                 assert not use_assoc(2, 1 << 20, "auto", platform=platform)
 
+    @pytest.mark.slow  # measured multi-second on the single-core tier-1 host (.tier1_durations.json); full-suite coverage only
     def test_dispatch_branches_agree(self, rng):
         T, K = 30, 3
         log_pi, log_A, log_obs = _inputs(rng, T, K)
@@ -503,6 +507,7 @@ class TestDispatch:
         )
         assert (np.asarray(g_tp["zstar"]) == np.asarray(g_seq["zstar"])).all()
 
+    @pytest.mark.slow  # measured multi-second on the single-core tier-1 host (.tier1_durations.json); full-suite coverage only
     def test_gibbs_time_parallel_parity(self, rng):
         """sample_gibbs draws are identical under forced assoc routing
         (same uniforms, same inverse-CDF math)."""
@@ -640,6 +645,7 @@ class TestSeqShard:
 
 
 class TestAssocSweepBench:
+    @pytest.mark.slow  # measured multi-second on the single-core tier-1 host (.tier1_durations.json); full-suite coverage only
     def test_quick_sweep_record(self):
         """`bench.py --assoc-sweep --quick` must exit 0 and emit the
         tayal_assoc_decode_throughput record (the tier-1 regression

@@ -1,0 +1,108 @@
+"""Tier-1 duration headroom guard (ISSUE 10 CI/tooling satellite).
+
+The tier-1 suite runs under a hard 870 s timeout (ROADMAP "Tier-1
+verify"); before this guard the only way to learn the suite had
+outgrown its budget was the timeout killing the run mid-percentage.
+`tests/conftest.py` now persists a per-test duration ledger
+(``.tier1_durations.json``) whenever a session runs a meaningful slice
+of the non-slow suite; the slow-marked guard here loads that ledger and
+fails — naming the top offenders — when the measured non-slow total
+crosses the 800 s headroom bar, 70 s before the ceiling.
+
+The check itself (:func:`headroom_verdict`) is a pure function, so the
+fast tests pin both sides of its behavior in tier-1 without needing a
+real ledger.
+"""
+
+import json
+import os
+
+import pytest
+
+from conftest import DURATIONS_PATH, _should_persist
+
+# the bar sits 70 s under the 870 s tier-1 timeout: enough slack for
+# host jitter, loud before the ceiling is rediscovered by timeout
+TIER1_BUDGET_S = 800.0
+
+
+def headroom_verdict(ledger: dict, budget_s: float = TIER1_BUDGET_S):
+    """``(ok, message)`` for one duration ledger. The message names the
+    total, the budget, and the top offenders — the actionable output
+    when the guard trips (mark the offenders slow, or speed them up)."""
+    total = float(ledger.get("total_s", 0.0))
+    tests = ledger.get("tests") or {}
+    top = sorted(tests.items(), key=lambda kv: -kv[1])[:10]
+    offenders = "\n".join(f"  {v:8.1f}s  {k}" for k, v in top)
+    msg = (
+        f"non-slow suite measured at {total:.1f} s over {len(tests)} tests "
+        f"(budget {budget_s:g} s; tier-1 timeout 870 s).\nTop offenders:\n"
+        f"{offenders}"
+    )
+    return total <= budget_s, msg
+
+
+class TestHeadroomVerdict:
+    """Tier-1 coverage of the guard logic (no ledger required)."""
+
+    def test_under_budget_passes(self):
+        ok, msg = headroom_verdict(
+            {"total_s": 700.0, "tests": {"tests/a.py::t1": 700.0}}, 800.0
+        )
+        assert ok and "700.0 s" in msg
+
+    def test_over_budget_fails_naming_offenders(self):
+        ledger = {
+            "total_s": 850.0,
+            "tests": {"tests/big.py::t_huge": 600.0, "tests/a.py::t1": 250.0},
+        }
+        ok, msg = headroom_verdict(ledger, 800.0)
+        assert not ok
+        assert "t_huge" in msg.splitlines()[2]  # biggest offender first
+
+    def test_empty_ledger_passes(self):
+        ok, _ = headroom_verdict({}, 800.0)
+        assert ok
+
+
+class TestLedgerPersistGuard:
+    """The conftest write guard: a partial, failed, or subset run must
+    never replace the full measurement with an understated total (the
+    guard would then vacuously pass while the real suite is over
+    budget)."""
+
+    def test_clean_full_run_persists(self):
+        assert _should_persist(0, 560, prev_n=555)
+
+    def test_failed_run_never_persists(self):
+        assert not _should_persist(1, 560, prev_n=0)
+
+    def test_small_iteration_run_never_persists(self):
+        assert not _should_persist(0, 40, prev_n=560)
+
+    def test_subset_run_does_not_clobber_fuller_ledger(self):
+        # 170-test multi-file subset vs a 560-test prior measurement
+        assert not _should_persist(0, 170, prev_n=560)
+
+    def test_first_ever_ledger_needs_no_prior(self):
+        assert _should_persist(0, 300, prev_n=0)
+
+    def test_suite_may_shrink_moderately(self):
+        # marking a handful of tests slow must not wedge the ledger
+        assert _should_persist(0, 500, prev_n=560)
+
+
+@pytest.mark.slow
+def test_tier1_duration_headroom():
+    """The guard: fails when the last measured non-slow suite total
+    exceeds the 800 s headroom bar. Skips (visibly) when no ledger has
+    been recorded yet — the first full non-slow run writes it."""
+    if not os.path.exists(DURATIONS_PATH):
+        pytest.skip(
+            "no tier-1 duration ledger yet — run the non-slow suite "
+            f"once to record {os.path.basename(DURATIONS_PATH)}"
+        )
+    with open(DURATIONS_PATH) as f:
+        ledger = json.load(f)
+    ok, msg = headroom_verdict(ledger)
+    assert ok, msg

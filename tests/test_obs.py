@@ -891,7 +891,7 @@ def _run_bench_diff(*argv):
 
 
 def _write_fixture_rounds(
-    d, values, stamped=True, traced=None, slo=None, escaped=None
+    d, values, stamped=True, traced=None, slo=None, escaped=None, request=None
 ):
     for n, v in enumerate(values, start=1):
         rec = {
@@ -907,6 +907,14 @@ def _write_fixture_rounds(
                 "versions": {"jax": "0.0-test"},
                 "trace_enabled": bool(traced[n - 1]) if traced else False,
             }
+            if request is not None and request[n - 1] is not None:
+                spread, qshare = request[n - 1]
+                rec["manifest"]["request"] = {
+                    "window_s": 60.0,
+                    "tenants": {},
+                    "overall": {"ticks": 100, "queue_share": qshare},
+                    "fairness": {"p99_spread_ms": spread},
+                }
             if escaped is not None and escaped[n - 1] is not None:
                 rec["manifest"]["storm"] = {
                     "faults_escaped": int(escaped[n - 1])
@@ -1050,6 +1058,92 @@ class TestBenchDiffResilience:
         assert "no clean baseline" in proc.stdout
 
 
+class TestBenchDiffRequestPlane:
+    """The `request` manifest stanza (`hhmm_tpu/obs/request.py`) gates
+    INVERTED on the same comparability key: fairness-spread or
+    queue-share growth past the threshold is a request-plane
+    regression (starvation creeping in / latency migrating into the
+    queue)."""
+
+    def test_spread_growth_fails(self, tmp_path):
+        _write_fixture_rounds(
+            tmp_path,
+            [100.0, 100.0],
+            request=[(10.0, 0.2), (25.0, 0.2)],
+        )
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 1, proc.stdout
+        assert "REQUEST-PLANE REGRESSION" in proc.stdout
+        assert "fairness-spread" in proc.stdout
+
+    def test_queue_share_growth_fails(self, tmp_path):
+        _write_fixture_rounds(
+            tmp_path,
+            [100.0, 100.0],
+            request=[(10.0, 0.2), (10.0, 0.5)],
+        )
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 1, proc.stdout
+        assert "queue-share" in proc.stdout
+
+    def test_flat_observables_pass(self, tmp_path):
+        _write_fixture_rounds(
+            tmp_path,
+            [100.0, 99.0],
+            request=[(10.0, 0.2), (10.5, 0.21)],
+        )
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 0, proc.stdout
+        assert "request plane ok (2 observable(s))" in proc.stdout
+
+    def test_noise_floor_baseline_never_gates(self, tmp_path):
+        # a jitter-scale baseline (spread under 5 ms, queue share
+        # under 0.05) cannot express meaningful relative growth:
+        # +50% of noise is still noise, not a regression
+        _write_fixture_rounds(
+            tmp_path,
+            [100.0, 100.0],
+            request=[(2.0, 0.004), (3.0, 0.006)],
+        )
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 0, proc.stdout
+        assert "REQUEST-PLANE REGRESSION" not in proc.stdout
+
+    def test_zero_baseline_never_gates(self, tmp_path):
+        # a zero spread baseline cannot express relative growth: the
+        # next record is reported, not gated (mirrors the zero-value
+        # throughput rule)
+        _write_fixture_rounds(
+            tmp_path,
+            [100.0, 100.0],
+            request=[(0.0, 0.0), (50.0, 0.9)],
+        )
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 0, proc.stdout
+        assert "REQUEST-PLANE REGRESSION" not in proc.stdout
+
+    def test_first_record_is_baseline(self, tmp_path):
+        _write_fixture_rounds(
+            tmp_path, [100.0], request=[(10.0, 0.2)]
+        )
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 0, proc.stdout
+        assert "request-plane baseline" in proc.stdout
+
+    def test_unmeasured_middle_round_keeps_prior_baseline(self, tmp_path):
+        # round 2's spread is unmeasured (None): round 3's measured
+        # 10x spread must still gate against round 1's baseline — an
+        # unmeasured round must not silently re-baseline starvation
+        _write_fixture_rounds(
+            tmp_path,
+            [100.0, 100.0, 100.0],
+            request=[(10.0, 0.2), (None, 0.2), (100.0, 0.2)],
+        )
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 1, proc.stdout
+        assert "fairness-spread" in proc.stdout
+
+
 class TestObsReport:
     MANIFEST = os.path.join(FIXTURES, "obs_report_manifest.json")
     METRICS = os.path.join(FIXTURES, "obs_report_metrics.jsonl")
@@ -1070,8 +1164,10 @@ class TestObsReport:
             "== spans",
             "== compile ==",
             "== memory ==",
+            "== plan ==",
             "== convergence",
             "== serving ==",
+            "== request timeline ==",
             "== slo ==",
         ):
             assert section in out, section
@@ -1080,6 +1176,20 @@ class TestObsReport:
         assert "total divergences" in out
         # serving health incl. staleness + drift
         assert "snapshot staleness" in out and "drift alarms: 3" in out
+        # the PR 6 plan stanza, surfaced at last: mesh axes, chunk
+        # rounding, resolved branch, idle-device rationale
+        assert "mesh: chain:1 x series:2 x sp:3" in out
+        assert "devices used 6/8" in out
+        assert "requested 6, rounded" in out
+        assert "time-parallel branch: scan" in out
+        assert "2 devices idle" in out
+        # the request plane: per-tenant decomposition + fairness
+        assert "tenant0" in out and "tenant1" in out
+        assert "p99 spread 1.9875 ms" in out
+        assert "(+1 tenant(s) omitted" in out
+        assert "warm device re-time update/b128" in out
+        # the storm fairness arms
+        assert "skewed p99 spread 66.8182 ms vs balanced 2.3868 ms" in out
         # SLO verdicts: the fixture has both a PASS and a FAIL check
         assert "PASS" in out and "FAIL" in out and "UNMET" in out
 

@@ -217,6 +217,7 @@ class TestGatedPath:
         ref = jax.vmap(_vg_single_gated)(*args)
         _assert_close(out, ref)
 
+    @pytest.mark.slow  # measured multi-second on the single-core tier-1 host (.tier1_durations.json); full-suite coverage only
     def test_tayal_stan_vg_matches_autodiff(self, rng):
         """make_vg (gated op + onehot emissions) == grad(make_logp)
         (time-varying gated A + custom VJP) for the stan-parity mode."""
@@ -276,8 +277,20 @@ class TestIOHMMFold:
     (models/iohmm.py build_vg), making the family homogeneous-A and
     Pallas-eligible. Exact in f64; f32 tolerances cover reassociation."""
 
-    @pytest.mark.parametrize("mode", ["stan", "gen"])
-    @pytest.mark.parametrize("ragged", [False, True], ids=["dense", "ragged"])
+    # dense-stan is the one multi-second combo on the single-core
+    # tier-1 host (.tier1_durations.json) — slow-marked; the other
+    # three combos keep the fold-vs-autodiff contract in tier-1
+    @pytest.mark.parametrize(
+        "ragged, mode",
+        [
+            pytest.param(
+                False, "stan", id="dense-stan", marks=pytest.mark.slow
+            ),
+            pytest.param(False, "gen", id="dense-gen"),
+            pytest.param(True, "stan", id="ragged-stan"),
+            pytest.param(True, "gen", id="ragged-gen"),
+        ],
+    )
     def test_vg_matches_autodiff(self, rng, mode, ragged):
         from hhmm_tpu.apps.hassan.wf import DEFAULT_HYPERPARAMS
         from hhmm_tpu.models import IOHMMHMix, IOHMMReg
@@ -462,6 +475,7 @@ class TestAlphaFused:
             np.asarray(ll_k), np.asarray(ll_r), rtol=1e-5
         )
 
+    @pytest.mark.slow  # measured multi-second on the single-core tier-1 host (.tier1_durations.json); full-suite coverage only
     def test_generated_unchanged_on_cpu(self, rng):
         """TayalHHMMLite.generated (now routed through forward_alpha)
         must reproduce the materialized-kernel filter output exactly on

@@ -236,6 +236,7 @@ class TestPlannedFitParity:
     (B=6 over chunk 4), masked (ragged-T) padding, chunk auto-rounding
     (8-device plan rounds the chunk up and pads the whole batch)."""
 
+    @pytest.mark.slow  # measured multi-second on the single-core tier-1 host (.tier1_durations.json); full-suite coverage only
     def test_fit_matches_single_device(self):
         from __graft_entry__ import _tayal_batch
 
@@ -279,6 +280,7 @@ class TestPlannedFitParity:
         qs8, _ = fit_batched(model, data, key, cfg, plan=plan8)
         np.testing.assert_array_equal(np.asarray(qs8), np.asarray(qs_ref))
 
+    @pytest.mark.slow  # measured multi-second on the single-core tier-1 host (.tier1_durations.json); full-suite coverage only
     def test_legacy_mesh_autorounds_instead_of_raising(self):
         """The old `chunk_size not divisible by mesh series axis`
         ValueError is gone: the planner rounds the chunk up and the fit
@@ -389,6 +391,7 @@ class TestSchedulerPlanned:
 
 
 class TestPlanSweepBench:
+    @pytest.mark.slow  # measured multi-second on the single-core tier-1 host (.tier1_durations.json); full-suite coverage only
     def test_quick_sweep_record(self):
         """`bench.py --plan-sweep --quick` must exit 0 with bitwise
         parity across topologies and emit the gateable

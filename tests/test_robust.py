@@ -276,6 +276,7 @@ class TestGibbsGuard:
         rng = np.random.default_rng(0)
         return {"x": rng.integers(0, 3, size=60)}
 
+    @pytest.mark.slow  # measured multi-second on the single-core tier-1 host (.tier1_durations.json); full-suite coverage only
     def test_nan_logp_quarantines_other_chain_bitwise(self):
         model = MultinomialHMM(K=2, L=3)
         cfg = GibbsConfig(num_warmup=5, num_samples=20, num_chains=2)
@@ -546,6 +547,7 @@ class TestFitCrashResume:
 
 
 class TestSelfHealing:
+    @pytest.mark.slow  # measured multi-second on the single-core tier-1 host (.tier1_durations.json); full-suite coverage only
     def test_quarantined_series_redisptached_healthy_kept_bitwise(
         self, multinom_setup, tmp_path
     ):
@@ -584,6 +586,7 @@ class TestSelfHealing:
         assert qs.shape[0] == 2
         assert np.asarray(st["chain_healthy"]).all()
 
+    @pytest.mark.slow  # measured multi-second on the single-core tier-1 host (.tier1_durations.json); full-suite coverage only
     def test_sticky_fault_degrades_gracefully(self, multinom_setup, capsys):
         """A series that cannot be healed is returned with its mask
         down after the bounded ladder — the sweep completes."""

@@ -190,6 +190,7 @@ class TestTreeGibbs:
         assert np.abs(phis - phi).max() < 0.15
         assert (np.argmax(phis, axis=1) == np.arange(4)).all()
 
+    @pytest.mark.slow  # measured multi-second on the single-core tier-1 host (.tier1_durations.json); full-suite coverage only
     def test_soft_gate_weights_drop_inconsistent(self):
         """Stan-gate semisup: a label-inconsistent destination carries a
         unit pairwise factor — its step must contribute no transition
