@@ -1,0 +1,27 @@
+# Developer entry points. The heavyweight paths (bench, probes) keep
+# their documented python invocations; these are the fast loops.
+
+PY ?= python
+
+.PHONY: lint guards test test-fast report
+
+# static analysis, full default scan (pure ast, no jax import; <10 s).
+# Pre-commit hook one-liner:  echo 'make -C "$(git rev-parse --show-toplevel)" lint' > .git/hooks/pre-commit
+lint:
+	$(PY) scripts/lint.py
+
+# the legacy-contract spelling of the same pass (tier-1 runs this via
+# tests; kept for muscle memory)
+guards:
+	$(PY) scripts/check_guards.py
+
+# tier-1 (see ROADMAP.md for the canonical pinned command)
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider
+
+# the analyzer's own suite + the guard wiring — the fast lint loop
+test-fast:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_analysis.py -q -p no:cacheprovider
+
+report:
+	$(PY) docs/build_report.py

@@ -31,6 +31,7 @@ PAGES = [
     ("architecture.md", "Architecture"),
     ("serving.md", "Streaming inference service"),
     ("robustness.md", "Fault tolerance"),
+    ("static_analysis.md", "Static analysis"),
     ("results.md", "Results"),
     ("tayal2009.md", "Tayal (2009) replication"),
     ("phi_protocol.md", "Pre-registered φ̂ protocol"),

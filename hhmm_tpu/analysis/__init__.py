@@ -1,0 +1,63 @@
+"""hhmm_tpu.analysis — a JAX-discipline static analyzer (pure ``ast``).
+
+The correctness-tooling substrate for the repo: one rule engine, a
+registry of per-invariant rules with ids/severities/docs, per-finding
+locations, inline ``# lint: ok <rule-id>`` pragmas, a checked-in
+allowlist, and text/JSON reporters behind a CLI::
+
+    python -m hhmm_tpu.analysis                      # full default scan
+    python -m hhmm_tpu.analysis --format json hhmm_tpu/
+    python -m hhmm_tpu.analysis --list-rules
+
+``scripts/check_guards.py`` is a thin shim over this package: the ten
+legacy guard invariants live in :mod:`~hhmm_tpu.analysis.legacy` and
+keep their exact verdicts, messages, and exit-code contract, so the
+tier-1 wiring is untouched. The four post-guards rule families —
+hot-path purity (:mod:`.purity`), PRNG discipline (:mod:`.prng`),
+dtype discipline (:mod:`.dtype`), and the import-layering DAG
+(:mod:`.layering`) — catch the TPU-killing defect classes the monolith
+could not express. Rule catalog and how-to-add-a-rule:
+docs/static_analysis.md.
+
+This package imports NOTHING outside the stdlib (asserted by
+tests/test_analysis.py): it must run on hosts without the pinned jax
+and inside tier-1 under a <10 s budget.
+"""
+
+from .engine import (
+    DEFAULT_TARGETS,
+    AllowlistEntry,
+    AllowlistError,
+    Finding,
+    Module,
+    Project,
+    Report,
+    Rule,
+    RULES,
+    load_allowlist,
+    register,
+    run_analysis,
+)
+
+# importing the rule modules populates the registry (deterministic
+# order: legacy invariants first, then the new families)
+from . import legacy as _legacy  # noqa: F401
+from . import purity as _purity  # noqa: F401
+from . import prng as _prng  # noqa: F401
+from . import dtype as _dtype  # noqa: F401
+from . import layering as _layering  # noqa: F401
+
+__all__ = [
+    "AllowlistEntry",
+    "AllowlistError",
+    "DEFAULT_TARGETS",
+    "Finding",
+    "Module",
+    "Project",
+    "Report",
+    "Rule",
+    "RULES",
+    "load_allowlist",
+    "register",
+    "run_analysis",
+]

@@ -1,0 +1,95 @@
+"""CLI for the static analyzer.
+
+Usage::
+
+    python -m hhmm_tpu.analysis [paths...] [--root DIR]
+                                [--format text|json] [--rules a,b,c]
+                                [--allowlist FILE | --no-allowlist]
+                                [--list-rules]
+
+Paths default to the repo's full scan set (hhmm_tpu/, bench.py,
+bench_zoo.py, __graft_entry__.py, scripts/). Exit codes: 0 = no
+unsuppressed error-severity findings (warnings report but do not
+fail), 1 = findings, 2 = usage/config error (unknown rule, malformed
+allowlist). ``scripts/lint.py`` and the ``make lint`` target wrap this
+entry point for pre-commit use.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+from typing import List
+
+from .engine import AllowlistError, DEFAULT_TARGETS, RULES, run_analysis
+
+
+def main(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m hhmm_tpu.analysis",
+        description=__doc__.splitlines()[0],
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        help=f"files/dirs to scan (default: {', '.join(DEFAULT_TARGETS)})",
+    )
+    ap.add_argument(
+        "--root",
+        default=".",
+        help="repo root paths are resolved against (default: cwd)",
+    )
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument(
+        "--rules",
+        default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    ap.add_argument(
+        "--allowlist",
+        default=None,
+        help="allowlist file (default: <root>/hhmm_tpu/analysis/allowlist.txt)",
+    )
+    ap.add_argument(
+        "--no-allowlist",
+        action="store_true",
+        help="ignore the checked-in allowlist (audit mode)",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog and exit"
+    )
+    args = ap.parse_args(argv[1:])
+
+    if args.list_rules:
+        for rid, rule in RULES.items():
+            print(f"{rid:20s} {rule.severity:8s} {rule.title}")
+        return 0
+
+    rules = None
+    if args.rules:
+        rules = [r.strip() for r in args.rules.split(",") if r.strip()]
+    try:
+        report = run_analysis(
+            root=pathlib.Path(args.root),
+            paths=args.paths or None,
+            rules=rules,
+            allowlist_path=(
+                pathlib.Path(args.allowlist) if args.allowlist else None
+            ),
+            use_allowlist=not args.no_allowlist,
+        )
+    except (AllowlistError, KeyError) as e:
+        print(f"hhmm_tpu.analysis: {e}", file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        print(json.dumps(report.to_json(), indent=2, sort_keys=True))
+    else:
+        print(report.render_text())
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

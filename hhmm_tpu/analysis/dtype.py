@@ -1,0 +1,149 @@
+"""Dtype discipline rules for device-code directories.
+
+The defect class: silent float64. TPUs execute f64 in slow emulation
+(and the repo's numerics are designed around f32 with f64 as an
+explicitly-requested test mode via ``enable_x64``), so a stray
+``float64`` literal or an ambient-default constructor in a kernel
+either tanks throughput or forks numerics between hosts depending on
+the x64 flag. Scope: ``hhmm_tpu/kernels/``, ``hhmm_tpu/core/``, and
+``hhmm_tpu/serve/online.py`` — the code that runs under ``jit`` on the
+device. Host-side boundary conversions (``models/*``, app drivers) are
+out of scope by construction; the rare in-scope host-side site carries
+an allowlist entry with its rationale.
+
+- ``dtype-float64`` (error) — any ``float64`` spelling: the
+  ``jnp.float64``/``np.float64`` attribute, a ``"float64"`` string
+  fed to a dtype position, or ``astype`` with either.
+- ``dtype-implicit`` (error) — ``jnp.zeros``/``jnp.ones``/
+  ``jnp.array`` (alias-aware, bare imported names included) with
+  neither a positional dtype (argument 2) nor ``dtype=``. The ambient
+  default flips between f32 and f64 with the x64 flag, so an implicit
+  constructor is a numerics fork waiting for a host that enables it.
+  Derive the dtype from an input (``log_obs.dtype``) instead of
+  hardcoding — the kernels must stay generic over f32/f64 test modes.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Set
+
+from .astutil import cached_walk, module_aliases
+from .engine import Finding, Module, Project, Rule, register
+
+_SCOPE_PREFIXES = ("hhmm_tpu/kernels/", "hhmm_tpu/core/")
+_SCOPE_FILES = ("hhmm_tpu/serve/online.py",)
+
+_CTORS = ("zeros", "ones", "array")
+
+
+def _in_scope(rel: str) -> bool:
+    return rel.startswith(_SCOPE_PREFIXES) or rel in _SCOPE_FILES
+
+
+def _numeric_aliases(tree: ast.AST) -> Set[str]:
+    return module_aliases(tree, "jax.numpy") | module_aliases(tree, "numpy")
+
+
+@register
+class DtypeFloat64Rule(Rule):
+    id = "dtype-float64"
+    title = "no float64 literals/casts in device-code directories"
+    doc = (
+        "float64 on TPU is emulated and slow, and a hard-coded f64 forks "
+        "numerics against the f32 production path. Kernels stay generic: "
+        "propagate an input's dtype. Genuine host-side boundary sites in "
+        "scope are allowlisted with a rationale."
+    )
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for mod in project.iter_modules():
+            if not _in_scope(mod.rel):
+                continue
+            aliases = _numeric_aliases(mod.tree)
+            for node in cached_walk(mod.tree):
+                if (
+                    isinstance(node, ast.Attribute)
+                    and node.attr == "float64"
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in aliases
+                ):
+                    yield self.finding(
+                        mod.rel,
+                        node.lineno,
+                        f"`{node.value.id}.float64` in device-code scope — "
+                        "f64 is emulated on TPU and forks numerics vs the "
+                        "f32 path; propagate an input dtype instead",
+                    )
+                elif isinstance(node, ast.Call):
+                    for arg in list(node.args) + [k.value for k in node.keywords]:
+                        if (
+                            isinstance(arg, ast.Constant)
+                            and arg.value == "float64"
+                        ):
+                            yield self.finding(
+                                mod.rel,
+                                node.lineno,
+                                'string dtype "float64" in device-code scope '
+                                "— f64 is emulated on TPU; propagate an "
+                                "input dtype instead",
+                            )
+
+
+@register
+class DtypeImplicitRule(Rule):
+    id = "dtype-implicit"
+    title = "no dtype-less jnp.zeros/ones/array in device-code directories"
+    doc = (
+        "The ambient default dtype flips between f32 and f64 with the "
+        "x64 flag; an implicit constructor in a kernel silently forks "
+        "numerics per host. Pass the dtype explicitly — positionally "
+        "(`jnp.zeros(shape, x.dtype)`) or as dtype= — derived from an "
+        "input so f32 and f64 test modes both flow through."
+    )
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for mod in project.iter_modules():
+            if not _in_scope(mod.rel):
+                continue
+            aliases = _numeric_aliases(mod.tree)
+            bare = {}
+            for node in cached_walk(mod.tree):
+                if isinstance(node, ast.ImportFrom) and node.module in (
+                    "jax.numpy",
+                    "numpy",
+                ):
+                    for a in node.names:
+                        if a.name in _CTORS:
+                            bare[a.asname or a.name] = a.name
+            for node in cached_walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                ctor = ""
+                spelled = ""
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr in _CTORS
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in aliases
+                ):
+                    ctor = f.attr
+                    spelled = f"{f.value.id}.{f.attr}"
+                elif isinstance(f, ast.Name) and f.id in bare:
+                    ctor = bare[f.id]
+                    spelled = f.id
+                if not ctor:
+                    continue
+                has_dtype = len(node.args) >= 2 or any(
+                    k.arg == "dtype" for k in node.keywords
+                )
+                if not has_dtype:
+                    yield self.finding(
+                        mod.rel,
+                        node.lineno,
+                        f"dtype-less `{spelled}(...)` in device-code scope — "
+                        "the ambient default flips with the x64 flag; pass "
+                        "an explicit dtype derived from an input (e.g. "
+                        "`x.dtype`)",
+                    )

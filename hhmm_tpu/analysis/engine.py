@@ -1,0 +1,433 @@
+"""Rule engine for the `hhmm_tpu.analysis` static analyzer.
+
+Pure stdlib (``ast`` + ``re``) — importing this package must never pull
+in JAX (asserted by ``tests/test_analysis.py``): the analyzer runs on
+CI hosts and laptops without the pinned jax, and inside tier-1 under a
+<10 s budget.
+
+Pieces:
+
+- :class:`Finding` — one defect: ``(file, line, rule_id, severity,
+  message)``. ``line == 0`` means module-level (no single line).
+- :class:`Rule` — subclass, set ``id``/``title``/``severity``/``doc``,
+  implement :meth:`Rule.check` over a :class:`Project`; decorate with
+  :func:`register` to add it to the global registry. Rules scope
+  themselves by repo-relative path (see :meth:`Project.iter_modules`).
+- :class:`Module` / :class:`Project` — parsed source files keyed by
+  repo-relative path, with on-demand loading for rules that pin
+  specific files (the legacy guard invariants).
+- suppression — inline ``# lint: ok <rule-id>`` pragmas (same line or
+  the line directly above; multiple ids comma/space-separated; an
+  optional ``-- rationale`` tail is encouraged) plus a checked-in
+  allowlist file (:func:`load_allowlist`) for module-level findings
+  and sites where an inline comment cannot live.
+- :func:`run_analysis` — collect files, run rules, apply suppression,
+  return a :class:`Report` with text and JSON renderers.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import pathlib
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+__all__ = [
+    "AllowlistEntry",
+    "AllowlistError",
+    "DEFAULT_TARGETS",
+    "Finding",
+    "Module",
+    "Project",
+    "Report",
+    "Rule",
+    "RULES",
+    "load_allowlist",
+    "register",
+    "run_analysis",
+]
+
+# default scan set relative to the repo root — mirrors what the legacy
+# scripts/check_guards.py monolith covered, so the shim preserves its
+# verdict file-for-file
+DEFAULT_TARGETS: Tuple[str, ...] = (
+    "hhmm_tpu",
+    "bench.py",
+    "bench_zoo.py",
+    "__graft_entry__.py",
+    "scripts",
+)
+
+# `# lint: ok rule-a, rule-b -- why this is fine`
+_PRAGMA_RE = re.compile(
+    r"#\s*lint:\s*ok\s+(?P<ids>[A-Za-z0-9_,\s-]+?)\s*(?:--(?P<why>.*))?$"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One defect at one location. ``line == 0`` = module-level."""
+
+    file: str
+    line: int
+    rule_id: str
+    message: str
+    severity: str = "error"
+
+    def location(self) -> str:
+        return f"{self.file}:{self.line}" if self.line else self.file
+
+    def format(self) -> str:
+        return f"{self.location()}: [{self.rule_id}] {self.message}"
+
+    def legacy_format(self) -> str:
+        """The pre-engine ``check_guards.py`` line format (no rule id) —
+        the shim prints this so its output contract is unchanged."""
+        return f"{self.location()}: {self.message}"
+
+    def to_json(self) -> Dict[str, object]:
+        return {
+            "file": self.file,
+            "line": self.line,
+            "rule_id": self.rule_id,
+            "severity": self.severity,
+            "message": self.message,
+        }
+
+
+class Module:
+    """One parsed source file: tree, source lines, suppression pragmas."""
+
+    def __init__(self, rel: str, path: pathlib.Path, source: str):
+        self.rel = rel.replace("\\", "/")
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source, filename=str(path))
+        self.pragmas = _parse_pragmas(source)
+
+    def suppressed(self, rule_id: str, line: int) -> bool:
+        """True when ``line`` (or the line directly above it) carries a
+        ``# lint: ok`` pragma naming ``rule_id``."""
+        for ln in (line, line - 1):
+            if rule_id in self.pragmas.get(ln, ()):
+                return True
+        return False
+
+
+def _parse_pragmas(source: str) -> Dict[int, Set[str]]:
+    out: Dict[int, Set[str]] = {}
+    for i, raw in enumerate(source.splitlines(), 1):
+        m = _PRAGMA_RE.search(raw)
+        if m is None:
+            continue
+        ids = {t for t in re.split(r"[,\s]+", m.group("ids").strip()) if t}
+        if ids:
+            out[i] = ids
+    return out
+
+
+class Project:
+    """The scanned file set plus on-demand access to pinned files.
+
+    ``modules`` holds everything collected from the CLI paths; rules
+    that must inspect a FIXED file (the sampler-guard family) use
+    :meth:`module` which falls back to parsing from disk, so their
+    verdict does not depend on which paths the caller selected —
+    exactly the legacy monolith's semantics.
+    """
+
+    def __init__(self, root: pathlib.Path, modules: Dict[str, Module]):
+        self.root = pathlib.Path(root)
+        self.modules = modules
+        self._extra: Dict[str, Optional[Module]] = {}
+
+    def iter_modules(self) -> Iterator[Module]:
+        for rel in sorted(self.modules):
+            yield self.modules[rel]
+
+    def module(self, rel: str) -> Optional[Module]:
+        """The module at repo-relative ``rel`` — scanned, cached, or
+        parsed from disk on demand; ``None`` when the file is absent."""
+        rel = rel.replace("\\", "/")
+        if rel in self.modules:
+            return self.modules[rel]
+        if rel not in self._extra:
+            path = self.root / rel
+            if path.is_file():
+                self._extra[rel] = Module(rel, path, path.read_text())
+            else:
+                self._extra[rel] = None
+        return self._extra[rel]
+
+
+class Rule:
+    """One invariant. Subclass, set the class attributes, implement
+    :meth:`check`, and decorate with :func:`register`.
+
+    - ``id``       — kebab-case pragma/allowlist key (``# lint: ok <id>``)
+    - ``severity`` — ``"error"`` (drives exit code) or ``"warning"``
+    - ``title``    — one-line summary for ``--list-rules``
+    - ``doc``      — catalog paragraph (docs/static_analysis.md is the
+      rendered form; keep the two in sync)
+    """
+
+    id: str = ""
+    severity: str = "error"
+    title: str = ""
+    doc: str = ""
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, file: str, line: int, message: str) -> Finding:
+        return Finding(file, line, self.id, message, self.severity)
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator adding a Rule to the global registry (import
+    order = deterministic run order)."""
+    if not cls.id:
+        raise ValueError(f"rule {cls.__name__} has no id")
+    if cls.id in RULES:
+        raise ValueError(f"duplicate rule id {cls.id!r}")
+    RULES[cls.id] = cls()
+    return cls
+
+
+# ---------------------------------------------------------------------------
+# allowlist
+
+
+class AllowlistError(ValueError):
+    """Malformed allowlist file — CLI exits 2, never silently ignores."""
+
+
+@dataclass
+class AllowlistEntry:
+    rule_id: str
+    file: str
+    line: Optional[int]  # None = any line in the file
+    rationale: str
+    used: bool = field(default=False, compare=False)
+
+    def matches(self, f: Finding) -> bool:
+        return (
+            f.rule_id == self.rule_id
+            and f.file == self.file
+            and (self.line is None or self.line == f.line)
+        )
+
+
+def load_allowlist(path: pathlib.Path) -> List[AllowlistEntry]:
+    """Parse the checked-in allowlist: one ``<rule-id> <path>[:<line>]
+    -- <rationale>`` entry per line, ``#`` comments and blanks ignored.
+    The rationale is REQUIRED — an allowlist entry without a why is a
+    suppression nobody can audit."""
+    entries: List[AllowlistEntry] = []
+    for n, raw in enumerate(path.read_text().splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if "--" not in line:
+            raise AllowlistError(
+                f"{path}:{n}: allowlist entry has no ` -- rationale` tail"
+            )
+        head, rationale = line.split("--", 1)
+        parts = head.split()
+        if len(parts) != 2:
+            raise AllowlistError(
+                f"{path}:{n}: expected `<rule-id> <path>[:<line>] -- why`, "
+                f"got {line!r}"
+            )
+        rule_id, target = parts
+        lineno: Optional[int] = None
+        if ":" in target:
+            target, _, tail = target.rpartition(":")
+            try:
+                lineno = int(tail)
+            except ValueError as e:
+                raise AllowlistError(f"{path}:{n}: bad line number {tail!r}") from e
+        if not rationale.strip():
+            raise AllowlistError(f"{path}:{n}: empty rationale")
+        entries.append(
+            AllowlistEntry(rule_id, target.replace("\\", "/"), lineno, rationale.strip())
+        )
+    return entries
+
+
+# ---------------------------------------------------------------------------
+# runner + report
+
+# the package-shipped allowlist, looked up root-relative so toy trees
+# (tests) get none unless they check one in
+ALLOWLIST_REL = "hhmm_tpu/analysis/allowlist.txt"
+
+_EXCLUDE_DIRS = {"__pycache__"}
+
+
+def _collect(root: pathlib.Path, paths: Sequence[str]) -> Dict[str, pathlib.Path]:
+    files: Dict[str, pathlib.Path] = {}
+
+    def add(p: pathlib.Path) -> None:
+        try:
+            rel = str(p.resolve().relative_to(root.resolve())).replace("\\", "/")
+        except ValueError:
+            rel = str(p).replace("\\", "/")
+        files[rel] = p
+
+    for target in paths:
+        p = pathlib.Path(target)
+        if not p.is_absolute():
+            p = root / target
+        if p.is_dir():
+            # scripts/ is a flat glob in the legacy pass; everything else
+            # is scanned recursively — rglob covers both identically
+            # because scripts/ has no subpackages
+            for py in sorted(p.rglob("*.py")):
+                if not _EXCLUDE_DIRS.intersection(py.parts):
+                    add(py)
+        elif p.is_file():
+            add(p)
+    return files
+
+
+@dataclass
+class Report:
+    root: str
+    files_scanned: int
+    findings: List[Finding]  # unsuppressed, sorted
+    suppressed: List[Finding]  # pragma- or allowlist-suppressed
+    allowlist: List[AllowlistEntry]
+    rules_run: List[str]
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == "error"]
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+    def rule_table(self) -> Dict[str, Dict[str, object]]:
+        table: Dict[str, Dict[str, object]] = {}
+        for rid in self.rules_run:
+            rule = RULES[rid]
+            table[rid] = {"severity": rule.severity, "findings": 0, "suppressed": 0}
+        for f in self.findings:
+            table.setdefault(
+                f.rule_id, {"severity": f.severity, "findings": 0, "suppressed": 0}
+            )["findings"] += 1
+        for f in self.suppressed:
+            table.setdefault(
+                f.rule_id, {"severity": f.severity, "findings": 0, "suppressed": 0}
+            )["suppressed"] += 1
+        return table
+
+    def to_json(self) -> Dict[str, object]:
+        return {
+            "version": 1,
+            "root": self.root,
+            "files_scanned": self.files_scanned,
+            "rules": self.rule_table(),
+            "findings": [f.to_json() for f in self.findings],
+            "suppressed_count": len(self.suppressed),
+            "allowlist_entries": len(self.allowlist),
+            "allowlist_unused": [
+                f"{e.rule_id} {e.file}" for e in self.allowlist if not e.used
+            ],
+            "ok": self.ok,
+        }
+
+    def render_text(self) -> str:
+        lines = [f.format() for f in self.findings]
+        n_err = len(self.errors)
+        n_warn = len(self.findings) - n_err
+        tail = (
+            f"hhmm_tpu.analysis: {self.files_scanned} file(s), "
+            f"{len(self.rules_run)} rule(s): "
+        )
+        if self.findings:
+            tail += f"{n_err} error(s), {n_warn} warning(s)"
+        else:
+            tail += "clean"
+        if self.suppressed:
+            tail += f" ({len(self.suppressed)} suppressed)"
+        unused = [e for e in self.allowlist if not e.used]
+        if unused:
+            tail += f" [{len(unused)} unused allowlist entr(y/ies)]"
+        lines.append(tail)
+        return "\n".join(lines)
+
+
+def run_analysis(
+    root,
+    paths: Optional[Sequence[str]] = None,
+    rules: Optional[Sequence[str]] = None,
+    allowlist_path: Optional[pathlib.Path] = None,
+    use_allowlist: bool = True,
+) -> Report:
+    """Collect files under ``root``, run ``rules`` (default: all
+    registered), apply pragma + allowlist suppression, return a
+    :class:`Report`. Unparseable files become ``parse-error`` findings
+    rather than crashing the run."""
+    root = pathlib.Path(root)
+    if paths is None:
+        paths = [t for t in DEFAULT_TARGETS if (root / t).exists()]
+    files = _collect(root, paths)
+    modules: Dict[str, Module] = {}
+    parse_failures: List[Finding] = []
+    for rel, path in files.items():
+        try:
+            modules[rel] = Module(rel, path, path.read_text())
+        except SyntaxError as e:
+            parse_failures.append(
+                Finding(rel, e.lineno or 0, "parse-error", f"syntax error: {e.msg}")
+            )
+    project = Project(root, modules)
+
+    if rules is None:
+        selected = list(RULES)
+    else:
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            raise KeyError(f"unknown rule id(s): {unknown}")
+        selected = list(rules)
+
+    entries: List[AllowlistEntry] = []
+    if use_allowlist:
+        ap = allowlist_path if allowlist_path is not None else root / ALLOWLIST_REL
+        if pathlib.Path(ap).is_file():
+            entries = load_allowlist(pathlib.Path(ap))
+
+    raw: List[Finding] = list(parse_failures)
+    for rid in selected:
+        raw.extend(RULES[rid].check(project))
+    # dedupe (a rule walking overlapping scopes may re-derive a site)
+    raw = sorted(set(raw), key=lambda f: (f.file, f.line, f.rule_id, f.message))
+
+    findings: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in raw:
+        mod = modules.get(f.file)
+        if mod is not None and f.line and mod.suppressed(f.rule_id, f.line):
+            suppressed.append(f)
+            continue
+        hit = next((e for e in entries if e.matches(f)), None)
+        if hit is not None:
+            hit.used = True
+            suppressed.append(f)
+            continue
+        findings.append(f)
+    return Report(
+        root=str(root),
+        files_scanned=len(files),
+        findings=findings,
+        suppressed=suppressed,
+        allowlist=entries,
+        rules_run=selected,
+    )

@@ -1,0 +1,163 @@
+"""Import-layering rule: the SURVEY layer map as an enforced DAG.
+
+The architecture's layer map (SURVEY.md §7, refined by PRs 1–10 and
+measured from the actual import graph — see docs/architecture.md
+"Layering DAG") assigns every subpackage a rank; imports must point
+strictly DOWN the ranks. A back-edge import couples a substrate to a
+consumer: the next refactor of the consumer breaks the substrate, and
+import cycles start appearing as "lazy import inside a function"
+workarounds that this rule makes visible instead of letting them
+accrete silently.
+
+Ranks (higher may import lower; equal ranks may NOT import each
+other — siblings stay decoupled)::
+
+    7  viz
+    6  apps
+    5  serve
+    4  models, batch
+    3  infer, plan
+    2  kernels
+    1  obs
+    0  core, hhmm, sim, native, robust, analysis
+
+``import hhmm_tpu`` (the root package: version metadata only) is
+allowed from anywhere. Function-scoped (lazy) imports are findings
+too — laziness hides a cycle, it does not remove it; a deliberate
+cycle-breaking lazy import carries an inline ``# lint: ok
+layer-import -- why`` pragma so every such edge is audited.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Tuple
+
+from .astutil import cached_walk
+from .engine import Finding, Project, Rule, register
+
+LAYERS = {
+    "core": 0,
+    "hhmm": 0,
+    "sim": 0,
+    "native": 0,
+    "robust": 0,
+    "analysis": 0,
+    "obs": 1,
+    "kernels": 2,
+    "infer": 3,
+    "plan": 3,
+    "models": 4,
+    "batch": 4,
+    "serve": 5,
+    "apps": 6,
+    "viz": 7,
+}
+
+
+def _src_package(rel: str) -> Optional[str]:
+    """The subpackage a repo-relative file belongs to, or None for
+    files directly under hhmm_tpu/ (the root __init__ and toy-fixture
+    modules are unconstrained)."""
+    parts = rel.split("/")
+    if len(parts) < 3 or parts[0] != "hhmm_tpu":
+        return None
+    return parts[1]
+
+
+def _import_targets(node: ast.AST, rel: str) -> List[Tuple[int, str]]:
+    """(line, dst_subpackage) pairs for one import node."""
+    out: List[Tuple[int, str]] = []
+    if isinstance(node, ast.Import):
+        for a in node.names:
+            p = a.name.split(".")
+            if p[0] == "hhmm_tpu" and len(p) > 1:
+                out.append((node.lineno, p[1]))
+    elif isinstance(node, ast.ImportFrom):
+        if node.module and node.module.split(".")[0] == "hhmm_tpu" and node.level == 0:
+            p = node.module.split(".")
+            if len(p) > 1:
+                out.append((node.lineno, p[1]))
+            else:
+                # `from hhmm_tpu import serve` — each alias may be a
+                # subpackage
+                for a in node.names:
+                    if a.name in LAYERS:
+                        out.append((node.lineno, a.name))
+        elif node.level >= 2:
+            # relative import reaching ABOVE the current subpackage:
+            # resolve against the file's own package path
+            pkg_parts = rel.split("/")[:-1]
+            base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+            mod = base + (node.module.split(".") if node.module else [])
+            if len(mod) > 1 and mod[0] == "hhmm_tpu":
+                out.append((node.lineno, mod[1]))
+            elif mod == ["hhmm_tpu"]:
+                # `from .. import apps` — the aliases are the
+                # subpackages, exactly like the absolute spelling
+                for a in node.names:
+                    if a.name in LAYERS:
+                        out.append((node.lineno, a.name))
+    return out
+
+
+@register
+class LayerImportRule(Rule):
+    id = "layer-import"
+    title = "imports follow the layering DAG (no back-edges)"
+    doc = (
+        "core ← obs ← kernels ← infer/plan ← models/batch ← serve ← "
+        "apps ← viz: imports must point strictly down the ranks; "
+        "same-rank siblings stay decoupled. A back-edge couples a "
+        "substrate to its consumer and breeds import cycles. Deliberate "
+        "lazy cycle-breaking imports carry an inline pragma with a "
+        "rationale; a new subpackage must be added to the layer map "
+        "(hhmm_tpu/analysis/layering.py + docs/architecture.md) before "
+        "it can import anything."
+    )
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for mod in project.iter_modules():
+            src = _src_package(mod.rel)
+            if src is None:
+                continue
+            src_rank = LAYERS.get(src)
+            if src_rank is None:
+                yield self.finding(
+                    mod.rel,
+                    0,
+                    f"subpackage `{src}` is not in the layer map — add it "
+                    "to hhmm_tpu/analysis/layering.py LAYERS and the "
+                    "docs/architecture.md layering DAG",
+                )
+                continue
+            for node in cached_walk(mod.tree):
+                for line, dst in _import_targets(node, mod.rel):
+                    if dst == src:
+                        continue
+                    dst_rank = LAYERS.get(dst)
+                    if dst_rank is None:
+                        yield self.finding(
+                            mod.rel,
+                            line,
+                            f"imports unmapped subpackage `hhmm_tpu.{dst}` — "
+                            "add it to the layer map "
+                            "(hhmm_tpu/analysis/layering.py, "
+                            "docs/architecture.md)",
+                        )
+                    elif dst_rank >= src_rank:
+                        kind = (
+                            "back-edge"
+                            if dst_rank > src_rank
+                            else "same-rank sibling"
+                        )
+                        yield self.finding(
+                            mod.rel,
+                            line,
+                            f"{kind} import `hhmm_tpu.{dst}` (rank "
+                            f"{dst_rank}) from `{src}` (rank {src_rank}) — "
+                            "violates the layering DAG "
+                            "(docs/architecture.md); invert the dependency "
+                            "or pragma a deliberate lazy cycle-breaker "
+                            "with its rationale",
+                        )

@@ -1,0 +1,690 @@
+"""The ten legacy ``scripts/check_guards.py`` invariants as rules.
+
+Ported verbatim-in-verdict from the pre-PR-11 monolith: same scoping,
+same detection logic, same message text (minus the ``file:line:``
+prefix, which now lives on the :class:`~.engine.Finding`). The shim
+``scripts/check_guards.py`` re-renders these findings in the legacy
+line format so its exit-code/output contract is unchanged and the
+tier-1 wiring (test_robust/test_serve/test_assoc/test_obs/test_plan/
+test_profile/test_request) needs no edits.
+
+Rule ids (pragma keys) ↔ legacy invariant numbers:
+
+====================  ====================================
+``bare-except``       invariant 1
+``sampler-guard``     invariant 2
+``serve-norm-guard``  invariant 3
+``semiring-guard``    invariant 4
+``monotonic-clock``   invariant 5a
+``jit-telemetry``     invariant 5b
+``metrics-plane``     invariant 6
+``placement``         invariant 7
+``serve-degrade``     invariant 8
+``timing-harness``    invariant 9
+``serve-clock``       invariant 10
+====================  ====================================
+
+See the module docstring of the legacy script (now docs/
+static_analysis.md's rule catalog) for the full rationale per rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Tuple
+
+from .astutil import (
+    cached_walk,
+    called_names,
+    imported_symbols,
+    is_block_until_ready_call,
+    is_perf_counter_call,
+    own_scope_nodes,
+    perf_counter_names,
+)
+from .engine import Finding, Module, Project, Rule, register
+
+# ---------------------------------------------------------------------------
+# shared tables (verbatim from the monolith)
+
+SAMPLER_MODULES = {
+    "hhmm_tpu/infer/run.py": ("guard_update", "guard_where"),
+    "hhmm_tpu/infer/chees.py": ("guard_update", "guard_where"),
+    "hhmm_tpu/infer/gibbs.py": ("guard_update", "guard_where"),
+}
+GUARDS_MODULE = "hhmm_tpu.robust.guards"
+
+SERVE_MODULES = {
+    "hhmm_tpu/serve/online.py": ("safe_log_normalize",),
+}
+LMATH_MODULES = ("hhmm_tpu.core.lmath", "hhmm_tpu.core")
+
+SEMIRING_MODULES = (
+    "hhmm_tpu/kernels/semiring.py",
+    "hhmm_tpu/kernels/assoc.py",
+)
+RAW_LSE_ATTRS = ("logaddexp", "logsumexp")
+RAW_LSE_WRAPPERS = ("logsumexp", "log_vecmat", "log_matvec", "log_normalize")
+
+TELEMETRY_MODULES = ("hhmm_tpu.obs.telemetry", "hhmm_tpu.obs")
+TELEMETRY_HOOKS = ("register_jit",)
+
+METRICS_MODULES = ("hhmm_tpu.obs.metrics", "hhmm_tpu.obs")
+METRIC_FNS = ("counter", "gauge", "histogram")
+AD_HOC_COUNT_RE = re.compile(r"(^|_)(counts?|counters?)$")
+
+SHARDING_CTORS = ("Mesh", "NamedSharding", "PartitionSpec")
+PLACEMENT_ALLOWED_PREFIXES = ("hhmm_tpu/plan/",)
+PLACEMENT_ALLOWED_FILES = ("hhmm_tpu/core/compat.py",)
+
+SERVE_HOT_PATH_FILE = "hhmm_tpu/serve/scheduler.py"
+HOT_PATH_METHOD_RE = re.compile(r"^(tick|flush|submit|attach\w*)$")
+HOT_PATH_DISPATCH_ATTR = "_dispatch"
+
+TIMING_HARNESS_FILE = "hhmm_tpu/obs/profile.py"
+SERVE_DIR_PREFIX = "hhmm_tpu/serve/"
+
+_BENCH_FILES = ("bench.py", "bench_zoo.py")
+
+
+def _in_package(rel: str) -> bool:
+    return rel.startswith("hhmm_tpu/")
+
+
+def _clock_scope(rel: str) -> bool:
+    return (
+        _in_package(rel)
+        or rel in _BENCH_FILES
+        or rel == "__graft_entry__.py"
+        or rel.startswith("scripts/")
+    )
+
+
+# ---------------------------------------------------------------------------
+
+
+@register
+class BareExceptRule(Rule):
+    id = "bare-except"
+    title = "no bare `except:` anywhere under hhmm_tpu/"
+    doc = (
+        "A bare handler swallows KeyboardInterrupt/SystemExit and masks "
+        "the device faults the retry layer (robust/retry.py) must see to "
+        "classify (UNAVAILABLE vs deterministic). Catch concrete types."
+    )
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for mod in project.iter_modules():
+            if not _in_package(mod.rel):
+                continue
+            for node in cached_walk(mod.tree):
+                if isinstance(node, ast.ExceptHandler) and node.type is None:
+                    yield self.finding(
+                        mod.rel,
+                        node.lineno,
+                        "bare `except:` (name the exception types)",
+                    )
+
+
+class _GuardedImportRule(Rule):
+    """Invariants 2 and 3 share one shape: named modules must import a
+    guard function from a named source module AND call it."""
+
+    spec: Dict[str, Tuple[str, ...]] = {}
+    source_modules: Tuple[str, ...] = ()
+    kind = ""
+    noun = ""
+    what = ""
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for rel, guard_fns in sorted(self.spec.items()):
+            mod = project.module(rel)
+            if mod is None:
+                yield self.finding(rel, 0, f"{self.kind} module missing")
+                continue
+            imported = imported_symbols(mod.tree, self.source_modules) & set(guard_fns)
+            if not imported:
+                yield self.finding(
+                    rel,
+                    0,
+                    f"does not import a {self.noun} from "
+                    f"{self.source_modules[0]} (expected one of {guard_fns})",
+                )
+                continue
+            if not (imported & called_names(mod.tree)):
+                yield self.finding(
+                    rel,
+                    0,
+                    f"imports {sorted(imported)} but never calls it — {self.what}",
+                )
+
+
+@register
+class SamplerGuardRule(_GuardedImportRule):
+    id = "sampler-guard"
+    title = "every sampler entry point routes through the chain-health guard"
+    doc = (
+        "Each sampler module (infer/run.py, infer/chees.py, infer/gibbs.py) "
+        "must import from hhmm_tpu.robust.guards and call a guard — a "
+        "sampler refactored without it silently reintroduces NaN poisoning "
+        "of vmapped batches."
+    )
+    spec = SAMPLER_MODULES
+    source_modules = (GUARDS_MODULE, "hhmm_tpu.robust")
+    kind = "sampler"
+    noun = "chain-health guard"
+    what = "transitions are unguarded"
+
+
+@register
+class ServeNormGuardRule(_GuardedImportRule):
+    id = "serve-norm-guard"
+    title = "the online filter step routes through safe_log_normalize"
+    doc = (
+        "serve/online.py must import and call safe_log_normalize from "
+        "hhmm_tpu.core.lmath — a streaming update normalized with a bare "
+        "log_normalize turns impossible evidence into NaN state instead of "
+        "the −inf floor the scheduler's quarantine mask detects."
+    )
+    spec = SERVE_MODULES
+    source_modules = LMATH_MODULES
+    kind = "serving"
+    noun = "guarded normalization"
+    what = "the online step is unguarded"
+
+
+@register
+class SemiringGuardRule(Rule):
+    id = "semiring-guard"
+    title = "semiring combines use the guarded logsumexp only"
+    doc = (
+        "Semiring identity elements are −inf by construction, so every "
+        "combine hits the all-(−inf) reduction edge case; a raw logsumexp "
+        "there has NaN cotangents. kernels/semiring.py and kernels/assoc.py "
+        "must import+call safe_logsumexp and must not touch any raw "
+        "logsumexp spelling (docs/parallel_scan.md)."
+    )
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for rel in SEMIRING_MODULES:
+            mod = project.module(rel)
+            if mod is None:
+                yield self.finding(rel, 0, "time-parallel kernel module missing")
+                continue
+            imported = imported_symbols(mod.tree, LMATH_MODULES)
+            if "safe_logsumexp" not in imported:
+                yield self.finding(
+                    rel,
+                    0,
+                    f"does not import safe_logsumexp from {LMATH_MODULES[0]} "
+                    "— semiring combines would be unguarded",
+                )
+            elif "safe_logsumexp" not in called_names(mod.tree):
+                yield self.finding(
+                    rel,
+                    0,
+                    "imports safe_logsumexp but never calls it — "
+                    "semiring combines are unguarded",
+                )
+            for node in cached_walk(mod.tree):
+                if isinstance(node, ast.Attribute) and node.attr in RAW_LSE_ATTRS:
+                    yield self.finding(
+                        rel,
+                        node.lineno,
+                        f"raw `.{node.attr}` — semiring combines must use the "
+                        "guarded safe_logsumexp from hhmm_tpu.core.lmath",
+                    )
+                if isinstance(node, ast.ImportFrom):
+                    for alias in node.names:
+                        if (
+                            alias.name in RAW_LSE_ATTRS
+                            and node.module not in LMATH_MODULES
+                        ) or (
+                            alias.name in RAW_LSE_WRAPPERS
+                            and node.module in LMATH_MODULES
+                        ):
+                            yield self.finding(
+                                rel,
+                                node.lineno,
+                                f"imports raw `{alias.name}` from {node.module} "
+                                "— use safe_logsumexp from hhmm_tpu.core.lmath",
+                            )
+
+
+@register
+class MonotonicClockRule(Rule):
+    id = "monotonic-clock"
+    title = "no raw time.time() — monotonic clocks only"
+    doc = (
+        "Durations must come from time.perf_counter (directly or via "
+        "hhmm_tpu/obs/trace.py): a wall-clock step (NTP slew, suspend/ "
+        "resume) under time.time() silently corrupts every throughput "
+        "record — and the scripts/tpu_*_probe.py timings feed the measured "
+        "crossover table kernels/dispatch.py bets real decode throughput "
+        "on. Covers hhmm_tpu/, bench.py, bench_zoo.py, __graft_entry__.py "
+        "and scripts/."
+    )
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for mod in project.iter_modules():
+            if not _clock_scope(mod.rel):
+                continue
+            yield from self._check(mod)
+
+    def _check(self, mod: Module) -> Iterable[Finding]:
+        aliases = set()
+        for node in cached_walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "time":
+                        aliases.add(alias.asname or alias.name)
+            elif isinstance(node, ast.ImportFrom) and node.module == "time":
+                for alias in node.names:
+                    if alias.name == "time":
+                        yield self.finding(
+                            mod.rel,
+                            node.lineno,
+                            "imports raw `time.time` — use time.perf_counter "
+                            "(or hhmm_tpu.obs.trace)",
+                        )
+        if not aliases:
+            return
+        for node in cached_walk(mod.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "time"
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in aliases
+            ):
+                yield self.finding(
+                    mod.rel,
+                    node.lineno,
+                    f"raw `{node.func.value.id}.time()` timing read — "
+                    "wall-clock steps corrupt throughput records; use "
+                    "time.perf_counter (or hhmm_tpu.obs.trace)",
+                )
+
+
+_JIT_MAKERS = ("jit", "pjit", "pmap")
+
+
+def _uses_jax_jit(tree: ast.AST) -> bool:
+    """True when the module creates jit entry points — either the
+    attribute form (jax.jit/jax.pjit/jax.pmap) or names imported from
+    jax (``from jax import jit``); both spellings must trip the rule or
+    the check is trivially evaded."""
+    jitted_names = set()
+    for node in cached_walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module in (
+            "jax",
+            "jax.experimental.pjit",
+        ):
+            for alias in node.names:
+                if alias.name in _JIT_MAKERS:
+                    jitted_names.add(alias.asname or alias.name)
+    for node in cached_walk(tree):
+        if (
+            isinstance(node, ast.Attribute)
+            and node.attr in _JIT_MAKERS
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "jax"
+        ):
+            return True
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in jitted_names
+        ):
+            return True
+    return False
+
+
+@register
+class JitTelemetryRule(Rule):
+    id = "jit-telemetry"
+    title = "serve/bench jit entry points are telemetry-registered"
+    doc = (
+        "Every serve/bench module that creates a jax.jit entry point "
+        "(hhmm_tpu/serve/*.py, bench.py, bench_zoo.py) must import a "
+        "registration hook from hhmm_tpu.obs.telemetry and call it — "
+        "otherwise run manifests lose per-entry-point compile attribution "
+        "and the no-recompile audits go dark for that module. Only "
+        "register_jit counts: install_listeners attributes nothing."
+    )
+
+    def _applies(self, rel: str) -> bool:
+        return rel.rpartition("/")[0] == "hhmm_tpu/serve" or rel in _BENCH_FILES
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for mod in project.iter_modules():
+            if self._applies(mod.rel):
+                yield from self._check(mod)
+
+    def _check(self, mod: Module) -> Iterable[Finding]:
+        tree = mod.tree
+        if not _uses_jax_jit(tree):
+            return
+        direct = imported_symbols(tree, TELEMETRY_MODULES) & set(TELEMETRY_HOOKS)
+        module_aliases = set()
+        for node in cached_walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "hhmm_tpu.obs":
+                for alias in node.names:
+                    if alias.name == "telemetry":
+                        module_aliases.add(alias.asname or alias.name)
+            elif isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "hhmm_tpu.obs.telemetry":
+                        module_aliases.add(alias.asname or "hhmm_tpu.obs.telemetry")
+        called = bool(direct & called_names(tree))
+        if not called and module_aliases:
+            for node in cached_walk(tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in TELEMETRY_HOOKS
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in module_aliases
+                ):
+                    called = True
+                    break
+        if not (direct or module_aliases):
+            yield self.finding(
+                mod.rel,
+                0,
+                "creates jax.jit entry points but never imports a telemetry "
+                f"hook from {TELEMETRY_MODULES[0]} (expected one of "
+                f"{TELEMETRY_HOOKS}) — compile counts would be "
+                "unattributable in run manifests",
+            )
+        elif not called:
+            yield self.finding(
+                mod.rel,
+                0,
+                "imports telemetry but never calls a registration hook "
+                f"({TELEMETRY_HOOKS}) — jit entry points are unregistered",
+            )
+
+
+@register
+class MetricsPlaneRule(Rule):
+    id = "metrics-plane"
+    title = "one shared metrics plane (hhmm_tpu.obs.metrics)"
+    doc = (
+        "No private MetricsRegistry() outside obs/metrics.py (a second "
+        "registry forks the sink: its counters never reach the exports, "
+        "manifests, or obs_report); bare counter/gauge/histogram calls "
+        "must be bound from the metrics module; no module-level count-dict "
+        "stores."
+    )
+
+    def _applies(self, rel: str) -> bool:
+        return (
+            _in_package(rel) and rel != "hhmm_tpu/obs/metrics.py"
+        ) or rel in _BENCH_FILES
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for mod in project.iter_modules():
+            if self._applies(mod.rel):
+                yield from self._check(mod)
+
+    def _check(self, mod: Module) -> Iterable[Finding]:
+        tree = mod.tree
+        imported = imported_symbols(tree, METRICS_MODULES)
+        for node in cached_walk(tree):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                if (isinstance(fn, ast.Name) and fn.id == "MetricsRegistry") or (
+                    isinstance(fn, ast.Attribute) and fn.attr == "MetricsRegistry"
+                ):
+                    yield self.finding(
+                        mod.rel,
+                        node.lineno,
+                        "instantiates a private MetricsRegistry — a second "
+                        "registry forks the metrics sink; use the shared "
+                        "hhmm_tpu.obs.metrics registry",
+                    )
+                elif (
+                    isinstance(fn, ast.Name)
+                    and fn.id in METRIC_FNS
+                    and fn.id not in imported
+                ):
+                    yield self.finding(
+                        mod.rel,
+                        node.lineno,
+                        f"calls bare `{fn.id}(...)` not imported from "
+                        "hhmm_tpu.obs.metrics — ad-hoc metric sinks never "
+                        "reach the exports/manifests/obs_report",
+                    )
+        # module-level count-dict assignments only (function-local
+        # working dicts are algorithm state, not a metrics sink)
+        for node in mod.tree.body:
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+                value = node.value
+            else:
+                continue
+            is_dictish = isinstance(value, (ast.Dict, ast.DictComp)) or (
+                isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Name)
+                and value.func.id in ("dict", "defaultdict")
+            )
+            if not is_dictish:
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name) and AD_HOC_COUNT_RE.search(t.id):
+                    yield self.finding(
+                        mod.rel,
+                        node.lineno,
+                        f"module-level count store `{t.id}` — route counts "
+                        "through the shared hhmm_tpu.obs.metrics registry",
+                    )
+
+
+@register
+class PlacementRule(Rule):
+    id = "placement"
+    title = "placement objects confined to the planner"
+    doc = (
+        "No Mesh/NamedSharding/PartitionSpec construction outside "
+        "hhmm_tpu/plan/ and the core/compat.py shims — a new callsite "
+        "constructing placement objects directly re-fragments the decision "
+        "the planner centralizes, and its layout is invisible to the "
+        "manifest plan stanza (docs/sharding.md)."
+    )
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for mod in project.iter_modules():
+            if not _clock_scope(mod.rel):
+                continue
+            rel = mod.rel
+            if rel.startswith(PLACEMENT_ALLOWED_PREFIXES) or rel in (
+                PLACEMENT_ALLOWED_FILES
+            ):
+                continue
+            aliases = {}
+            for node in cached_walk(mod.tree):
+                if isinstance(node, ast.ImportFrom) and node.module == "jax.sharding":
+                    for alias in node.names:
+                        if alias.name in SHARDING_CTORS:
+                            aliases[alias.asname or alias.name] = alias.name
+            for node in cached_walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                ctor = None
+                if isinstance(fn, ast.Name) and fn.id in aliases:
+                    ctor = aliases[fn.id]
+                elif isinstance(fn, ast.Attribute) and fn.attr in SHARDING_CTORS:
+                    ctor = fn.attr
+                if ctor is not None:
+                    yield self.finding(
+                        rel,
+                        node.lineno,
+                        f"constructs `{ctor}` outside hhmm_tpu/plan/ — "
+                        "placement decisions belong to the execution planner "
+                        "(take a Plan / plan_for_mesh, or the core/compat.py "
+                        "pspec shim); see docs/sharding.md",
+                    )
+
+
+def _handler_catches_exception(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    names: List[str] = []
+    if isinstance(t, ast.Name):
+        names = [t.id]
+    elif isinstance(t, ast.Tuple):
+        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+    return "Exception" in names
+
+
+@register
+class ServeDegradeRule(Rule):
+    id = "serve-degrade"
+    title = "serve hot paths degrade, never raise"
+    doc = (
+        "In serve/scheduler.py the hot-path entry points (tick/flush/"
+        "submit/attach*) contain no bare re-`raise` and keep every "
+        "self._dispatch(...) call under a try/except-Exception degrade "
+        "handler — one malformed observation or a device loss must shed, "
+        "not take down every other series' flush (docs/serving.md)."
+    )
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        mod = project.module(SERVE_HOT_PATH_FILE)
+        if mod is None:
+            return
+        for cls in [n for n in cached_walk(mod.tree) if isinstance(n, ast.ClassDef)]:
+            for fn in [
+                n
+                for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and HOT_PATH_METHOD_RE.match(n.name)
+            ]:
+                guarded_spans: List[Tuple[int, int]] = []
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Raise) and node.exc is None:
+                        yield self.finding(
+                            mod.rel,
+                            node.lineno,
+                            f"bare `raise` in serve hot path `{fn.name}` — "
+                            "per-series failures must degrade into shed "
+                            "TickResponses, not propagate (docs/serving.md "
+                            "overload ladder)",
+                        )
+                    if isinstance(node, ast.Try) and any(
+                        _handler_catches_exception(h) for h in node.handlers
+                    ):
+                        lo = min(s.lineno for s in node.body)
+                        hi = max(getattr(s, "end_lineno", s.lineno) for s in node.body)
+                        guarded_spans.append((lo, hi))
+                for node in ast.walk(fn):
+                    if (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == HOT_PATH_DISPATCH_ATTR
+                    ):
+                        if not any(
+                            lo <= node.lineno <= hi for lo, hi in guarded_spans
+                        ):
+                            yield self.finding(
+                                mod.rel,
+                                node.lineno,
+                                f"`{HOT_PATH_DISPATCH_ATTR}` call in serve hot "
+                                f"path `{fn.name}` outside a try/except-"
+                                "Exception degrade handler — one malformed "
+                                "observation or device loss would fail every "
+                                "series in the flush",
+                            )
+
+
+@register
+class TimingHarnessRule(Rule):
+    id = "timing-harness"
+    title = "raw timing loops confined to obs/profile.py"
+    doc = (
+        "No perf_counter-around-block_until_ready timing loop outside the "
+        "obs/profile.py harness: every such loop re-derives the warmup/"
+        "compile split, fresh-input, and order-statistic discipline by "
+        "hand, so its numbers are incomparable with the kernel cost DB "
+        "rows dispatch bets on. Per-iteration clock reads (attribution) "
+        "are fine; bench.py and the probe drivers are exempt."
+    )
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for mod in project.iter_modules():
+            if not _in_package(mod.rel) or mod.rel == TIMING_HARNESS_FILE:
+                continue
+            yield from self._check(mod)
+
+    def _check(self, mod: Module) -> Iterable[Finding]:
+        pc_names = perf_counter_names(mod.tree)
+        fns = [
+            n
+            for n in cached_walk(mod.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for fn in fns:
+            own = own_scope_nodes(fn)
+            pc_lines = [n.lineno for n in own if is_perf_counter_call(n, pc_names)]
+            if len(pc_lines) < 2:
+                continue
+            for loop in own:
+                if not isinstance(loop, (ast.For, ast.While)):
+                    continue
+                body_nodes = [
+                    n for s in loop.body for n in [s, *own_scope_nodes(s)]
+                ]
+                if not any(is_block_until_ready_call(n) for n in body_nodes):
+                    continue
+                if any(is_perf_counter_call(n, pc_names) for n in body_nodes):
+                    continue  # per-iteration clock read: attribution, fine
+                end = getattr(loop, "end_lineno", loop.lineno)
+                if any(l < loop.lineno for l in pc_lines) and any(
+                    l > end for l in pc_lines
+                ):
+                    yield self.finding(
+                        mod.rel,
+                        loop.lineno,
+                        "raw perf_counter-around-block_until_ready timing "
+                        "loop — device timings must go through "
+                        "hhmm_tpu.obs.profile.device_time (the one harness "
+                        "with the warmup/compile split and order-statistic "
+                        "discipline; see docs/observability.md kernel cost "
+                        "plane)",
+                    )
+
+
+@register
+class ServeClockRule(Rule):
+    id = "serve-clock"
+    title = "serve-layer clocks route through the request plane"
+    doc = (
+        "No raw perf_counter read anywhere under hhmm_tpu/serve/ — "
+        "neither the bare imported name nor the attribute spelling. A raw "
+        "read there is a timing the request plane cannot see; route it "
+        "through obs_request.now or a lifecycle recorder stage stamp "
+        "(docs/observability.md request plane)."
+    )
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for mod in project.iter_modules():
+            if not mod.rel.startswith(SERVE_DIR_PREFIX):
+                continue
+            pc_names = perf_counter_names(mod.tree)
+            for node in cached_walk(mod.tree):
+                if is_perf_counter_call(node, pc_names):
+                    yield self.finding(
+                        mod.rel,
+                        node.lineno,
+                        "raw `perf_counter` read in the serve layer — "
+                        "per-tick timing must route through the "
+                        "request-plane lifecycle recorder (hhmm_tpu.obs."
+                        "request `now`/stage stamps; see "
+                        "docs/observability.md request plane)",
+                    )

@@ -1,0 +1,310 @@
+"""PRNG discipline rules.
+
+The defect class: JAX keys are consumed, not mutated. Passing the same
+key to two sampling calls yields IDENTICAL randomness — across vmapped
+chains that silently correlates every chain's proposal stream, which
+corrupts posteriors without a single warning. The dual defect is the
+dead ``split``: a subkey that is produced and never consumed usually
+means a call was refactored to take the WRONG key (often the parent —
+i.e. a reuse) and the split now only looks like hygiene.
+
+Two rules, both same-scope dataflow over each function body (nested
+defs are their own scopes):
+
+- ``prng-key-reuse`` (error) — a key variable consumed by two
+  key-consuming calls (``jax.random.*`` samplers and ``split``; all
+  spellings — ``jax.random.fn``, an aliased random module, or bare
+  imported names) with no intervening rebinding of that variable.
+  Consumption and rebinding are ordered linearly by line;
+  consumptions in mutually exclusive ``if``/``else`` (or
+  ``try``/``except``) branches do not pair, nor does a consumption
+  in a branch that ``return``s/``raise``s before the later one can
+  run. ``fold_in(key, i)`` is a DERIVATION, not an exhausting
+  consumption — several children from one parent with distinct data
+  is the sanctioned pattern — so it neither claims nor conflicts. A
+  single consumption inside a ``for``/``while`` BODY (the ``iter``
+  expression evaluates once and doesn't count) with no same-body
+  rebinding is also a reuse — every iteration draws the same
+  randomness.
+- ``prng-dead-split`` (warning) — a name bound from a
+  ``jax.random.split`` result that is never read afterwards in the
+  same scope. Underscore-prefixed names are exempt (explicitly
+  discarded).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .astutil import cached_walk, build_parents, module_aliases, mutually_exclusive, own_scope_nodes
+from .engine import Finding, Module, Project, Rule, register
+
+_SAMPLERS = {
+    "normal",
+    "uniform",
+    "bernoulli",
+    "categorical",
+    "choice",
+    "permutation",
+    "randint",
+    "truncated_normal",
+    "beta",
+    "gamma",
+    "poisson",
+    "dirichlet",
+    "multivariate_normal",
+    "exponential",
+    "laplace",
+    "gumbel",
+    "t",
+    "split",
+    "fold_in",
+}
+
+
+def _random_aliases(tree: ast.AST) -> Tuple[Set[str], Set[str], Dict[str, str]]:
+    """(module aliases of jax.random, module aliases of jax itself,
+    bare names imported from jax.random). The ``jax`` aliases matter
+    because the repo's dominant spelling is the attribute chain
+    ``jax.random.normal(...)`` under a plain ``import jax`` — a rule
+    that only sees alias-based spellings scans nothing real."""
+    mods = module_aliases(tree, "jax.random")
+    jax_mods = module_aliases(tree, "jax")
+    fns: Dict[str, str] = {}
+    for node in cached_walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax.random":
+            for a in node.names:
+                if a.name in _SAMPLERS:
+                    fns[a.asname or a.name] = a.name
+    return mods, jax_mods, fns
+
+
+def _consumer_of(
+    node: ast.AST, mods: Set[str], jax_mods: Set[str], fns: Dict[str, str]
+) -> str:
+    """The jax.random function name when ``node`` is a key-consuming
+    call, else '' — matches ``<rnd-alias>.fn``, ``<jax-alias>.random.fn``
+    and bare imported names alike."""
+    if not isinstance(node, ast.Call):
+        return ""
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in _SAMPLERS:
+        base = f.value
+        if isinstance(base, ast.Name) and base.id in mods:
+            return f.attr
+        if (
+            isinstance(base, ast.Attribute)
+            and base.attr == "random"
+            and isinstance(base.value, ast.Name)
+            and base.value.id in jax_mods
+        ):
+            return f.attr
+    if isinstance(f, ast.Name) and f.id in fns:
+        return fns[f.id]
+    return ""
+
+
+def _scopes(tree: ast.AST):
+    for node in cached_walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _assigned_names(target: ast.AST) -> List[ast.Name]:
+    return [n for n in ast.walk(target) if isinstance(n, ast.Name)]
+
+
+def _enclosing_loop(node: ast.AST, parents, scope) -> Optional[ast.AST]:
+    """The innermost For/While whose BODY contains ``node`` — a node in
+    the loop's ``iter``/``target``/``test`` fields evaluates once, not
+    per iteration (`for k in split(key, 2):` consumes key ONCE), so
+    those positions don't count as in-loop."""
+    child, n = node, node
+    while n in parents and n is not scope:
+        child, n = n, parents[n]
+        if isinstance(n, (ast.For, ast.While)):
+            in_body = any(
+                any(s is child for s in getattr(n, fld, []))
+                for fld in ("body", "orelse")
+            )
+            if in_body:
+                return n
+    return None
+
+
+_TERMINATORS = (ast.Return, ast.Raise)
+
+
+def _exits_before(a: ast.AST, b: ast.AST, parents) -> bool:
+    """True when every path from ``a``'s statement leaves the function
+    before ``b`` can execute — i.e. some enclosing block of ``a`` that
+    does NOT contain ``b`` ends in ``return``/``raise``. This is the
+    early-return branch shape (`if cond: use(key); return` followed by
+    `use(key)` later) that plain lowest-common-ancestor branch testing
+    misses."""
+    b_anc = set()
+    n = b
+    while n in parents:
+        b_anc.add(id(n))
+        n = parents[n]
+    b_anc.add(id(n))  # the scope root itself contains b
+    child, n = a, a
+    while n in parents:
+        child, n = n, parents[n]
+        if id(n) in b_anc:
+            return False  # reached a block containing b: flow may continue
+        for fld in ("body", "orelse", "finalbody"):
+            stmts = getattr(n, fld, None)
+            if isinstance(stmts, list) and any(s is child for s in stmts):
+                if stmts and isinstance(stmts[-1], _TERMINATORS):
+                    return True
+    return False
+
+
+@register
+class PrngKeyReuseRule(Rule):
+    id = "prng-key-reuse"
+    title = "no PRNG key consumed twice without an intervening split"
+    doc = (
+        "Two sampling calls fed the same key produce identical "
+        "randomness; across vmapped chains this correlates proposal "
+        "streams and corrupts posteriors silently. Rebind between "
+        "consumptions (`key, sub = split(key)`) or derive per-call "
+        "keys with fold_in (a derivation — it never conflicts)."
+    )
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for mod in project.iter_modules():
+            if not mod.rel.startswith("hhmm_tpu/"):
+                continue
+            mods, jax_mods, fns = _random_aliases(mod.tree)
+            if not mods and not jax_mods and not fns:
+                continue
+            for fn in _scopes(mod.tree):
+                yield from self._check_scope(mod, fn, mods, jax_mods, fns)
+
+    def _check_scope(self, mod: Module, scope, mods, jax_mods, fns) -> Iterable[Finding]:
+        own = own_scope_nodes(scope)
+        # events: (line, order, kind, name, fn_name, node). fold_in is a
+        # DERIVATION, not an exhausting consumption: deriving several
+        # children from one parent with distinct data is the sanctioned
+        # pattern, so it neither claims the key nor conflicts — but a
+        # dead fold-in chain still shows up via prng-dead-split.
+        events: List[Tuple[int, int, str, str, str, ast.AST]] = []
+        for n in own:
+            sfn = _consumer_of(n, mods, jax_mods, fns)
+            if sfn and n.args and isinstance(n.args[0], ast.Name):
+                kind = "derive" if sfn == "fold_in" else "consume"
+                events.append((n.lineno, 0, kind, n.args[0].id, sfn, n))
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    for name in _assigned_names(t):
+                        events.append((n.lineno, 1, "kill", name.id, "", n))
+            elif isinstance(n, (ast.AnnAssign, ast.AugAssign)) and isinstance(
+                getattr(n, "target", None), ast.Name
+            ):
+                events.append((n.lineno, 1, "kill", n.target.id, "", n))
+            elif isinstance(n, ast.For):
+                for name in _assigned_names(n.target):
+                    events.append((n.lineno, 1, "kill", name.id, "", n))
+            elif isinstance(n, (ast.comprehension,)):
+                for name in _assigned_names(n.target):
+                    events.append((getattr(n.target, "lineno", 0), 1, "kill", name.id, "", n))
+        events.sort(key=lambda e: (e[0], e[1]))
+        parents = build_parents(scope)
+        live: Dict[str, Tuple[int, str, ast.AST]] = {}
+        kills_by_name: Dict[str, List[ast.AST]] = {}
+        for line, _, kind, name, sfn, node in events:
+            if kind == "kill":
+                live.pop(name, None)
+                kills_by_name.setdefault(name, []).append(node)
+                continue
+            if kind != "consume":
+                continue  # fold_in derivations neither claim nor conflict
+            prev = live.get(name)
+            if (
+                prev is not None
+                and not mutually_exclusive(prev[2], node, parents)
+                and not _exits_before(prev[2], node, parents)
+            ):
+                yield self.finding(
+                    mod.rel,
+                    line,
+                    f"PRNG key `{name}` consumed by `{sfn}` but already "
+                    f"consumed by `{prev[1]}` at line {prev[0]} with no "
+                    "intervening split/rebind — identical randomness "
+                    "(split the key, or fold_in per call)",
+                )
+            live[name] = (line, sfn, node)
+        # in-loop single consumption with no same-loop rebinding:
+        # every iteration draws the same stream
+        for line, _, kind, name, sfn, node in events:
+            if kind != "consume" or sfn == "fold_in":
+                continue
+            loop = _enclosing_loop(node, parents, scope)
+            if loop is None:
+                continue
+            loop_end = getattr(loop, "end_lineno", loop.lineno)
+            # a rebinding anywhere in the loop (including the loop's own
+            # target: `for key in keys:` re-binds per iteration) clears it
+            killed_in_loop = any(
+                loop.lineno <= getattr(k, "lineno", -1) <= loop_end
+                for k in kills_by_name.get(name, ())
+            )
+            if not killed_in_loop:
+                yield self.finding(
+                    mod.rel,
+                    line,
+                    f"PRNG key `{name}` consumed by `{sfn}` inside a loop "
+                    "with no per-iteration split/rebind — every iteration "
+                    "draws identical randomness (fold_in the loop index or "
+                    "split inside the loop)",
+                )
+
+
+@register
+class PrngDeadSplitRule(Rule):
+    id = "prng-dead-split"
+    severity = "warning"
+    title = "no dead jax.random.split results"
+    doc = (
+        "A subkey produced by split and never consumed usually means a "
+        "downstream call was refactored onto the WRONG key — frequently "
+        "the parent, i.e. a latent reuse. Consume it, delete the split, "
+        "or bind the discard to an underscore-prefixed name."
+    )
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for mod in project.iter_modules():
+            if not mod.rel.startswith("hhmm_tpu/"):
+                continue
+            mods, jax_mods, fns = _random_aliases(mod.tree)
+            if not mods and not jax_mods and not fns:
+                continue
+            for fn in _scopes(mod.tree):
+                yield from self._check_scope(mod, fn, mods, jax_mods, fns)
+
+    def _check_scope(self, mod: Module, scope, mods, jax_mods, fns) -> Iterable[Finding]:
+        own = own_scope_nodes(scope)
+        loads: Dict[str, int] = {}
+        for n in own:
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                loads[n.id] = loads.get(n.id, 0) + 1
+        for n in own:
+            if not isinstance(n, ast.Assign):
+                continue
+            if _consumer_of(n.value, mods, jax_mods, fns) != "split":
+                continue
+            for t in n.targets:
+                for name in _assigned_names(t):
+                    if name.id.startswith("_"):
+                        continue
+                    if loads.get(name.id, 0) == 0:
+                        yield self.finding(
+                            mod.rel,
+                            n.lineno,
+                            f"split result `{name.id}` is never consumed in "
+                            "this scope — dead PRNG split (a downstream "
+                            "call likely uses the wrong key)",
+                        )

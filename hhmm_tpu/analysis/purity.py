@@ -1,0 +1,331 @@
+"""Hot-path purity rules.
+
+The defect class: host work inside device code. A ``block_until_ready``
+/ ``.item()`` / ``float(traced)`` / ``np.*`` / ``print`` / raw clock
+read inside a function that ``jit``/``lax.scan``/``vmap`` will trace
+either fails at trace time (on a Tracer) or — worse — silently
+constant-folds host state into the compiled executable, or forces a
+device→host sync per call. On TPU pods these are the classic
+throughput killers: one stray sync in a scan body serializes the whole
+pipeline.
+
+Two rules:
+
+- ``hot-path-purity`` — per module, mark every function reachable
+  (same-module call graph: bare-name calls and ``self.method`` calls)
+  from a ``jit``/``vmap``/``pmap``/``lax.scan``/``associative_scan``/
+  ``fori_loop``/``while_loop``/``cond``/``map`` call site, a
+  ``@jit``-family decorator, or a ``partial(jit, ...)`` decorator, and
+  flag host-sync/IO operations inside those functions.
+  ``float(x)``/``int(x)`` are flagged only when the argument is
+  array-shaped (contains a call/subscript/attribute) — ``float(j)`` on
+  a static Python loop index is how Pallas kernels spell constants and
+  is pure. ``np.float32``-style dtype attribute references are fine;
+  ``np.anything(...)`` calls are not.
+- ``raw-clock`` — raw ``perf_counter``/``monotonic`` reads anywhere
+  under ``hhmm_tpu/`` outside the obs/ substrate (which IS the clock
+  plane) and outside serve/ (owned by the stricter legacy
+  ``serve-clock`` rule). Host-side phase attribution belongs in
+  ``obs.profile.PhaseClock`` / ``obs.trace.span`` so the timings reach
+  manifests and stay comparable; a raw read is a number nothing else
+  can see. bench.py / scripts/ probe drivers are exempt (their timed
+  loops are the measurement products).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Sequence, Set, Tuple
+
+from .astutil import cached_walk, call_target_names, module_aliases, own_scope_nodes
+from .engine import Finding, Module, Project, Rule, register
+
+_DEVICE_WRAPPERS = ("jit", "vmap", "pmap")
+# lax higher-order fns -> positional indices of their traced callables
+_LAX_HOF: Dict[str, Tuple[int, ...]] = {
+    "scan": (0,),
+    "associative_scan": (0,),
+    "map": (0,),
+    "fori_loop": (2,),
+    "while_loop": (0, 1),
+    "cond": (1, 2, 3),
+    "checkpoint": (0,),
+}
+# np attribute CALLS that are pure dtype/constant constructors
+_NP_PURE_ATTRS = {
+    "float32",
+    "float64",
+    "int8",
+    "int16",
+    "int32",
+    "int64",
+    "uint8",
+    "uint32",
+    "bool_",
+    "dtype",
+}
+_CLOCK_ATTRS = ("time", "perf_counter", "monotonic", "monotonic_ns", "perf_counter_ns")
+
+
+def _jax_aliases(tree: ast.AST) -> Set[str]:
+    return module_aliases(tree, "jax")
+
+
+class _ModuleIndex:
+    """Per-module device-entry detection + same-module reachability."""
+
+    def __init__(self, mod: Module):
+        self.mod = mod
+        tree = mod.tree
+        self.jax = _jax_aliases(tree)
+        self.lax = module_aliases(tree, "jax.lax")
+        self.np = module_aliases(tree, "numpy")
+        self.time_mods = module_aliases(tree, "time")
+        # bare names bound to device wrappers / lax HOFs
+        self.wrapper_names: Dict[str, str] = {}
+        for node in cached_walk(tree):
+            if isinstance(node, ast.ImportFrom):
+                if node.module == "jax":
+                    for a in node.names:
+                        if a.name in _DEVICE_WRAPPERS:
+                            self.wrapper_names[a.asname or a.name] = a.name
+                elif node.module == "jax.lax":
+                    for a in node.names:
+                        if a.name in _LAX_HOF:
+                            self.wrapper_names[a.asname or a.name] = a.name
+        self.defs: Dict[str, List[ast.AST]] = {}
+        for node in cached_walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs.setdefault(node.name, []).append(node)
+
+    def _wrapper_of(self, fn: ast.AST) -> str:
+        """The device-wrapper name a callable expression resolves to,
+        or '' — covering the bare imported name, ``lax.scan``/``jax.jit``
+        one-level attributes, AND the full ``jax.lax.scan`` chain (the
+        plain-``import jax`` spelling most of the repo uses)."""
+        if isinstance(fn, ast.Name):
+            return self.wrapper_names.get(fn.id, "")
+        if isinstance(fn, ast.Attribute):
+            base = fn.value
+            if isinstance(base, ast.Name):
+                if fn.attr in _DEVICE_WRAPPERS and base.id in self.jax:
+                    return fn.attr
+                if fn.attr in _LAX_HOF and (base.id in self.lax or base.id in self.jax):
+                    return fn.attr
+            elif (
+                isinstance(base, ast.Attribute)
+                and fn.attr in _LAX_HOF
+                and base.attr == "lax"
+                and isinstance(base.value, ast.Name)
+                and base.value.id in self.jax
+            ):
+                return fn.attr
+        return ""
+
+    def device_seeds(self) -> Tuple[Set[str], List[ast.AST]]:
+        """(function names, lambda nodes) handed to a device wrapper."""
+        names: Set[str] = set()
+        lambdas: List[ast.AST] = []
+
+        def mark(arg: ast.AST) -> None:
+            if isinstance(arg, ast.Lambda):
+                lambdas.append(arg)
+            else:
+                names.update(call_target_names(arg))
+
+        for node in cached_walk(self.mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    if self._wrapper_of(target) in _DEVICE_WRAPPERS:
+                        names.add(node.name)
+                    # @partial(jax.jit, ...) / @partial(jit, ...)
+                    if isinstance(dec, ast.Call):
+                        f = dec.func
+                        is_partial = (
+                            isinstance(f, ast.Name) and f.id == "partial"
+                        ) or (isinstance(f, ast.Attribute) and f.attr == "partial")
+                        if is_partial and dec.args:
+                            if self._wrapper_of(dec.args[0]) in _DEVICE_WRAPPERS:
+                                names.add(node.name)
+            if not isinstance(node, ast.Call):
+                continue
+            wrapper = self._wrapper_of(node.func)
+            if not wrapper:
+                continue
+            if wrapper in _DEVICE_WRAPPERS:
+                if node.args:
+                    mark(node.args[0])
+            else:
+                for i in _LAX_HOF[wrapper]:
+                    if i < len(node.args):
+                        mark(node.args[i])
+        return names, lambdas
+
+    def reachable(self) -> List[ast.AST]:
+        """Defs/lambdas reachable from device seeds via same-module
+        bare-name and ``self.method`` calls. Cross-module reachability
+        is out of scope (documented in docs/static_analysis.md)."""
+        seed_names, lambdas = self.device_seeds()
+        seen: Set[str] = set()
+        out: List[ast.AST] = list(lambdas)
+        frontier = list(seed_names)
+        while frontier:
+            name = frontier.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            for d in self.defs.get(name, ()):
+                out.append(d)
+                for n in ast.walk(d):
+                    if not isinstance(n, ast.Call):
+                        continue
+                    f = n.func
+                    if isinstance(f, ast.Name) and f.id in self.defs:
+                        frontier.append(f.id)
+                    elif (
+                        isinstance(f, ast.Attribute)
+                        and isinstance(f.value, ast.Name)
+                        and f.value.id in ("self", "cls")
+                        and f.attr in self.defs
+                    ):
+                        frontier.append(f.attr)
+        return out
+
+
+def _arrayish(arg: ast.AST) -> bool:
+    """Heuristic: the expression can hold a traced array — it contains
+    a call, subscript, or attribute read. Bare names, constants, and
+    arithmetic over them are how static kernel constants are spelled
+    (``float(j)``, ``float(_L - 1)``) and stay exempt, as is anything
+    routed through a ``.shape``/``.ndim`` read or ``len(...)`` — those
+    are static Python ints at trace time."""
+    for n in ast.walk(arg):
+        if isinstance(n, ast.Attribute) and n.attr in ("shape", "ndim"):
+            return False
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) and n.func.id == "len":
+            return False
+    for n in ast.walk(arg):
+        if isinstance(n, (ast.Call, ast.Subscript, ast.Attribute)):
+            return True
+    return False
+
+
+@register
+class HotPathPurityRule(Rule):
+    id = "hot-path-purity"
+    title = "no host sync/IO in functions reachable from jit/scan/vmap sites"
+    doc = (
+        "block_until_ready, .item(), float()/int() on array-shaped "
+        "arguments, np.*() calls, print(), and raw clock reads are "
+        "flagged inside any function reachable — through the module's own "
+        "call graph — from a jit/vmap/pmap/lax.scan/associative_scan/"
+        "fori_loop/while_loop/cond/map call site or decorator. Each is a "
+        "trace-time failure or a silent per-call device→host sync in a "
+        "hot path. Deliberate respond-time syncs live OUTSIDE traced "
+        "functions; anything that genuinely must stay gets an inline "
+        "pragma or an allowlist entry with a rationale."
+    )
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for mod in project.iter_modules():
+            if not mod.rel.startswith("hhmm_tpu/"):
+                continue
+            idx = _ModuleIndex(mod)
+            for body in idx.reachable():
+                fname = getattr(body, "name", "<lambda>")
+                for n in ast.walk(body):
+                    msg = self._impure(n, idx)
+                    if msg:
+                        yield self.finding(
+                            mod.rel,
+                            n.lineno,
+                            f"{msg} inside `{fname}`, which is reachable from "
+                            "a jit/scan/vmap call site — host work in device "
+                            "code is a trace failure or a per-call sync "
+                            "(hot-path purity)",
+                        )
+
+    def _impure(self, n: ast.AST, idx: _ModuleIndex) -> str:
+        if not isinstance(n, ast.Call):
+            return ""
+        f = n.func
+        if isinstance(f, ast.Attribute):
+            if f.attr == "block_until_ready":
+                return "`block_until_ready` sync"
+            if f.attr == "item" and not n.args:
+                return "`.item()` host transfer"
+            if f.attr in ("device_get", "device_put") and isinstance(
+                f.value, ast.Name
+            ) and f.value.id in idx.jax:
+                return f"`jax.{f.attr}` host transfer"
+            if isinstance(f.value, ast.Name):
+                if f.value.id in idx.np and f.attr not in _NP_PURE_ATTRS:
+                    return f"`{f.value.id}.{f.attr}(...)` NumPy host call"
+                if f.value.id in idx.time_mods and f.attr in _CLOCK_ATTRS:
+                    return f"raw clock read `{f.value.id}.{f.attr}()`"
+        elif isinstance(f, ast.Name):
+            if f.id == "block_until_ready":
+                return "`block_until_ready` sync"
+            if f.id == "print":
+                return "`print(...)` host IO"
+            if f.id in ("float", "int") and n.args and _arrayish(n.args[0]):
+                return f"`{f.id}(...)` cast of an array-shaped value"
+            if f.id == "perf_counter":
+                return "raw clock read `perf_counter()`"
+        return ""
+
+
+@register
+class RawClockRule(Rule):
+    id = "raw-clock"
+    title = "host-side clock reads route through the obs plane"
+    doc = (
+        "Raw perf_counter/monotonic reads under hhmm_tpu/ (outside obs/, "
+        "which is the clock substrate, and serve/, owned by the stricter "
+        "serve-clock rule) are flagged: phase attribution belongs in "
+        "obs.profile.PhaseClock or obs.trace.span so timings reach "
+        "manifests and aggregate consistently. bench.py and scripts/ "
+        "drivers are exempt — their timed loops are the measurement "
+        "products themselves."
+    )
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for mod in project.iter_modules():
+            rel = mod.rel
+            if not rel.startswith("hhmm_tpu/"):
+                continue
+            if rel.startswith("hhmm_tpu/obs/") or rel.startswith("hhmm_tpu/serve/"):
+                continue
+            if rel.startswith("hhmm_tpu/analysis/"):
+                continue
+            time_mods = module_aliases(mod.tree, "time")
+            bare: Set[str] = set()
+            for node in cached_walk(mod.tree):
+                if isinstance(node, ast.ImportFrom) and node.module == "time":
+                    for a in node.names:
+                        if a.name in ("perf_counter", "monotonic"):
+                            bare.add(a.asname or a.name)
+            for node in cached_walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                hit = ""
+                if isinstance(f, ast.Name) and f.id in bare:
+                    hit = f.id
+                elif (
+                    isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in time_mods
+                    and f.attr in ("perf_counter", "monotonic")
+                ):
+                    hit = f"{f.value.id}.{f.attr}"
+                if hit:
+                    yield self.finding(
+                        mod.rel,
+                        node.lineno,
+                        f"raw `{hit}()` read — route phase attribution "
+                        "through hhmm_tpu.obs.profile.PhaseClock (or an "
+                        "obs.trace span) so the timing reaches manifests "
+                        "and aggregates consistently",
+                    )

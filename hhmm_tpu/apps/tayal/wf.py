@@ -26,6 +26,7 @@ from hhmm_tpu.apps.tayal.trading import Trades
 from hhmm_tpu.batch import fit_batched, pad_datasets
 from hhmm_tpu.infer import SamplerConfig
 from hhmm_tpu.models import TayalHHMMLite
+from hhmm_tpu.obs.profile import PhaseClock
 
 __all__ = ["WFTask", "WFResult", "build_tasks", "wf_trade"]
 
@@ -146,17 +147,13 @@ def wf_trade(
     associative-scan kernels per decode bucket from the measured
     table; ``True``/``False`` force a branch for every bucket.
     """
-    import time as _time
-
     if key is None:
         key = jax.random.PRNGKey(0)
     tm = phase_timings if phase_timings is not None else {}
-    t_phase = _time.perf_counter()
-
-    def _mark(name):
-        nonlocal t_phase
-        tm[name] = round(tm.get(name, 0.0) + _time.perf_counter() - t_phase, 2)
-        t_phase = _time.perf_counter()
+    # phase attribution through the obs plane (analysis rule raw-clock:
+    # no raw perf_counter reads outside obs/) — same rounded cumulative
+    # semantics the hand-rolled _mark closure had
+    _mark = PhaseClock(tm, round_digits=2).mark
 
     model = TayalHHMMLite(gate_mode=gate_mode)
 
@@ -342,7 +339,7 @@ def wf_trade(
         )
 
     sub = defaultdict(float)  # raw-float sub-profile; rounded once below
-    t_sel = _time.perf_counter()
+    _sub_clock = PhaseClock(sub)  # marker doubles as the select-phase t0
     leg_states: List[Optional[np.ndarray]] = [None] * B
     meta = []  # per-task (n_ins, n_oos, b_ins, b_oos, keep, draws_thin, dk, n_uniq)
     pend: Dict[tuple, List[int]] = {}
@@ -379,15 +376,14 @@ def wf_trade(
                 {"n_ins": n_ins, "n_uniq": n_uniq},
                 draws_t,
             )
-            t_rd = _time.perf_counter()
-            hit = dcache.get(dk)
-            sub["decode.cache_read"] += _time.perf_counter() - t_rd
+            with _sub_clock.phase("decode.cache_read"):
+                hit = dcache.get(dk)
             if hit is not None:
                 leg_states[i] = np.asarray(hit["leg_state"])
         meta.append((n_ins, n_oos, b_ins, b_oos, keep, draws_t, dk, n_uniq))
         if leg_states[i] is None:
             pend.setdefault((b_ins, b_oos), []).append(i)
-    sub["decode.select"] = _time.perf_counter() - t_sel - sub["decode.cache_read"]
+    sub["decode.select"] = _sub_clock.elapsed() - sub["decode.cache_read"]
 
     # Device-side median-α classification: the generated pass's full
     # probability stacks ([G, D, T, K] f32 ≈ 250 MB/dispatch) dominated
@@ -413,15 +409,11 @@ def wf_trade(
     # single largest unprofiled cost): host prep vs first-call-per-
     # shape (compile+run) vs steady-state dispatches vs host reduction
     # vs cache IO, plus shape/dispatch counts — in the same phase dict
-    def _acc(name, t0):
-        sub[name] += _time.perf_counter() - t0
-        return _time.perf_counter()
-
     seen_shapes: set = set()
     tm["decode.dispatches"] = 0
     for (b_ins, b_oos), idxs in pend.items():
         for c0 in range(0, len(idxs), G_DEC):
-            t_sub = _time.perf_counter()
+            _sub_clock.restart()
             grp = idxs[c0 : c0 + G_DEC]
             pad_n = G_DEC - len(grp)
             grp_fit = grp + [grp[-1]] * pad_n  # repeat-pad: one compile
@@ -453,7 +445,7 @@ def wf_trade(
             }
             samples_g = np.stack([meta[j][5] for j in grp_fit])
             data_dev = {k: jnp.asarray(v) for k, v in data_g.items()}
-            t_sub = _acc("decode.prep", t_sub)
+            _sub_clock.mark("decode.prep")
             full = all(meta[j][7] == D_DEC for j in grp)
             shape_key = (b_ins, b_oos, full)
             first = shape_key not in seen_shapes
@@ -464,25 +456,25 @@ def wf_trade(
                     gen_med_fn(jnp.asarray(samples_g), data_dev)
                 )
                 ins_s, oos_s = np.asarray(ins_s), np.asarray(oos_s)
-                t_sub = _acc(
-                    "decode.first_call" if first else "decode.steady", t_sub
+                _sub_clock.mark(
+                    "decode.first_call" if first else "decode.steady"
                 )
                 for li, j in enumerate(grp):
                     n_ins_j, n_oos_j = meta[j][0], meta[j][1]
                     leg_states[j] = np.concatenate(
                         [ins_s[li][:n_ins_j], oos_s[li][:n_oos_j]]
                     )
-                t_sub = _acc("decode.host_reduce", t_sub)
+                _sub_clock.mark("decode.host_reduce")
                 for j in grp:
                     if meta[j][6] is not None:
                         dcache.put(meta[j][6], {"leg_state": leg_states[j]})
-                _acc("decode.cache_io", t_sub)
+                _sub_clock.mark("decode.cache_io")
                 continue
             out = jax.block_until_ready(gen_fn(jnp.asarray(samples_g), data_dev))
             alpha = np.asarray(out["alpha"])  # [G, D, b_ins, K]
             alpha_o = np.asarray(out["alpha_oos"])
-            t_sub = _acc(
-                "decode.first_call" if first else "decode.steady", t_sub
+            _sub_clock.mark(
+                "decode.first_call" if first else "decode.steady"
             )
             for li, j in enumerate(grp):
                 n_ins_j, n_oos_j, n_uniq_j = meta[j][0], meta[j][1], meta[j][7]
@@ -493,11 +485,11 @@ def wf_trade(
                     np.median(alpha_o[li][:n_uniq_j], axis=0), axis=-1
                 )[:n_oos_j]
                 leg_states[j] = np.concatenate([ins_state, oos_state])
-            t_sub = _acc("decode.host_reduce", t_sub)
+            _sub_clock.mark("decode.host_reduce")
             for j in grp:
                 if meta[j][6] is not None:
                     dcache.put(meta[j][6], {"leg_state": leg_states[j]})
-            _acc("decode.cache_io", t_sub)
+            _sub_clock.mark("decode.cache_io")
 
     # compile-shape accounting: the dispatch keys are (b_ins, b_oos,
     # full) — a pending (b_ins, b_oos) pair can expand into both the

@@ -53,7 +53,7 @@ class Identity(Bijector):
         self.n_free = int(np.prod(self.shape)) if self.shape else 1
 
     def forward(self, x):
-        return x.reshape(self.shape), jnp.zeros(())
+        return x.reshape(self.shape), jnp.zeros((), x.dtype)
 
     def inverse(self, y):
         return jnp.asarray(y).reshape(-1)
@@ -148,7 +148,7 @@ class Simplex(Bijector):
     def forward(self, x):
         K = self._K
         if K == 1:
-            return jnp.ones(self.shape), jnp.zeros(())
+            return jnp.ones(self.shape, x.dtype), jnp.zeros((), x.dtype)
         x = x.reshape(self.shape[:-1] + (K - 1,))
         offsets = -jnp.log(jnp.arange(K - 1, 0, -1, dtype=x.dtype))
         logit_z = x + offsets
@@ -169,7 +169,7 @@ class Simplex(Bijector):
     def inverse(self, y):
         K = self._K
         if K == 1:
-            return jnp.zeros((0,))
+            return jnp.zeros((0,), jnp.asarray(y).dtype)
         y = jnp.asarray(y).reshape(self.shape)
         csum = jnp.cumsum(y, axis=-1)
         rem_before = jnp.concatenate(

@@ -57,8 +57,13 @@ def backward_sample(
         z = jax.random.categorical(k, logits)
         if mask is not None:
             # If step t+1 was padding, z_{t+1} carries no information;
-            # sample from the filter at t instead.
-            z = jnp.where(m_next > 0, z, jax.random.categorical(k, alpha_t))
+            # sample from the filter at t instead. Reusing the per-step
+            # key is deliberate: the `where` keeps exactly ONE of the
+            # two draws per lane, so correlation between them is
+            # unobservable — and splitting would change the draw stream
+            # every seed-pinned FFBS test is calibrated against.
+            z = jnp.where(m_next > 0, z, jax.random.categorical(k, alpha_t))  # lint: ok prng-key-reuse -- exclusive where-selection: only one draw survives
+
         return z, z
 
     m = jnp.ones((T,), log_alpha.dtype) if mask is None else mask

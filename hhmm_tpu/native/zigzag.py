@@ -78,7 +78,7 @@ def _alloc(T: int):
 
 
 def _to_zigzag(bufs, n: int):
-    from hhmm_tpu.apps.tayal.features import ZigZag
+    from hhmm_tpu.apps.tayal.features import ZigZag  # lint: ok layer-import -- deliberate lazy cycle-breaker: apps.tayal.features imports native for the fast path; the return-type dataclass lives with the NumPy oracle and resolves at call time only
 
     lp, st, en, sa, f0, f1, f2, ft, tr = bufs
     return ZigZag(

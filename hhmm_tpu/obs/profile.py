@@ -120,6 +120,76 @@ class DeviceTiming:
         }
 
 
+class PhaseClock:
+    """Cumulative named phase attribution for host-side drivers.
+
+    The sanctioned home for the ``t0 = perf_counter(); ...;
+    sink[name] += perf_counter() - t0`` pattern that app drivers
+    (`apps/tayal/wf.py` phase timings) used to hand-roll — the
+    analysis rule ``raw-clock`` confines raw clock reads outside
+    ``obs/`` to this wrapper so every phase number shares one
+    accumulation discipline (monotonic clock, optional fixed rounding,
+    one sink dict that lands in records/manifests verbatim).
+
+    Not a tracing span (`obs/trace.py` ``span`` owns nesting +
+    percentile aggregation) and not a device harness
+    (:func:`device_time` owns synced kernel timing): this is the thin
+    phase-bucket accumulator in between — sequential ``mark`` points
+    and re-entrant ``phase`` regions over one mutable sink.
+
+    - :meth:`mark` — accumulate the time since the previous
+      mark/restart into ``name`` and reset the marker (sequential
+      phase splits).
+    - :meth:`phase` — context manager accumulating its own region into
+      ``name`` (nested/scattered attribution); does NOT move the
+      ``mark`` marker.
+    - :meth:`elapsed` — seconds since the last mark/restart, without
+      consuming it.
+    """
+
+    def __init__(self, sink: Optional[Dict[str, float]] = None, round_digits: Optional[int] = None):
+        self.sink: Dict[str, float] = sink if sink is not None else {}
+        self._round = round_digits
+        self._last = perf_counter()
+
+    def _acc(self, name: str, dt: float) -> None:
+        total = self.sink.get(name, 0.0) + dt
+        self.sink[name] = (
+            round(total, self._round) if self._round is not None else total
+        )
+
+    def restart(self) -> None:
+        self._last = perf_counter()
+
+    def elapsed(self) -> float:
+        return perf_counter() - self._last
+
+    def mark(self, name: str) -> float:
+        now = perf_counter()
+        dt = now - self._last
+        self._acc(name, dt)
+        self._last = now
+        return dt
+
+    def phase(self, name: str):
+        return _PhaseRegion(self, name)
+
+
+class _PhaseRegion:
+    __slots__ = ("_clock", "_name", "_t0")
+
+    def __init__(self, clock: PhaseClock, name: str):
+        self._clock = clock
+        self._name = name
+
+    def __enter__(self):
+        self._t0 = perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._clock._acc(self._name, perf_counter() - self._t0)
+
+
 def device_time(
     fn,
     *args,
@@ -293,7 +363,7 @@ def decode_kernel_pairs() -> Dict[str, Tuple[Any, Any]]:
     (`kernels/dispatch.py` imports it)."""
     import jax
 
-    from hhmm_tpu.kernels import (
+    from hhmm_tpu.kernels import (  # lint: ok layer-import -- deliberate lazy cycle-breaker: obs sits below kernels (dispatch imports obs.trace/profile); this driver-only helper resolves at call time, never at import time
         ffbs_assoc_sample,
         ffbs_fused,
         forward_filter,

@@ -1,748 +1,61 @@
 #!/usr/bin/env python
-"""Static robustness pass (tier-1, no JAX import — pure ``ast``).
+"""Static guard pass (tier-1, no JAX import) — thin shim over
+``hhmm_tpu.analysis``.
 
-Asserts the invariants the fault-tolerance subsystem
-(`docs/robustness.md`) and the streaming service (`docs/serving.md`)
-depend on:
+Until PR 11 this file was a 773-line monolith of ten hand-written AST
+invariants. Those invariants now live as first-class rules in the
+:mod:`hhmm_tpu.analysis` rule engine (``hhmm_tpu/analysis/legacy.py``
+— same detection logic, same messages, same scoping), alongside the
+four new rule families the monolith could not express (hot-path
+purity, PRNG discipline, dtype discipline, import layering — see
+docs/static_analysis.md for the catalog, pragma syntax, and how to add
+a rule).
 
-1. **No bare ``except:``** anywhere under ``hhmm_tpu/`` (the serving
-   layer included) — a bare handler swallows
-   ``KeyboardInterrupt``/``SystemExit`` and, worse, masks the device
-   faults the retry layer (`robust/retry.py`) must *see* to classify
-   (UNAVAILABLE vs deterministic). Catch concrete types.
-2. **Every public sampler entry point routes through the chain-health
-   guard**: each sampler module (`infer/run.py`, `infer/chees.py`,
-   `infer/gibbs.py`) must import from ``hhmm_tpu.robust.guards`` and
-   actually *call* a guard function — a sampler added (or refactored)
-   without the guard would silently reintroduce NaN poisoning of vmapped
-   batches.
-3. **The online filter step routes through the guarded normalization**:
-   ``serve/online.py`` must import ``safe_log_normalize`` from
-   ``hhmm_tpu.core.lmath`` and call it — a streaming update normalized
-   with a bare ``log_normalize`` would turn impossible evidence into
-   NaN state instead of the −inf floor the scheduler's quarantine mask
-   detects (`serve/scheduler.py`).
-4. **Semiring combines use the guarded reduction**: the time-parallel
-   kernels (`kernels/semiring.py`, `kernels/assoc.py`) must import
-   ``safe_logsumexp`` from ``hhmm_tpu.core.lmath`` and call it, and
-   must NOT touch any raw logsumexp — no ``jnp.logaddexp`` /
-   ``jax.nn.logsumexp`` attribute access, no un-guarded ``logsumexp``
-   import. Semiring *identity elements are −inf by construction*, so
-   an all-identity fiber (masked run, impossible evidence) hits the
-   all-(−inf) reduction edge case on every combine; a raw logsumexp
-   there has NaN cotangents and, in naive forms, NaN values
-   (docs/parallel_scan.md).
-5. **Observability invariants** (`docs/observability.md`): (a) no raw
-   ``time.time()`` call anywhere under ``hhmm_tpu/``, in ``bench.py``
-   / ``bench_zoo.py``, or under ``scripts/`` — durations must come
-   from the monotonic ``time.perf_counter()`` (directly or via the
-   `hhmm_tpu/obs/trace.py` helpers); a wall-clock step (NTP slew,
-   suspend/resume) under ``time.time()`` silently corrupts every
-   throughput record built on it — and the ``scripts/tpu_*_probe.py``
-   timings feed the measured crossover table `kernels/dispatch.py`
-   bets real decode throughput on, so skew there corrupts dispatch
-   decisions, not just records. (b) Every serve/bench module that
-   creates a ``jax.jit`` entry point (``hhmm_tpu/serve/*.py``,
-   ``bench.py``, ``bench_zoo.py``) must import a registration hook
-   from ``hhmm_tpu.obs.telemetry`` and call it — otherwise run
-   manifests lose per-entry-point compile attribution and the
-   no-recompile audits go dark for that module.
-6. **One metrics plane** (`hhmm_tpu/obs/metrics.py`): every module
-   emitting health metrics goes through the shared registry — no
-   private ``MetricsRegistry()`` instances outside ``obs/metrics.py``
-   (a second registry forks the sink: its counters never reach the
-   exports, manifests, or `scripts/obs_report.py`), no ad-hoc
-   module-level count dicts, and any call to a bare
-   ``counter``/``gauge``/``histogram`` name must be bound from the
-   metrics module, not a local shadow.
-7. **One placement substrate** (`hhmm_tpu/plan/`, `docs/sharding.md`):
-   no ``Mesh`` / ``NamedSharding`` / ``PartitionSpec`` construction
-   anywhere outside ``hhmm_tpu/plan/`` and the ``core/compat.py``
-   shims — covering the package, ``bench.py`` / ``bench_zoo.py``,
-   ``__graft_entry__.py``, and ``scripts/``. Before the planner,
-   `batch/fit.py` and `serve/scheduler.py` each hand-rolled their own
-   layout; a new callsite constructing placement objects directly
-   would re-fragment the decision the planner exists to centralize
-   (and its layout would be invisible to the manifest ``plan``
-   stanza). Consumers take a ``Plan`` (or a caller mesh wrapped via
-   ``plan_for_mesh``); kernel shard_map bodies describe specs through
-   ``core.compat.pspec``.
-8. **Serve hot paths degrade, never raise per-series**
-   (`docs/serving.md` "Overload & failure modes"): in
-   ``hhmm_tpu/serve/scheduler.py``, the hot-path entry points
-   (``tick`` / ``flush`` / ``submit`` / ``attach*``) (a) contain no
-   bare re-``raise`` — catching a per-series dispatch failure and
-   re-propagating it is exactly the overload behavior the shed path
-   exists to prevent — and (b) every ``self._dispatch(...)`` call
-   inside them sits under a ``try`` whose handler catches ``Exception``
-   (degrading the group into shed responses). A refactor that unwraps
-   the dispatch would let one malformed observation (or a device loss)
-   take down every other series' flush.
-9. **One timing harness** (`hhmm_tpu/obs/profile.py`,
-   `docs/observability.md` "kernel cost plane"): no raw
-   ``perf_counter``-around-``block_until_ready`` timing loop anywhere
-   under ``hhmm_tpu/`` outside ``obs/profile.py`` — the shape
-   ``t0 = perf_counter(); for ...: block_until_ready(...); dt =
-   perf_counter() - t0``. Every such loop re-derives the
-   warmup/compile split, fresh-input, and order-statistic discipline
-   by hand; device timings must come from ``obs.profile.device_time``
-   so their numbers are comparable with the kernel cost DB rows
-   dispatch bets on. Per-iteration clock reads inside the loop (phase
-   *attribution*, e.g. `apps/tayal/wf.py`'s decode sub-profile) are
-   fine — the flag is specifically a clocked batch of synced calls
-   with no clock read per call. ``bench.py`` and the
-   ``scripts/tpu_*_probe.py`` drivers are exempt (their timed loops
-   are the measurement products themselves, and the probes now route
-   through the harness anyway — migrated where trivial).
-10. **Serve-layer clocks route through the request plane**
-   (`hhmm_tpu/obs/request.py`, `docs/observability.md` "request
-   plane"): no raw ``perf_counter`` read anywhere under
-   ``hhmm_tpu/serve/`` — neither the bare imported name nor the
-   ``time.perf_counter()`` / ``trace.perf_counter()`` attribute
-   spelling. The serve hot paths used to sprinkle ad-hoc
-   ``perf_counter`` deltas (one end-to-end stamp per tick); those all
-   migrated into the per-tick lifecycle recorder
-   (``TickTrace``/``RequestRecorder``), whose stamps decompose latency
-   into queue/batch-formation/device/post-process shares per tenant. A
-   new raw read in the serve layer would be a timing the request plane
-   cannot see — route it through ``obs_request.now`` or a recorder
-   stage stamp instead.
+This shim preserves the legacy contract exactly, so the tier-1 wiring
+(tests/test_robust.py, test_serve.py, test_assoc.py, test_obs.py,
+test_plan.py, test_profile.py, test_request.py) is untouched:
 
-Exit 0 when clean, 1 with one line per violation. Run by
-``tests/test_robust.py`` (and re-asserted by ``tests/test_serve.py``,
-``tests/test_assoc.py``, and ``tests/test_obs.py``) so the pass is
-enforced in tier-1.
+- ``python scripts/check_guards.py [root]`` — scan ``root`` (default:
+  the repo), print one ``file[:line]: message`` line per violation.
+- Exit 0 when clean (with the legacy ok summary line), 1 with a
+  ``check_guards: N violation(s)`` tail otherwise.
+
+New-rule ERROR findings print in the same stream (the legacy contract
+is "N violation(s) == N printed lines", so warning-severity findings —
+which never fail — stay out of this script entirely); suppressions
+(inline ``# lint: ok <rule-id>`` pragmas and
+``hhmm_tpu/analysis/allowlist.txt`` entries, both audited with
+rationales) are honored. For warnings, per-finding rule ids, JSON
+output, rule selection, and the rule catalog use the real CLI::
+
+    python -m hhmm_tpu.analysis --format json hhmm_tpu/
+    python -m hhmm_tpu.analysis --list-rules
+
+``scripts/lint.py`` (or ``make lint``) is the pre-commit spelling.
 """
 
 from __future__ import annotations
 
-import ast
 import pathlib
-import re
 import sys
 from typing import List
 
-# sampler entry modules -> guard functions at least one of which must be
-# both imported from hhmm_tpu.robust.guards and called
-SAMPLER_MODULES = {
-    "hhmm_tpu/infer/run.py": ("guard_update", "guard_where"),
-    "hhmm_tpu/infer/chees.py": ("guard_update", "guard_where"),
-    "hhmm_tpu/infer/gibbs.py": ("guard_update", "guard_where"),
-}
-GUARDS_MODULE = "hhmm_tpu.robust.guards"
+_REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
 
-# serving modules -> guard functions that must be imported from the
-# named source modules AND called (invariant 3 in the module docstring)
-SERVE_MODULES = {
-    "hhmm_tpu/serve/online.py": ("safe_log_normalize",),
-}
-LMATH_MODULES = ("hhmm_tpu.core.lmath", "hhmm_tpu.core")
+from hhmm_tpu.analysis import run_analysis  # noqa: E402
 
-# time-parallel kernel modules: every semiring combine must be the
-# guarded reduction (invariant 4 in the module docstring)
-SEMIRING_MODULES = (
-    "hhmm_tpu/kernels/semiring.py",
-    "hhmm_tpu/kernels/assoc.py",
+_OK_LINE = (
+    "check_guards: ok (no bare excepts; all samplers guarded; "
+    "online serve step guarded; semiring combines guarded; "
+    "monotonic clocks only; serve/bench jits telemetry-registered; "
+    "one shared metrics plane; placement objects confined to the "
+    "planner; serve hot paths degrade, never raise; timing loops "
+    "confined to the obs/profile.py harness; serve-layer clocks "
+    "confined to the obs/request.py plane; purity/PRNG/dtype/layering "
+    "rule families clean — engine: hhmm_tpu.analysis)"
 )
-# attribute names whose access anywhere in a semiring module means a
-# raw (unguarded) log-space reduction slipped in
-RAW_LSE_ATTRS = ("logaddexp", "logsumexp")
-# lmath helpers that WRAP the raw reduction (NaN cotangents on the
-# all-(−inf) columns the −inf semiring identities create) — importing
-# them into a semiring module is the loophole the attribute scan above
-# cannot see
-RAW_LSE_WRAPPERS = ("logsumexp", "log_vecmat", "log_matvec", "log_normalize")
-
-# invariant 5b: registration hooks a jax.jit-creating serve/bench module
-# must import from the telemetry module and call. Only register_jit
-# counts: install_listeners alone turns on the global compile listener
-# without attributing any entry point, so accepting it would let a
-# module's jits stay invisible to jit_cache_sizes()/run manifests —
-# exactly the condition the invariant exists to prevent.
-TELEMETRY_MODULES = ("hhmm_tpu.obs.telemetry", "hhmm_tpu.obs")
-TELEMETRY_HOOKS = ("register_jit",)
-
-# invariant 6: the shared statistical-health plane. Bare-name calls to
-# these must be bound from the metrics module; a private registry or a
-# module-level count dict forks the sink.
-METRICS_MODULES = ("hhmm_tpu.obs.metrics", "hhmm_tpu.obs")
-METRIC_FNS = ("counter", "gauge", "histogram")
-AD_HOC_COUNT_RE = re.compile(r"(^|_)(counts?|counters?)$")
-
-# invariant 7: placement-object constructors confined to the planner
-# (and the core/compat.py shims) — any other construction site is a
-# placement decision the planner cannot see or record
-SHARDING_CTORS = ("Mesh", "NamedSharding", "PartitionSpec")
-PLACEMENT_ALLOWED_PREFIXES = ("hhmm_tpu/plan/",)
-PLACEMENT_ALLOWED_FILES = ("hhmm_tpu/core/compat.py",)
-
-# invariant 8: the scheduler's hot-path entry points and the guarded
-# per-group dispatch call they must wrap
-SERVE_HOT_PATH_FILE = "hhmm_tpu/serve/scheduler.py"
-HOT_PATH_METHOD_RE = re.compile(r"^(tick|flush|submit|attach\w*)$")
-HOT_PATH_DISPATCH_ATTR = "_dispatch"
-
-# invariant 9: raw timing loops confined to the profiling harness —
-# the one module allowed to clock a batch of synced device calls
-TIMING_HARNESS_FILE = "hhmm_tpu/obs/profile.py"
-
-# invariant 10: the serve layer reads no raw clocks — every timing
-# read under hhmm_tpu/serve/ routes through the request plane
-# (hhmm_tpu/obs/request.py: `now` or a lifecycle recorder stamp)
-SERVE_DIR_PREFIX = "hhmm_tpu/serve/"
-
-
-def _bare_excepts(tree: ast.Module, rel: str, problems: List[str]) -> None:
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler) and node.type is None:
-            problems.append(f"{rel}:{node.lineno}: bare `except:` (name the exception types)")
-
-
-def _imported_symbols(tree: ast.Module, modules) -> set:
-    """Names bound from ``from <module> import ...`` for any of
-    ``modules`` (package re-exports count too)."""
-    names = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom) and node.module in modules:
-            for alias in node.names:
-                names.add(alias.asname or alias.name)
-    return names
-
-
-def _called_names(tree: ast.Module) -> set:
-    calls = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
-            calls.add(node.func.id)
-    return calls
-
-
-def _check_raw_time(tree: ast.Module, rel: str, problems: List[str]) -> None:
-    """Invariant 5a: flag every ``<time-module-alias>.time()`` call and
-    every ``from time import time`` binding. ``perf_counter`` /
-    ``monotonic`` reads (and the `obs/trace.py` helpers built on them)
-    are the sanctioned clocks."""
-    aliases = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for alias in node.names:
-                if alias.name == "time":
-                    aliases.add(alias.asname or alias.name)
-        elif isinstance(node, ast.ImportFrom) and node.module == "time":
-            for alias in node.names:
-                if alias.name == "time":
-                    problems.append(
-                        f"{rel}:{node.lineno}: imports raw `time.time` — "
-                        "use time.perf_counter (or hhmm_tpu.obs.trace)"
-                    )
-    if not aliases:
-        return
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Attribute)
-            and node.func.attr == "time"
-            and isinstance(node.func.value, ast.Name)
-            and node.func.value.id in aliases
-        ):
-            problems.append(
-                f"{rel}:{node.lineno}: raw `{node.func.value.id}.time()` "
-                "timing read — wall-clock steps corrupt throughput "
-                "records; use time.perf_counter (or hhmm_tpu.obs.trace)"
-            )
-
-
-_JIT_MAKERS = ("jit", "pjit", "pmap")
-
-
-def _uses_jax_jit(tree: ast.Module) -> bool:
-    """True when the module creates jit entry points — either the
-    attribute form (``jax.jit``/``jax.pjit``/``jax.pmap``) or names
-    imported from jax (``from jax import jit``); both spellings must
-    trip invariant 5b or the check is trivially evaded."""
-    jitted_names = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom) and node.module in (
-            "jax",
-            "jax.experimental.pjit",
-        ):
-            for alias in node.names:
-                if alias.name in _JIT_MAKERS:
-                    jitted_names.add(alias.asname or alias.name)
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.Attribute)
-            and node.attr in _JIT_MAKERS
-            and isinstance(node.value, ast.Name)
-            and node.value.id == "jax"
-        ):
-            return True
-        if (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Name)
-            and node.func.id in jitted_names
-        ):
-            return True
-    return False
-
-
-def _check_telemetry_registration(
-    tree: ast.Module, rel: str, problems: List[str]
-) -> None:
-    """Invariant 5b: a serve/bench module creating jax.jit entry points
-    must import a telemetry hook (directly or via the telemetry module)
-    and call it."""
-    if not _uses_jax_jit(tree):
-        return
-    direct = _imported_symbols(tree, TELEMETRY_MODULES) & set(TELEMETRY_HOOKS)
-    module_aliases = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom) and node.module == "hhmm_tpu.obs":
-            for alias in node.names:
-                if alias.name == "telemetry":
-                    module_aliases.add(alias.asname or alias.name)
-        elif isinstance(node, ast.Import):
-            for alias in node.names:
-                if alias.name == "hhmm_tpu.obs.telemetry":
-                    module_aliases.add(
-                        alias.asname or "hhmm_tpu.obs.telemetry"
-                    )
-    called = bool(direct & _called_names(tree))
-    if not called and module_aliases:
-        for node in ast.walk(tree):
-            if (
-                isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Attribute)
-                and node.func.attr in TELEMETRY_HOOKS
-                and isinstance(node.func.value, ast.Name)
-                and node.func.value.id in module_aliases
-            ):
-                called = True
-                break
-    if not (direct or module_aliases):
-        problems.append(
-            f"{rel}: creates jax.jit entry points but never imports a "
-            f"telemetry hook from {TELEMETRY_MODULES[0]} (expected one "
-            f"of {TELEMETRY_HOOKS}) — compile counts would be "
-            "unattributable in run manifests"
-        )
-    elif not called:
-        problems.append(
-            f"{rel}: imports telemetry but never calls a registration "
-            f"hook ({TELEMETRY_HOOKS}) — jit entry points are "
-            "unregistered"
-        )
-
-
-def _check_metrics_discipline(
-    tree: ast.Module, rel: str, problems: List[str]
-) -> None:
-    """Invariant 6: one shared metrics plane. (a) no private
-    ``MetricsRegistry()`` outside ``obs/metrics.py``; (b) bare-name
-    ``counter``/``gauge``/``histogram`` calls must be bound from the
-    metrics module (a local shadow is an ad-hoc sink); (c) no
-    module-level count-dict stores (``foo_counts = {}``) — counts that
-    bypass the registry never reach the exports or obs_report."""
-    if rel.replace("\\", "/") == "hhmm_tpu/obs/metrics.py":
-        return
-    imported = _imported_symbols(tree, METRICS_MODULES)
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call):
-            fn = node.func
-            if (isinstance(fn, ast.Name) and fn.id == "MetricsRegistry") or (
-                isinstance(fn, ast.Attribute) and fn.attr == "MetricsRegistry"
-            ):
-                problems.append(
-                    f"{rel}:{node.lineno}: instantiates a private "
-                    "MetricsRegistry — a second registry forks the metrics "
-                    "sink; use the shared hhmm_tpu.obs.metrics registry"
-                )
-            elif (
-                isinstance(fn, ast.Name)
-                and fn.id in METRIC_FNS
-                and fn.id not in imported
-            ):
-                problems.append(
-                    f"{rel}:{node.lineno}: calls bare `{fn.id}(...)` not "
-                    "imported from hhmm_tpu.obs.metrics — ad-hoc metric "
-                    "sinks never reach the exports/manifests/obs_report"
-                )
-    # (c) module-level count-dict assignments only (function-local
-    # working dicts are algorithm state, not a metrics sink)
-    for node in tree.body:
-        targets = []
-        if isinstance(node, ast.Assign):
-            targets = node.targets
-            value = node.value
-        elif isinstance(node, ast.AnnAssign) and node.value is not None:
-            targets = [node.target]
-            value = node.value
-        else:
-            continue
-        is_dictish = isinstance(value, (ast.Dict, ast.DictComp)) or (
-            isinstance(value, ast.Call)
-            and isinstance(value.func, ast.Name)
-            and value.func.id in ("dict", "defaultdict")
-        )
-        if not is_dictish:
-            continue
-        for t in targets:
-            if isinstance(t, ast.Name) and AD_HOC_COUNT_RE.search(t.id):
-                problems.append(
-                    f"{rel}:{node.lineno}: module-level count store "
-                    f"`{t.id}` — route counts through the shared "
-                    "hhmm_tpu.obs.metrics registry"
-                )
-
-
-def _check_placement_confinement(
-    tree: ast.Module, rel: str, problems: List[str]
-) -> None:
-    """Invariant 7: flag every ``Mesh``/``NamedSharding``/
-    ``PartitionSpec`` constructor call outside the allowed modules —
-    both the bare-name spelling (``from jax.sharding import
-    PartitionSpec as P; P(...)``) and the attribute spelling
-    (``jax.sharding.Mesh(...)``)."""
-    rel_n = rel.replace("\\", "/")
-    if rel_n.startswith(PLACEMENT_ALLOWED_PREFIXES) or rel_n in PLACEMENT_ALLOWED_FILES:
-        return
-    aliases = {}
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom) and node.module == "jax.sharding":
-            for alias in node.names:
-                if alias.name in SHARDING_CTORS:
-                    aliases[alias.asname or alias.name] = alias.name
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        ctor = None
-        if isinstance(fn, ast.Name) and fn.id in aliases:
-            ctor = aliases[fn.id]
-        elif isinstance(fn, ast.Attribute) and fn.attr in SHARDING_CTORS:
-            ctor = fn.attr
-        if ctor is not None:
-            problems.append(
-                f"{rel}:{node.lineno}: constructs `{ctor}` outside "
-                "hhmm_tpu/plan/ — placement decisions belong to the "
-                "execution planner (take a Plan / plan_for_mesh, or the "
-                "core/compat.py pspec shim); see docs/sharding.md"
-            )
-
-
-def _handler_catches_exception(handler: ast.ExceptHandler) -> bool:
-    """True when the handler's type covers ``Exception`` (bare handlers
-    are already outlawed by invariant 1; BaseException would swallow
-    KeyboardInterrupt and is not accepted as a degrade handler)."""
-    t = handler.type
-    names = []
-    if isinstance(t, ast.Name):
-        names = [t.id]
-    elif isinstance(t, ast.Tuple):
-        names = [e.id for e in t.elts if isinstance(e, ast.Name)]
-    return "Exception" in names
-
-
-def _check_serve_hot_path(tree: ast.Module, rel: str, problems: List[str]) -> None:
-    """Invariant 8: hot-path entry points (tick/flush/submit/attach*)
-    in the scheduler (a) never bare-``raise`` (re-propagating a caught
-    per-series failure) and (b) keep every ``self._dispatch(...)`` call
-    under a try/except-``Exception`` degrade handler."""
-    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
-        for fn in [
-            n
-            for n in cls.body
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-            and HOT_PATH_METHOD_RE.match(n.name)
-        ]:
-            guarded_spans: List[Tuple[int, int]] = []
-            for node in ast.walk(fn):
-                if isinstance(node, ast.Raise) and node.exc is None:
-                    problems.append(
-                        f"{rel}:{node.lineno}: bare `raise` in serve hot path "
-                        f"`{fn.name}` — per-series failures must degrade "
-                        "into shed TickResponses, not propagate "
-                        "(docs/serving.md overload ladder)"
-                    )
-                if isinstance(node, ast.Try) and any(
-                    _handler_catches_exception(h) for h in node.handlers
-                ):
-                    lo = min(s.lineno for s in node.body)
-                    hi = max(
-                        getattr(s, "end_lineno", s.lineno) for s in node.body
-                    )
-                    guarded_spans.append((lo, hi))
-            for node in ast.walk(fn):
-                if (
-                    isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
-                    and node.func.attr == HOT_PATH_DISPATCH_ATTR
-                ):
-                    if not any(
-                        lo <= node.lineno <= hi for lo, hi in guarded_spans
-                    ):
-                        problems.append(
-                            f"{rel}:{node.lineno}: `{HOT_PATH_DISPATCH_ATTR}` "
-                            f"call in serve hot path `{fn.name}` outside a "
-                            "try/except-Exception degrade handler — one "
-                            "malformed observation or device loss would "
-                            "fail every series in the flush"
-                        )
-
-
-def _perf_counter_names(tree: ast.Module) -> set:
-    """Bare names bound to ``perf_counter`` (``from time import
-    perf_counter``, ``from hhmm_tpu.obs.trace import perf_counter``,
-    any alias) — the attribute spelling is matched structurally."""
-    names = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ImportFrom):
-            for alias in node.names:
-                if alias.name == "perf_counter":
-                    names.add(alias.asname or alias.name)
-    return names
-
-
-def _is_perf_counter_call(node: ast.AST, pc_names: set) -> bool:
-    if not isinstance(node, ast.Call):
-        return False
-    fn = node.func
-    if isinstance(fn, ast.Name) and fn.id in pc_names:
-        return True
-    return isinstance(fn, ast.Attribute) and fn.attr == "perf_counter"
-
-
-def _is_block_until_ready_call(node: ast.AST) -> bool:
-    if not isinstance(node, ast.Call):
-        return False
-    fn = node.func
-    if isinstance(fn, ast.Name) and fn.id == "block_until_ready":
-        return True
-    return isinstance(fn, ast.Attribute) and fn.attr == "block_until_ready"
-
-
-def _own_scope_nodes(node: ast.AST) -> List[ast.AST]:
-    """All descendants of ``node`` EXCLUDING nested function bodies —
-    a nested def is its own timing scope (it is analyzed as its own
-    function), so its clock reads and loops must not bleed into the
-    enclosing function's line-number bracketing."""
-    out: List[ast.AST] = []
-    stack = list(ast.iter_child_nodes(node))
-    while stack:
-        n = stack.pop()
-        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
-            continue
-        out.append(n)
-        stack.extend(ast.iter_child_nodes(n))
-    return out
-
-
-def _check_timing_harness(tree: ast.Module, rel: str, problems: List[str]) -> None:
-    """Invariant 9: flag every ``For``/``While`` loop that (a) syncs
-    device work (``block_until_ready`` in its body), (b) reads no clock
-    per iteration (so it is a timed BATCH, not per-call attribution),
-    and (c) sits between a ``perf_counter`` read before it and one
-    after it in the same function scope — the hand-rolled
-    timing-harness shape that belongs in ``obs.profile.device_time``.
-    Each function is analyzed over its OWN scope only (nested defs are
-    separate scopes), so a loop is neither double-reported through its
-    enclosing function nor bracketed by clock reads that never time
-    it."""
-    if rel.replace("\\", "/") == TIMING_HARNESS_FILE:
-        return
-    pc_names = _perf_counter_names(tree)
-    fns = [
-        n
-        for n in ast.walk(tree)
-        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-    ]
-    for fn in fns:
-        own = _own_scope_nodes(fn)
-        pc_lines = [
-            n.lineno for n in own if _is_perf_counter_call(n, pc_names)
-        ]
-        if len(pc_lines) < 2:
-            continue
-        for loop in own:
-            if not isinstance(loop, (ast.For, ast.While)):
-                continue
-            body_nodes = [
-                n for s in loop.body for n in [s, *_own_scope_nodes(s)]
-            ]
-            if not any(_is_block_until_ready_call(n) for n in body_nodes):
-                continue
-            if any(_is_perf_counter_call(n, pc_names) for n in body_nodes):
-                continue  # per-iteration clock read: attribution, fine
-            end = getattr(loop, "end_lineno", loop.lineno)
-            if any(l < loop.lineno for l in pc_lines) and any(
-                l > end for l in pc_lines
-            ):
-                problems.append(
-                    f"{rel}:{loop.lineno}: raw perf_counter-around-"
-                    "block_until_ready timing loop — device timings "
-                    "must go through hhmm_tpu.obs.profile.device_time "
-                    "(the one harness with the warmup/compile split and "
-                    "order-statistic discipline; see "
-                    "docs/observability.md kernel cost plane)"
-                )
-
-
-def _check_serve_clock_confinement(
-    tree: ast.Module, rel: str, problems: List[str]
-) -> None:
-    """Invariant 10: flag every ``perf_counter`` call under
-    ``hhmm_tpu/serve/`` — the bare imported name and the attribute
-    spelling both. The serve layer's clock reads belong to the
-    request-plane lifecycle recorder (`hhmm_tpu/obs/request.py`), where
-    per-tick stamps stay decomposable and tenant-attributable."""
-    if not rel.replace("\\", "/").startswith(SERVE_DIR_PREFIX):
-        return
-    pc_names = _perf_counter_names(tree)
-    for node in ast.walk(tree):
-        if _is_perf_counter_call(node, pc_names):
-            problems.append(
-                f"{rel}:{node.lineno}: raw `perf_counter` read in the "
-                "serve layer — per-tick timing must route through the "
-                "request-plane lifecycle recorder (hhmm_tpu.obs.request "
-                "`now`/stage stamps; see docs/observability.md request "
-                "plane)"
-            )
-
-
-def check(root: pathlib.Path) -> List[str]:
-    problems: List[str] = []
-    pkg = root / "hhmm_tpu"
-    if not pkg.is_dir():
-        return [f"{root}: no hhmm_tpu/ package to check"]
-    # one parse per package file, shared by every tree-walking invariant
-    serve_dir = pkg / "serve"
-    for py in sorted(pkg.rglob("*.py")):
-        rel = str(py.relative_to(root))
-        tree = ast.parse(py.read_text(), filename=str(py))
-        _bare_excepts(tree, rel, problems)
-        # invariant 5a: monotonic clocks only, package-wide
-        _check_raw_time(tree, rel, problems)
-        # invariant 6: one shared metrics plane, package-wide
-        _check_metrics_discipline(tree, rel, problems)
-        # invariant 7: placement objects only from the planner
-        _check_placement_confinement(tree, rel, problems)
-        # invariant 9: timing loops confined to the profiling harness
-        _check_timing_harness(tree, rel, problems)
-        # invariant 10: serve-layer clocks confined to the request plane
-        _check_serve_clock_confinement(tree, rel, problems)
-        # invariant 5b over the serving layer: every module with a
-        # jax.jit entry point registers it with the telemetry registry
-        if py.parent == serve_dir:
-            _check_telemetry_registration(tree, rel, problems)
-        # invariant 8: scheduler hot paths degrade, never raise
-        if rel.replace("\\", "/") == SERVE_HOT_PATH_FILE:
-            _check_serve_hot_path(tree, rel, problems)
-    for bench_name in ("bench.py", "bench_zoo.py"):
-        bench = root / bench_name
-        if bench.is_file():
-            btree = ast.parse(bench.read_text(), filename=str(bench))
-            _check_raw_time(btree, bench_name, problems)
-            _check_telemetry_registration(btree, bench_name, problems)
-            _check_metrics_discipline(btree, bench_name, problems)
-            _check_placement_confinement(btree, bench_name, problems)
-    # __graft_entry__ hand-rolled the dryrun meshes before the planner;
-    # invariant 7 keeps it a thin driver (5b does not apply: its jits
-    # are one-shot dry-run probes, not serving entry points)
-    graft = root / "__graft_entry__.py"
-    if graft.is_file():
-        gtree = ast.parse(graft.read_text(), filename=str(graft))
-        _check_raw_time(gtree, "__graft_entry__.py", problems)
-        _check_placement_confinement(gtree, "__graft_entry__.py", problems)
-    # invariant 5a over scripts/: the tpu_*_probe timings feed the
-    # measured crossover table kernels/dispatch.py dispatches on — a
-    # wall-clock step there corrupts dispatch decisions silently
-    # (invariant 7 rides along: a probe constructing its own mesh would
-    # measure a layout the planner never dispatches)
-    scripts_dir = root / "scripts"
-    if scripts_dir.is_dir():
-        for py in sorted(scripts_dir.glob("*.py")):
-            stree = ast.parse(py.read_text(), filename=str(py))
-            _check_raw_time(stree, f"scripts/{py.name}", problems)
-            _check_placement_confinement(stree, f"scripts/{py.name}", problems)
-
-    def check_guarded(spec, source_modules, kind, noun, what):
-        for rel, guard_fns in sorted(spec.items()):
-            path = root / rel
-            if not path.is_file():
-                problems.append(f"{rel}: {kind} module missing")
-                continue
-            tree = ast.parse(path.read_text(), filename=str(path))
-            imported = _imported_symbols(tree, source_modules) & set(guard_fns)
-            if not imported:
-                problems.append(
-                    f"{rel}: does not import a {noun} from {source_modules[0]} "
-                    f"(expected one of {guard_fns})"
-                )
-                continue
-            if not (imported & _called_names(tree)):
-                problems.append(
-                    f"{rel}: imports {sorted(imported)} but never calls it — "
-                    f"{what}"
-                )
-
-    check_guarded(
-        SAMPLER_MODULES,
-        (GUARDS_MODULE, "hhmm_tpu.robust"),
-        "sampler",
-        "chain-health guard",
-        "transitions are unguarded",
-    )
-    check_guarded(
-        SERVE_MODULES,
-        LMATH_MODULES,
-        "serving",
-        "guarded normalization",
-        "the online step is unguarded",
-    )
-
-    # invariant 4: semiring combines use the guarded logsumexp only
-    for rel in SEMIRING_MODULES:
-        path = root / rel
-        if not path.is_file():
-            problems.append(f"{rel}: time-parallel kernel module missing")
-            continue
-        tree = ast.parse(path.read_text(), filename=str(path))
-        imported = _imported_symbols(tree, LMATH_MODULES)
-        if "safe_logsumexp" not in imported:
-            problems.append(
-                f"{rel}: does not import safe_logsumexp from "
-                f"{LMATH_MODULES[0]} — semiring combines would be unguarded"
-            )
-        elif "safe_logsumexp" not in _called_names(tree):
-            problems.append(
-                f"{rel}: imports safe_logsumexp but never calls it — "
-                "semiring combines are unguarded"
-            )
-        for node in ast.walk(tree):
-            if (
-                isinstance(node, ast.Attribute)
-                and node.attr in RAW_LSE_ATTRS
-            ):
-                problems.append(
-                    f"{rel}:{node.lineno}: raw `.{node.attr}` — semiring "
-                    "combines must use the guarded safe_logsumexp from "
-                    "hhmm_tpu.core.lmath"
-                )
-            if isinstance(node, ast.ImportFrom):
-                for alias in node.names:
-                    if (
-                        alias.name in RAW_LSE_ATTRS
-                        and node.module not in LMATH_MODULES
-                    ) or (
-                        alias.name in RAW_LSE_WRAPPERS
-                        and node.module in LMATH_MODULES
-                    ):
-                        problems.append(
-                            f"{rel}:{node.lineno}: imports raw "
-                            f"`{alias.name}` from {node.module} — use "
-                            "safe_logsumexp from hhmm_tpu.core.lmath"
-                        )
-    return problems
 
 
 def main(argv: List[str]) -> int:
@@ -751,21 +64,22 @@ def main(argv: List[str]) -> int:
         if len(argv) > 1
         else pathlib.Path(__file__).resolve().parent.parent
     )
-    problems = check(root)
-    for p in problems:
-        print(p)
-    if problems:
-        print(f"check_guards: {len(problems)} violation(s)")
+    if not (root / "hhmm_tpu").is_dir():
+        print(f"{root}: no hhmm_tpu/ package to check")
+        print("check_guards: 1 violation(s)")
         return 1
-    print(
-        "check_guards: ok (no bare excepts; all samplers guarded; "
-        "online serve step guarded; semiring combines guarded; "
-        "monotonic clocks only; serve/bench jits telemetry-registered; "
-        "one shared metrics plane; placement objects confined to the "
-        "planner; serve hot paths degrade, never raise; timing loops "
-        "confined to the obs/profile.py harness; serve-layer clocks "
-        "confined to the obs/request.py plane)"
-    )
+    report = run_analysis(root=root)
+    # legacy line format (no rule-id bracket). ERROR findings only: the
+    # legacy contract is "N violation(s) == N printed lines", so
+    # warning-severity findings (which never fail) stay out of this
+    # stream entirely — `python -m hhmm_tpu.analysis` shows them.
+    errors = report.errors
+    for f in errors:
+        print(f.legacy_format())
+    if errors:
+        print(f"check_guards: {len(errors)} violation(s)")
+        return 1
+    print(_OK_LINE)
     return 0
 
 

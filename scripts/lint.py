@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Pre-commit lint entry point — `hhmm_tpu.analysis` over the full
+default scan set.
+
+Exactly `python -m hhmm_tpu.analysis` with the repo root pinned (so it
+works from any cwd and from a `.git/hooks/pre-commit` one-liner), plus
+`--changed` to scan only files the working tree touches::
+
+    python scripts/lint.py                 # full scan, text report
+    python scripts/lint.py --changed       # staged+unstaged .py files only
+    python scripts/lint.py --format json   # machine-readable
+    make lint                              # Makefile spelling
+
+Exit codes are the analyzer's: 0 clean, 1 findings, 2 config error.
+Pure `ast` — no jax import, safe on any host.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import subprocess
+import sys
+from typing import List
+
+_REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(_REPO) not in sys.path:
+    sys.path.insert(0, str(_REPO))
+
+from hhmm_tpu.analysis.__main__ import main as analysis_main  # noqa: E402
+
+
+def _changed_py_files() -> List[str]:
+    """Tracked .py files the working tree modifies (staged + unstaged)
+    plus untracked ones — the pre-commit scan set."""
+    out = subprocess.run(
+        ["git", "-C", str(_REPO), "status", "--porcelain"],
+        capture_output=True,
+        text=True,
+        check=True,
+    ).stdout
+    files = []
+    for line in out.splitlines():
+        path = line[3:].split(" -> ")[-1].strip().strip('"')
+        if path.endswith(".py") and (_REPO / path).is_file():
+            files.append(path)
+    return files
+
+
+def main(argv: List[str]) -> int:
+    args = list(argv[1:])
+    if "--changed" in args:
+        args.remove("--changed")
+        changed = _changed_py_files()
+        if not changed:
+            print("lint: no changed .py files")
+            return 0
+        args.extend(changed)
+    return analysis_main(["lint", "--root", str(_REPO), *args])
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -28,6 +28,10 @@ SLO attainment). This script folds all of it into one readable report:
                      observables (p99 spread, queue age, interleaving)
   == storm ==        the `bench.py --serve-storm` verdict: faults
                      injected/escaped + survival gates, fairness arms
+  == analysis ==     the `hhmm_tpu.analysis` static-analyzer verdict:
+                     per-rule finding/suppression counts and the
+                     zero-unsuppressed-findings assertion (embedded
+                     `analysis` stanza or `--analysis report.json`)
   == slo ==          per-check PASS/FAIL + overall attainment
 
 Inputs: the full manifest JSON (``bench.py --manifest-out`` /
@@ -514,6 +518,52 @@ def render_serving(metrics: Dict[str, Dict[str, Any]], out) -> None:
         print("  (no serving metrics in this run)", file=out)
 
 
+def render_analysis(analysis: Optional[Dict[str, Any]], out) -> None:
+    """The `hhmm_tpu.analysis` static-analyzer verdict (``--format
+    json`` report, embedded at manifest key ``analysis`` or passed via
+    ``--analysis``): per-rule finding/suppression counts and the
+    zero-unsuppressed-findings assertion tier-1 runs under."""
+    _section("analysis", out)
+    if not isinstance(analysis, dict):
+        print("  (no static-analysis report in this run)", file=out)
+        return
+    rules = analysis.get("rules") or {}
+    print(
+        f"  files: {_fmt(analysis.get('files_scanned'))}   "
+        f"rules: {len(rules)}   "
+        f"findings: {len(analysis.get('findings') or [])}   "
+        f"suppressed: {_fmt(analysis.get('suppressed_count'))}   "
+        f"allowlist: {_fmt(analysis.get('allowlist_entries'))}",
+        file=out,
+    )
+    rows = []
+    for rid, stats in sorted(rules.items()):
+        if not (stats.get("findings") or stats.get("suppressed")):
+            continue
+        rows.append(
+            (
+                rid,
+                _fmt(stats.get("severity")),
+                _fmt(stats.get("findings")),
+                _fmt(stats.get("suppressed")),
+            )
+        )
+    if rows:
+        _table(("rule", "severity", "findings", "suppressed"), rows, out)
+    for f in (analysis.get("findings") or [])[:20]:
+        loc = f"{f.get('file')}:{f.get('line')}" if f.get("line") else f.get("file")
+        print(f"  {loc}: [{f.get('rule_id')}] {f.get('message')}", file=out)
+    unused = analysis.get("allowlist_unused") or []
+    if unused:
+        print(f"  unused allowlist entries: {', '.join(map(str, unused))}", file=out)
+    clean = bool(analysis.get("ok"))
+    print(
+        "  verdict: "
+        + ("CLEAN (zero unsuppressed findings)" if clean else "FINDINGS"),
+        file=out,
+    )
+
+
 def render_slo(man: Dict[str, Any], out) -> bool:
     _section("slo", out)
     slo = man.get("slo")
@@ -541,7 +591,12 @@ def render_slo(man: Dict[str, Any], out) -> bool:
     return attained
 
 
-def render(man: Dict[str, Any], metrics: Dict[str, Dict[str, Any]], out) -> None:
+def render(
+    man: Dict[str, Any],
+    metrics: Dict[str, Dict[str, Any]],
+    out,
+    analysis: Optional[Dict[str, Any]] = None,
+) -> None:
     print("hhmm_tpu run report", file=out)
     render_run(man, out)
     render_spans(man, out)
@@ -553,6 +608,7 @@ def render(man: Dict[str, Any], metrics: Dict[str, Dict[str, Any]], out) -> None
     render_serving(metrics, out)
     render_request(man, out)
     render_storm(man, out)
+    render_analysis(analysis if analysis is not None else man.get("analysis"), out)
     render_slo(man, out)
 
 
@@ -565,6 +621,13 @@ def main(argv: List[str]) -> int:
         metavar="JSONL",
         help="metrics JSONL export to render instead of the manifest's "
         "embedded snapshot (MetricsRegistry.export_jsonl)",
+    )
+    ap.add_argument(
+        "--analysis",
+        default=None,
+        metavar="JSON",
+        help="hhmm_tpu.analysis --format json report to render instead "
+        "of the manifest's embedded `analysis` stanza",
     )
     args = ap.parse_args(argv[1:])
     try:
@@ -588,7 +651,18 @@ def main(argv: List[str]) -> int:
             return 2
     else:
         metrics = man.get("metrics") or {}
-    render(man, metrics, sys.stdout)
+    analysis: Optional[Dict[str, Any]] = None
+    if args.analysis is not None:
+        try:
+            with open(args.analysis) as f:
+                analysis = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(
+                f"obs_report: cannot read analysis report {args.analysis}: {e}",
+                file=sys.stderr,
+            )
+            return 2
+    render(man, metrics, sys.stdout, analysis=analysis)
     return 0
 
 
