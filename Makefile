@@ -5,10 +5,15 @@ PY ?= python
 
 .PHONY: lint guards test test-fast report
 
-# static analysis, full default scan (pure ast, no jax import; <10 s).
+# static analysis, full default scan (pure ast, no jax import; <10 s),
+# concurrency rules included, plus the findings ratchet: per-(rule,
+# file) counts may only shrink vs the checked-in baseline — a new
+# finding fails even at warning severity; after deliberately accepting
+# or fixing findings, re-baseline with
+#   $(PY) scripts/lint.py --baseline results/analysis_baseline.json --update-baseline
 # Pre-commit hook one-liner:  echo 'make -C "$(git rev-parse --show-toplevel)" lint' > .git/hooks/pre-commit
 lint:
-	$(PY) scripts/lint.py
+	$(PY) scripts/lint.py --baseline results/analysis_baseline.json
 
 # the legacy-contract spelling of the same pass (tier-1 runs this via
 # tests; kept for muscle memory)

@@ -46,6 +46,7 @@ from . import purity as _purity  # noqa: F401
 from . import prng as _prng  # noqa: F401
 from . import dtype as _dtype  # noqa: F401
 from . import layering as _layering  # noqa: F401
+from . import concurrency as _concurrency  # noqa: F401
 
 __all__ = [
     "AllowlistEntry",

@@ -186,3 +186,127 @@ def call_target_names(arg: ast.AST) -> List[str]:
     if isinstance(arg, ast.Attribute):
         return [arg.attr]
     return []
+
+
+# ---------------------------------------------------------------------------
+# dataflow support for the concurrency rule family (analysis/concurrency.py)
+
+
+def attr_chain(node: ast.AST) -> Optional[List[str]]:
+    """The dotted-name chain of a Name/Attribute expression —
+    ``self._lock`` → ``["self", "_lock"]``, ``jax.random.normal`` →
+    ``["jax", "random", "normal"]`` — or ``None`` when the expression
+    is not a pure chain (a call/subscript in the middle breaks it)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    parts.reverse()
+    return parts
+
+
+# threading constructors the concurrency rules model. Condition and
+# Semaphore are deliberately absent — the repo's discipline is plain
+# Lock/RLock plus thread-local state; anything fancier should stand out
+# in review, not be silently blessed by the analyzer.
+_THREADING_CTORS = ("Lock", "RLock", "local")
+
+
+def threading_ctor(node: ast.AST, threading_aliases: Set[str]) -> str:
+    """``"Lock"`` / ``"RLock"`` / ``"local"`` when ``node`` is a call
+    constructing one (``threading.Lock()``, aliased module, or a bare
+    imported name), else ``""``."""
+    if not isinstance(node, ast.Call):
+        return ""
+    f = node.func
+    if (
+        isinstance(f, ast.Attribute)
+        and f.attr in _THREADING_CTORS
+        and isinstance(f.value, ast.Name)
+        and f.value.id in threading_aliases
+    ):
+        return f.attr
+    if isinstance(f, ast.Name) and f.id in _THREADING_CTORS:
+        return f.id
+    return ""
+
+
+# container-mutating method names: a call ``<target>.append(...)`` etc.
+# mutates <target> in place. `get`/`items`/`copy` and friends are reads
+# and deliberately excluded.
+MUTATOR_METHODS = frozenset(
+    {
+        "append",
+        "appendleft",
+        "extend",
+        "insert",
+        "add",
+        "discard",
+        "remove",
+        "clear",
+        "update",
+        "setdefault",
+        "pop",
+        "popitem",
+        "popleft",
+        "move_to_end",
+        "sort",
+        "reverse",
+    }
+)
+
+
+def mutation_roots(node: ast.AST) -> List[Tuple[List[str], int]]:
+    """The (attr-chain, line) roots ``node`` mutates in place, for the
+    shared-state race lint:
+
+    - ``Assign``/``AnnAssign``/``AugAssign`` whose target is an
+      attribute chain (``self.x = ...``) or a subscript of one
+      (``self.x[k] = ...``, ``D[k] += 1``);
+    - ``Delete`` of either shape;
+    - mutator-method calls (:data:`MUTATOR_METHODS`) on a chain
+      (``self.x.append(v)``, ``CACHE.clear()``).
+
+    Bare-name rebinding (``x = ...``) is NOT a mutation — rebinding a
+    local is scope-private, and rebinding a module global via ``global``
+    swaps the object rather than mutating shared contents."""
+    out: List[Tuple[List[str], int]] = []
+
+    def chain_of_target(t: ast.AST) -> Optional[List[str]]:
+        if isinstance(t, ast.Subscript):
+            t = t.value
+        if isinstance(t, (ast.Attribute, ast.Name)):
+            c = attr_chain(t)
+            # a bare Name rebind is not a mutation; a bare Name
+            # SUBSCRIPT store is (handled by the Subscript unwrap)
+            return c
+        return None
+
+    def add_target(t: ast.AST, line: int) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for elt in t.elts:
+                add_target(elt, line)
+            return
+        sub = isinstance(t, ast.Subscript)
+        c = chain_of_target(t)
+        if c is not None and (len(c) > 1 or sub):
+            out.append((c, line))
+
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            add_target(t, node.lineno)
+    elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+        add_target(node.target, node.lineno)
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            add_target(t, node.lineno)
+    elif isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in MUTATOR_METHODS:
+            c = attr_chain(f.value)
+            if c is not None:
+                out.append((c, node.lineno))
+    return out

@@ -37,6 +37,7 @@ from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tupl
 __all__ = [
     "AllowlistEntry",
     "AllowlistError",
+    "BASELINE_VERSION",
     "DEFAULT_TARGETS",
     "Finding",
     "Module",
@@ -44,7 +45,10 @@ __all__ = [
     "Report",
     "Rule",
     "RULES",
+    "baseline_from_report",
+    "diff_baseline",
     "load_allowlist",
+    "load_baseline",
     "register",
     "run_analysis",
 ]
@@ -106,14 +110,53 @@ class Module:
         self.source = source
         self.tree = ast.parse(source, filename=str(path))
         self.pragmas = _parse_pragmas(source)
+        # statement-anchor map, built LAZILY on the first suppression
+        # probe of a pragma-carrying module — eagerly walking every
+        # tree cost more than the whole concurrency pass (profiled
+        # ~1.3 s/scan across 113 files, ~5 of which carry pragmas)
+        self._stmt_first: Optional[Dict[int, int]] = None
 
     def suppressed(self, rule_id: str, line: int) -> bool:
-        """True when ``line`` (or the line directly above it) carries a
-        ``# lint: ok`` pragma naming ``rule_id``."""
+        """True when the finding's line carries a ``# lint: ok`` pragma
+        naming ``rule_id`` — on the line itself, the line directly
+        above, or (for a finding anchored to a CONTINUATION line of a
+        multi-line statement) the statement's first line or the line
+        above that. Without the statement anchor, a pragma written
+        where humans write it (on the statement) silently fails to
+        suppress a finding whose AST node starts lines later."""
+        if not self.pragmas:
+            return False
         for ln in (line, line - 1):
             if rule_id in self.pragmas.get(ln, ()):
                 return True
+        if self._stmt_first is None:
+            self._stmt_first = _statement_first_lines(self.tree)
+        first = self._stmt_first.get(line)
+        if first is None or first == line:
+            return False
+        for ln in (first, first - 1):
+            if rule_id in self.pragmas.get(ln, ()):
+                return True
         return False
+
+
+def _statement_first_lines(tree: ast.AST) -> Dict[int, int]:
+    """line → first line of the INNERMOST statement covering it, for
+    every line inside a multi-line statement. ``ast.walk`` is BFS —
+    parents before children — so later (inner) statements overwrite
+    outer ones and the innermost anchor wins."""
+    out: Dict[int, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.stmt):
+            continue
+        end = getattr(node, "end_lineno", None) or node.lineno
+        # single-line statements map to themselves so an enclosing
+        # compound statement (a whole function body is one multi-line
+        # stmt) can never hijack their anchor — a pragma on a `def`
+        # line must not suppress findings across the body
+        for ln in range(node.lineno, end + 1):
+            out[ln] = node.lineno
+    return out
 
 
 def _parse_pragmas(source: str) -> Dict[int, Set[str]]:
@@ -142,6 +185,13 @@ class Project:
         self.root = pathlib.Path(root)
         self.modules = modules
         self._extra: Dict[str, Optional[Module]] = {}
+        # structured side-channel for rules that compute a whole-project
+        # artifact beyond findings (the lock-order DAG) — copied into
+        # Report.extras / the JSON report under "extras"
+        self.extras: Dict[str, object] = {}
+        # per-project analysis caches keyed by rule family (the
+        # concurrency rules share one package-wide index)
+        self.caches: Dict[str, object] = {}
 
     def iter_modules(self) -> Iterator[Module]:
         for rel in sorted(self.modules):
@@ -171,12 +221,16 @@ class Rule:
     - ``title``    — one-line summary for ``--list-rules``
     - ``doc``      — catalog paragraph (docs/static_analysis.md is the
       rendered form; keep the two in sync)
+    - ``family``   — rule-group key for per-family report counts;
+      defaults to the defining module's basename (``legacy``,
+      ``purity``, ``prng``, ``dtype``, ``layering``, ``concurrency``)
     """
 
     id: str = ""
     severity: str = "error"
     title: str = ""
     doc: str = ""
+    family: str = ""
 
     def check(self, project: Project) -> Iterable[Finding]:
         raise NotImplementedError
@@ -195,6 +249,8 @@ def register(cls):
         raise ValueError(f"rule {cls.__name__} has no id")
     if cls.id in RULES:
         raise ValueError(f"duplicate rule id {cls.id!r}")
+    if not cls.family:
+        cls.family = cls.__module__.rsplit(".", 1)[-1]
     RULES[cls.id] = cls()
     return cls
 
@@ -304,6 +360,7 @@ class Report:
     suppressed: List[Finding]  # pragma- or allowlist-suppressed
     allowlist: List[AllowlistEntry]
     rules_run: List[str]
+    extras: Dict[str, object] = field(default_factory=dict)
 
     @property
     def errors(self) -> List[Finding]:
@@ -314,18 +371,24 @@ class Report:
         return not self.errors
 
     def rule_table(self) -> Dict[str, Dict[str, object]]:
+        def fresh(rid: str, severity: str) -> Dict[str, object]:
+            rule = RULES.get(rid)
+            return {
+                "severity": rule.severity if rule else severity,
+                "family": rule.family if rule else "unknown",
+                "findings": 0,
+                "suppressed": 0,
+            }
+
         table: Dict[str, Dict[str, object]] = {}
         for rid in self.rules_run:
-            rule = RULES[rid]
-            table[rid] = {"severity": rule.severity, "findings": 0, "suppressed": 0}
+            table[rid] = fresh(rid, "error")
         for f in self.findings:
-            table.setdefault(
-                f.rule_id, {"severity": f.severity, "findings": 0, "suppressed": 0}
-            )["findings"] += 1
+            table.setdefault(f.rule_id, fresh(f.rule_id, f.severity))["findings"] += 1
         for f in self.suppressed:
-            table.setdefault(
-                f.rule_id, {"severity": f.severity, "findings": 0, "suppressed": 0}
-            )["suppressed"] += 1
+            table.setdefault(f.rule_id, fresh(f.rule_id, f.severity))[
+                "suppressed"
+            ] += 1
         return table
 
     def to_json(self) -> Dict[str, object]:
@@ -340,6 +403,7 @@ class Report:
             "allowlist_unused": [
                 f"{e.rule_id} {e.file}" for e in self.allowlist if not e.used
             ],
+            "extras": self.extras,
             "ok": self.ok,
         }
 
@@ -407,6 +471,7 @@ def run_analysis(
     raw: List[Finding] = list(parse_failures)
     for rid in selected:
         raw.extend(RULES[rid].check(project))
+    extras = dict(project.extras)
     # dedupe (a rule walking overlapping scopes may re-derive a site)
     raw = sorted(set(raw), key=lambda f: (f.file, f.line, f.rule_id, f.message))
 
@@ -430,4 +495,63 @@ def run_analysis(
         suppressed=suppressed,
         allowlist=entries,
         rules_run=selected,
+        extras=extras,
     )
+
+
+# ---------------------------------------------------------------------------
+# findings ratchet (the `bench_diff.py` discipline applied to lint):
+# a checked-in baseline records the accepted finding counts per
+# (rule, file); a scan may only ever SHRINK them. New findings fail,
+# fixed findings invite a baseline update — warnings can't silently
+# re-accumulate between PRs.
+
+BASELINE_VERSION = 1
+
+
+def baseline_from_report(report: Report) -> Dict[str, object]:
+    """JSON-ready baseline doc: per ``<rule-id> <file>`` unsuppressed
+    finding counts (warnings included — errors fail the scan anyway,
+    but a baseline taken mid-cleanup must round-trip)."""
+    counts: Dict[str, int] = {}
+    for f in report.findings:
+        key = f"{f.rule_id} {f.file}"
+        counts[key] = counts.get(key, 0) + 1
+    return {
+        "version": BASELINE_VERSION,
+        "counts": {k: counts[k] for k in sorted(counts)},
+    }
+
+
+def load_baseline(path: pathlib.Path) -> Dict[str, int]:
+    """The baseline's ``{key: count}`` table. Missing file → empty
+    (first run ratchets against zero); malformed → AllowlistError-class
+    config failure (exit 2 — a torn baseline must not fail open)."""
+    p = pathlib.Path(path)
+    if not p.is_file():
+        return {}
+    try:
+        doc = json.loads(p.read_text())
+        counts = doc["counts"]
+        return {str(k): int(v) for k, v in counts.items()}
+    except (ValueError, KeyError, TypeError) as e:
+        raise AllowlistError(f"{path}: malformed findings baseline ({e})") from e
+
+
+def diff_baseline(
+    report: Report, baseline: Dict[str, int]
+) -> Tuple[List[str], List[str]]:
+    """``(grown, shrunk)`` — human-readable lines for keys whose count
+    exceeds the baseline (ratchet FAILURE) and keys the scan improved
+    on (the baseline is stale; tighten it)."""
+    current = baseline_from_report(report)["counts"]
+    grown: List[str] = []
+    shrunk: List[str] = []
+    for key in sorted(set(current) | set(baseline)):
+        now = current.get(key, 0)  # type: ignore[union-attr]
+        then = baseline.get(key, 0)
+        if now > then:
+            grown.append(f"{key}: {then} -> {now}")
+        elif now < then:
+            shrunk.append(f"{key}: {then} -> {now}")
+    return grown, shrunk

@@ -6,6 +6,7 @@ probability → top-state mapping → ex-post bear/bull labeling → trading.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Sequence
 
@@ -73,8 +74,12 @@ def decode_states(model, samples: np.ndarray, data: Dict, n_thin: int = 100) -> 
 # model's static configuration, not object identity — drivers (e.g. the
 # walk-forward loop) construct a fresh model per window, and
 # config-equal models have identical generated semantics, so the cache
-# hits across windows and stays bounded.
+# hits across windows and stays bounded. Lock-guarded
+# (shared-state-race); the jax.jit construction happens OUTSIDE the
+# lock (held-lock-escape) and a raced insert resolves to ONE canonical
+# jitted callable via setdefault, so the trace cache never forks.
 _GEN_JIT_CACHE: Dict = {}
+_GEN_JIT_LOCK = threading.Lock()
 
 
 def _model_config_key(model):
@@ -97,13 +102,17 @@ def _model_config_key(model):
 
 def _generated_jit(model, keys):
     ck = (_model_config_key(model), keys)
-    if ck not in _GEN_JIT_CACHE:
+    with _GEN_JIT_LOCK:
+        fn = _GEN_JIT_CACHE.get(ck)
+    if fn is None:
 
         def f(s, *vals):
             return model.generated(s, dict(zip(keys, vals)))
 
-        _GEN_JIT_CACHE[ck] = jax.jit(f)
-    return _GEN_JIT_CACHE[ck]
+        fn = jax.jit(f)
+        with _GEN_JIT_LOCK:
+            fn = _GEN_JIT_CACHE.setdefault(ck, fn)
+    return fn
 
 
 @dataclass

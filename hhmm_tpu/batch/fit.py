@@ -30,6 +30,7 @@ Key design points:
 from __future__ import annotations
 
 import sys
+import threading
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -55,8 +56,11 @@ _RETRY_SLEEP_S = 15.0
 
 # one chunk-rounding warning per (requested, rounded) pair per process —
 # the rounding is deliberate planner behavior, not an anomaly worth a
-# line per chunk of every sweep
+# line per chunk of every sweep. Lock-guarded (shared-state-race): two
+# fits racing the warn-once check tear the set on free-threaded hosts;
+# the stderr write itself stays outside the lock.
 _CHUNK_ROUND_WARNED: set = set()
+_WARN_LOCK = threading.Lock()
 
 # bound on the (series × parameter) rows fed to the interim per-chunk
 # convergence estimators — a 512-series × 100-dim chunk must not pay a
@@ -303,15 +307,19 @@ def fit_batched(
         plan.note()
     mesh = plan.mesh
     chunk = plan.chunk
-    if chunk != plan.chunk_requested and (plan.chunk_requested, chunk) not in _CHUNK_ROUND_WARNED:
-        _CHUNK_ROUND_WARNED.add((plan.chunk_requested, chunk))
-        print(
-            f"# fit_batched: chunk_size {plan.chunk_requested} rounded up to "
-            f"{chunk} (multiple of mesh series axis {plan.series_ways}; "
-            "ragged tails pad by lane repeat with weight 0)",
-            file=sys.stderr,
-            flush=True,
-        )
+    if chunk != plan.chunk_requested:
+        with _WARN_LOCK:
+            first_warn = (plan.chunk_requested, chunk) not in _CHUNK_ROUND_WARNED
+            if first_warn:
+                _CHUNK_ROUND_WARNED.add((plan.chunk_requested, chunk))
+        if first_warn:
+            print(
+                f"# fit_batched: chunk_size {plan.chunk_requested} rounded up to "
+                f"{chunk} (multiple of mesh series axis {plan.series_ways}; "
+                "ragged tails pad by lane repeat with weight 0)",
+                file=sys.stderr,
+                flush=True,
+            )
 
     data_keys = list(data.keys())
 

@@ -124,8 +124,13 @@ def config_digest(*parts: Any) -> str:
 
 # per-process cache: the revision and dirty flag cannot change inside
 # one run, and `git status` costs real time on a large tree — a bench
-# sweep stamping every record must not pay it per record
+# sweep stamping every record must not pay it per record. Lock-guarded
+# (shared-state-race): a serving thread stamping a manifest while a
+# bench thread stamps a record must not tear the dict; the subprocess
+# itself runs OUTSIDE the lock (held-lock-escape) — a raced first call
+# pays git twice, first writer wins via setdefault.
 _GIT_CACHE: Dict[str, Optional[Dict[str, Any]]] = {}
+_GIT_LOCK = threading.Lock()
 
 
 def git_revision(root: Optional[str] = None) -> Optional[Dict[str, Any]]:
@@ -134,10 +139,12 @@ def git_revision(root: Optional[str] = None) -> Optional[Dict[str, Any]]:
     or the repo is unavailable — provenance is best-effort, never a
     crash. Cached per (process, root)."""
     cwd = root or os.path.dirname(os.path.dirname(os.path.dirname(__file__)))
-    if cwd in _GIT_CACHE:
-        return _GIT_CACHE[cwd]
-    _GIT_CACHE[cwd] = out = _git_revision_uncached(cwd)
-    return out
+    with _GIT_LOCK:
+        if cwd in _GIT_CACHE:
+            return _GIT_CACHE[cwd]
+    out = _git_revision_uncached(cwd)
+    with _GIT_LOCK:
+        return _GIT_CACHE.setdefault(cwd, out)
 
 
 def _git_revision_uncached(cwd: str) -> Optional[Dict[str, Any]]:

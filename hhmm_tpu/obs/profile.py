@@ -662,11 +662,19 @@ def _invalidate_winner_cache() -> None:
 
 def active_db() -> KernelCostDB:
     """The process-wide DB the dispatch layer consults — loaded lazily
-    from :func:`default_db_path` on first use."""
+    from :func:`default_db_path` on first use. The disk read happens
+    OUTSIDE ``_DB_LOCK`` (held-lock-escape: a dispatching thread must
+    never stall on another thread's cold file read); a raced first
+    touch loads twice and the first binder wins."""
     global _ACTIVE_DB
     with _DB_LOCK:
+        db = _ACTIVE_DB
+    if db is not None:
+        return db
+    fresh = KernelCostDB().load()
+    with _DB_LOCK:
         if _ACTIVE_DB is None:
-            _ACTIVE_DB = KernelCostDB().load()
+            _ACTIVE_DB = fresh
         return _ACTIVE_DB
 
 
@@ -709,18 +717,28 @@ def dispatch_winner(
     same lock every invalidation (:func:`set_db` / :func:`refresh` /
     row writes) clears under — so a concurrent rebind can never
     interleave between a stale compute and its cache write and pin the
-    pre-refresh answer; the hit path stays lock-free."""
-    global _ACTIVE_DB
+    pre-refresh answer; the hit path stays lock-free, and the lazy
+    first-touch disk read happens in :func:`active_db` BEFORE the lock
+    is taken (held-lock-escape — the locked region re-reads
+    ``_ACTIVE_DB`` so a rebind that won the race still governs)."""
     ck = (str(kernel), int(K), int(T), device_kind)
     w = _WINNER_CACHE.get(ck, _MISSING)
-    if w is _MISSING:
+    while w is _MISSING:
+        db = active_db()
         with _DB_LOCK:
             w = _WINNER_CACHE.get(ck, _MISSING)
-            if w is _MISSING:
-                if _ACTIVE_DB is None:
-                    _ACTIVE_DB = KernelCostDB().load()
-                w = _ACTIVE_DB.winner(kernel, K, T, device_kind)
-                _WINNER_CACHE[ck] = w
+            if w is not _MISSING:
+                break
+            if _ACTIVE_DB is None:
+                # a concurrent set_db(None) restored the default
+                # binding between our active_db() read and this lock:
+                # caching a winner computed from the pre-restore `db`
+                # would be exactly the stale pin this path exists to
+                # prevent — loop so active_db() re-binds and the
+                # answer comes from the post-restore DB
+                continue
+            w = _ACTIVE_DB.winner(kernel, K, T, device_kind)
+            _WINNER_CACHE[ck] = w
     if w is None:
         return None
     return w == "assoc"

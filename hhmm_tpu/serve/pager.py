@@ -40,6 +40,7 @@ embeds in its record.
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -89,9 +90,29 @@ def resolve_budget_bytes(
 
 
 class SnapshotPager:
-    """See module docstring. Not thread-safe by itself — it lives
-    inside the scheduler's (single-threaded) serving loop, exactly like
-    the scheduler's own tables."""
+    """See module docstring.
+
+    **Thread safety** (PR 12, clearing the runway for the async flush
+    pipeline): all residency state — the LRU table, byte accounting,
+    pins — is guarded by ``self._lock``, and the slow paths obey the
+    analyzer's concurrency rules (`docs/static_analysis.md`):
+
+    - registry ``.npz`` loads and the traffic-fault surface (which
+      injects *sleeps* and torn files) run OUTSIDE the lock
+      (held-lock-escape): a cold page-in must never stall every other
+      thread's hit path behind disk latency;
+    - the eviction listener (the scheduler's ``detach``) fires AFTER
+      the lock is released — it calls straight back into
+      :meth:`discard`, which under a held non-reentrant lock is a
+      guaranteed self-deadlock (exactly what ``lock-order`` flags);
+    - metric publication happens outside the lock so the pager's node
+      in the lock-order DAG stays a leaf.
+
+    Consistency contract under concurrency: because the listener fires
+    after residency is released, a racing ``touch`` may re-admit a
+    just-evicted name before its ``detach`` lands; the scheduler's
+    detach/attach paths are idempotent per series, so the race costs a
+    redundant cold re-attach, never a torn table."""
 
     def __init__(
         self,
@@ -107,6 +128,9 @@ class SnapshotPager:
             fraction=budget_fraction,
             fallback_bytes=fallback_budget_bytes,
         )
+        # guards every table below; see the class docstring for what
+        # deliberately happens OUTSIDE it
+        self._lock = threading.Lock()
         # name -> (snapshot, nbytes); insertion order IS the LRU order
         self._resident: "OrderedDict[str, Tuple[PosteriorSnapshot, int]]" = (
             OrderedDict()
@@ -154,14 +178,19 @@ class SnapshotPager:
         the scheduler's page-in path validates the attach first, so a
         rejected attach never leaks unattached residency or evicts an
         attached series on behalf of a snapshot that will not serve."""
-        entry = self._resident.get(name)
+        with self._lock:
+            entry = self._resident.get(name)
+            if entry is not None:
+                self._resident.move_to_end(name)
         if entry is not None:
-            self._resident.move_to_end(name)
             self._hits.inc()
             return entry[0]
         self._misses.inc()
-        # the traffic-fault surface: slow-load latency and torn-file
-        # corruption land here, exactly where cold storage would bite
+        # the traffic-fault surface: slow-load latency (an injected
+        # SLEEP) and torn-file corruption land here, exactly where cold
+        # storage would bite — and exactly why this path must not hold
+        # the lock: a 100 ms injected stall inside the critical section
+        # would serialize every concurrent hit behind it
         faults.snapshot_load_fault(self.registry.path(name))
         return self.registry.load(name)
 
@@ -181,45 +210,53 @@ class SnapshotPager:
         fresh fit) REPLACES the resident copy: serving a stale draw
         bank after a later eviction+touch would silently undo the
         refit."""
-        entry = self._resident.get(name)
-        if entry is not None and entry[0] is snap:
-            # the page-in path: touch() already loaded and accounted
-            # this very object
-            self._resident.move_to_end(name)
-            return
-        if entry is not None:
-            self._resident.pop(name)
-            self._resident_bytes -= entry[1]
-        self._admit(name, snap)
-
-    def _admit(self, name: str, snap: PosteriorSnapshot) -> None:
-        nbytes = snapshot_nbytes(snap)
+        nbytes = snapshot_nbytes(snap)  # np host read — outside the lock
+        with self._lock:
+            entry = self._resident.get(name)
+            if entry is not None and entry[0] is snap:
+                # the page-in path: touch() already loaded and
+                # accounted this very object
+                self._resident.move_to_end(name)
+                return
+            if entry is not None:
+                self._resident.pop(name)
+                self._resident_bytes -= entry[1]
+            reload = name in self._ever_resident
+            self._ever_resident.add(name)
+            self._resident[name] = (snap, nbytes)
+            self._resident_bytes += nbytes
+            victims, overrun = self._collect_victims_locked(exempt=name)
+            bytes_now = self._note_peak_locked()
         self._loads.inc()
-        if name in self._ever_resident:
+        if reload:
             self._reloads.inc()
-        self._ever_resident.add(name)
-        self._resident[name] = (snap, nbytes)
-        self._resident_bytes += nbytes
-        self._evict_over_budget(exempt=name)
-        self._note_resident()
+        self._publish(bytes_now, victims, overrun)
 
     # ---- pinning ----
 
     def pin(self, name: str) -> None:
         """Exempt ``name`` from eviction (a pending tick needs it)."""
-        self._pinned.add(name)
+        with self._lock:
+            self._pinned.add(name)
 
     def unpin(self, name: str) -> None:
-        self._pinned.discard(name)
+        with self._lock:
+            self._pinned.discard(name)
 
     # ---- eviction ----
 
-    def _evict_over_budget(self, exempt: Optional[str] = None) -> None:
-        """Evict LRU-first unpinned entries until the budget holds. The
-        just-admitted entry is exempt for this pass (it is needed right
-        now); if only pinned/exempt entries remain while still over
-        budget, the overrun is counted and allowed — shedding a tick to
-        save memory is the admission policy's call, not the pager's."""
+    def _collect_victims_locked(
+        self, exempt: Optional[str] = None
+    ) -> Tuple[List[str], bool]:
+        """Lock held. Pop LRU-first unpinned entries until the budget
+        holds; returns ``(victims, overrun)``. The just-admitted entry
+        is exempt for this pass (it is needed right now); if only
+        pinned/exempt entries remain while still over budget the
+        overrun is reported and allowed — shedding a tick to save
+        memory is the admission policy's call, not the pager's.
+        Listener dispatch and counters happen in :meth:`_publish`,
+        after the lock is released."""
+        victims: List[str] = []
         while self._resident_bytes > self.budget_bytes:
             victim = next(
                 (
@@ -230,17 +267,32 @@ class SnapshotPager:
                 None,
             )
             if victim is None:
-                self._budget_overruns.inc()
-                break
-            self._evict(victim)
+                return victims, True
+            _, nbytes = self._resident.pop(victim)
+            self._resident_bytes -= nbytes
+            victims.append(victim)
+        return victims, False
 
-    def _evict(self, name: str) -> None:
-        _, nbytes = self._resident.pop(name)
-        self._resident_bytes -= nbytes
-        self._evictions.inc()
-        self._note_resident()
-        if self._on_evict is not None:
-            self._on_evict(name)
+    def _note_peak_locked(self) -> int:
+        """Lock held. Track the peak and return the current bytes for
+        gauge publication outside the lock."""
+        if self._resident_bytes > self._peak_resident_bytes:
+            self._peak_resident_bytes = self._resident_bytes
+        return self._resident_bytes
+
+    def _publish(
+        self, bytes_now: int, victims: List[str], overrun: bool = False
+    ) -> None:
+        """Outside the lock: metric publication and the eviction
+        listener (the scheduler's ``detach`` — it re-enters
+        :meth:`discard`, which under a held lock would self-deadlock)."""
+        self._resident_gauge.set(bytes_now)
+        if overrun:
+            self._budget_overruns.inc()
+        for victim in victims:
+            self._evictions.inc()
+            if self._on_evict is not None:
+                self._on_evict(victim)
 
     def shrink_to_budget(self) -> None:
         """Evict unpinned LRU entries until the budget holds — the
@@ -249,52 +301,64 @@ class SnapshotPager:
         policy whose pending reach exceeds the budget can pin the pager
         past it transiently (counted in ``budget_overruns``); this is
         where residency comes back under."""
-        self._evict_over_budget()
+        with self._lock:
+            victims, overrun = self._collect_victims_locked()
+            bytes_now = self._note_peak_locked()
+        self._publish(bytes_now, victims, overrun)
 
     def evict(self, name: str) -> bool:
         """Explicit eviction (fires the listener). False if not
         resident."""
-        if name not in self._resident:
-            return False
-        self._evict(name)
+        with self._lock:
+            entry = self._resident.pop(name, None)
+            if entry is None:
+                return False
+            self._resident_bytes -= entry[1]
+            bytes_now = self._note_peak_locked()
+        self._publish(bytes_now, [name])
         return True
 
     def discard(self, name: str) -> None:
         """Drop residency WITHOUT firing the listener — for the
         listener itself (detach already in progress)."""
-        entry = self._resident.pop(name, None)
+        with self._lock:
+            entry = self._resident.pop(name, None)
+            if entry is not None:
+                self._resident_bytes -= entry[1]
+            self._pinned.discard(name)
+            bytes_now = self._note_peak_locked()
         if entry is not None:
-            self._resident_bytes -= entry[1]
-            self._note_resident()
-        self._pinned.discard(name)
+            self._resident_gauge.set(bytes_now)
 
     # ---- reading ----
 
-    def _note_resident(self) -> None:
-        self._resident_gauge.set(self._resident_bytes)
-        if self._resident_bytes > self._peak_resident_bytes:
-            self._peak_resident_bytes = self._resident_bytes
-
     def resident_names(self) -> List[str]:
         """LRU→MRU order."""
-        return list(self._resident)
+        with self._lock:
+            return list(self._resident)
 
     def resident_bytes(self) -> int:
-        return self._resident_bytes
+        with self._lock:
+            return self._resident_bytes
 
     def peak_resident_bytes(self) -> int:
         """High-watermark of resident bytes — the storm bench's
         held-under-budget gate reads this."""
-        return self._peak_resident_bytes
+        with self._lock:
+            return self._peak_resident_bytes
 
     def stats(self) -> Dict[str, int]:
         """JSON-ready paging counters for bench records."""
+        with self._lock:
+            resident = len(self._resident)
+            resident_bytes = self._resident_bytes
+            peak = self._peak_resident_bytes
         return {
             "budget_bytes": int(self.budget_bytes),
             "budget_source": self.budget_source,
-            "resident": len(self._resident),
-            "resident_bytes": int(self._resident_bytes),
-            "peak_resident_bytes": int(self._peak_resident_bytes),
+            "resident": resident,
+            "resident_bytes": int(resident_bytes),
+            "peak_resident_bytes": int(peak),
             "loads": int(self._loads.get()),
             "reloads": int(self._reloads.get()),
             "evictions": int(self._evictions.get()),

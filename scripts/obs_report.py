@@ -29,8 +29,9 @@ SLO attainment). This script folds all of it into one readable report:
   == storm ==        the `bench.py --serve-storm` verdict: faults
                      injected/escaped + survival gates, fairness arms
   == analysis ==     the `hhmm_tpu.analysis` static-analyzer verdict:
-                     per-rule finding/suppression counts and the
-                     zero-unsuppressed-findings assertion (embedded
+                     per-family + per-rule finding/suppression counts,
+                     the lock-order DAG verdict (ACYCLIC/CYCLES), and
+                     the zero-unsuppressed-findings assertion (embedded
                      `analysis` stanza or `--analysis report.json`)
   == slo ==          per-check PASS/FAIL + overall attainment
 
@@ -521,7 +522,8 @@ def render_serving(metrics: Dict[str, Dict[str, Any]], out) -> None:
 def render_analysis(analysis: Optional[Dict[str, Any]], out) -> None:
     """The `hhmm_tpu.analysis` static-analyzer verdict (``--format
     json`` report, embedded at manifest key ``analysis`` or passed via
-    ``--analysis``): per-rule finding/suppression counts and the
+    ``--analysis``): per-family and per-rule finding/suppression
+    counts, the lock-order DAG verdict, and the
     zero-unsuppressed-findings assertion tier-1 runs under."""
     _section("analysis", out)
     if not isinstance(analysis, dict):
@@ -536,6 +538,24 @@ def render_analysis(analysis: Optional[Dict[str, Any]], out) -> None:
         f"allowlist: {_fmt(analysis.get('allowlist_entries'))}",
         file=out,
     )
+    # per-family rollup (reports predating rule families fold into
+    # "unknown" — the per-rule table below still carries them)
+    fams: Dict[str, Dict[str, int]] = {}
+    for rid, stats in rules.items():
+        fam = str(stats.get("family") or "unknown")
+        agg = fams.setdefault(fam, {"rules": 0, "findings": 0, "suppressed": 0})
+        agg["rules"] += 1
+        agg["findings"] += int(stats.get("findings") or 0)
+        agg["suppressed"] += int(stats.get("suppressed") or 0)
+    if fams:
+        _table(
+            ("family", "rules", "findings", "suppressed"),
+            [
+                (fam, str(a["rules"]), str(a["findings"]), str(a["suppressed"]))
+                for fam, a in sorted(fams.items())
+            ],
+            out,
+        )
     rows = []
     for rid, stats in sorted(rules.items()):
         if not (stats.get("findings") or stats.get("suppressed")):
@@ -556,6 +576,17 @@ def render_analysis(analysis: Optional[Dict[str, Any]], out) -> None:
     unused = analysis.get("allowlist_unused") or []
     if unused:
         print(f"  unused allowlist entries: {', '.join(map(str, unused))}", file=out)
+    lock_order = (analysis.get("extras") or {}).get("lock_order")
+    if isinstance(lock_order, dict):
+        verdict = _fmt(lock_order.get("verdict"))
+        print(
+            f"  lock-order: {verdict}   "
+            f"locks: {len(lock_order.get('locks') or [])}   "
+            f"edges: {len(lock_order.get('edges') or [])}",
+            file=out,
+        )
+        for cyc in lock_order.get("cycles") or []:
+            print(f"    cycle: {' -> '.join(map(str, cyc))}", file=out)
     clean = bool(analysis.get("ok"))
     print(
         "  verdict: "

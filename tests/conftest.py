@@ -32,6 +32,26 @@ import numpy as np
 import pytest
 
 
+@pytest.fixture(scope="session")
+def check_guards_repo():
+    """ONE full-repo `scripts/check_guards.py` run shared by every
+    invariant acceptance test. Ten tests across nine modules each
+    asserted a substring of the SAME no-argument full-scan output via
+    their own subprocess — ~10 identical ~5 s scans on the tier-1
+    duration budget (PR 12 discipline; the ledger guard measures the
+    suite against an 800 s bar). Toy-tree runs keep their own
+    subprocesses; only the no-argument repo scan is shared."""
+    import subprocess
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts", "check_guards.py")],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",

@@ -1221,12 +1221,8 @@ class TestObsReport:
 
 
 class TestCheckGuardsInvariant5:
-    def test_repo_passes(self):
-        proc = subprocess.run(
-            [sys.executable, os.path.join(REPO, "scripts", "check_guards.py")],
-            capture_output=True,
-            text=True,
-        )
+    def test_repo_passes(self, check_guards_repo):
+        proc = check_guards_repo  # one shared repo scan (conftest)
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert "monotonic clocks" in proc.stdout
 
@@ -1411,11 +1407,7 @@ class TestCheckGuardsInvariant6:
         proc = self._run_on(tmp_path)
         assert "count store" not in proc.stdout
 
-    def test_repo_passes_invariant_6(self):
-        proc = subprocess.run(
-            [sys.executable, os.path.join(REPO, "scripts", "check_guards.py")],
-            capture_output=True,
-            text=True,
-        )
+    def test_repo_passes_invariant_6(self, check_guards_repo):
+        proc = check_guards_repo  # one shared repo scan (conftest)
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert "one shared metrics plane" in proc.stdout

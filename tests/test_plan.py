@@ -485,11 +485,7 @@ class TestCheckGuardsInvariant7:
         proc = self._run_on(tmp_path)
         assert "constructs" not in proc.stdout, proc.stdout
 
-    def test_repo_passes(self):
-        proc = subprocess.run(
-            [sys.executable, os.path.join(REPO, "scripts", "check_guards.py")],
-            capture_output=True,
-            text=True,
-        )
+    def test_repo_passes(self, check_guards_repo):
+        proc = check_guards_repo  # one shared repo scan (conftest)
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert "placement objects confined" in proc.stdout

@@ -389,12 +389,8 @@ class TestCheckGuardsInvariant9:
             text=True,
         )
 
-    def test_repo_passes(self):
-        proc = subprocess.run(
-            [sys.executable, os.path.join(REPO, "scripts", "check_guards.py")],
-            capture_output=True,
-            text=True,
-        )
+    def test_repo_passes(self, check_guards_repo):
+        proc = check_guards_repo  # one shared repo scan (conftest)
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert "timing loops confined" in proc.stdout
 

@@ -533,11 +533,7 @@ class TestCheckGuardsInvariant10:
         proc = self._run_on(tmp_path)
         assert "serve layer" not in proc.stdout, proc.stdout
 
-    def test_repo_passes_invariant_10(self):
-        proc = subprocess.run(
-            [sys.executable, os.path.join(REPO, "scripts", "check_guards.py")],
-            capture_output=True,
-            text=True,
-        )
+    def test_repo_passes_invariant_10(self, check_guards_repo):
+        proc = check_guards_repo  # one shared repo scan (conftest)
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert "serve-layer clocks confined" in proc.stdout

@@ -606,11 +606,8 @@ class TestSelfHealing:
 
 
 class TestCheckGuardsScript:
-    def test_repo_passes(self):
-        proc = subprocess.run(
-            [sys.executable, os.path.join(REPO, "scripts", "check_guards.py")],
-            capture_output=True, text=True,
-        )
+    def test_repo_passes(self, check_guards_repo):
+        proc = check_guards_repo  # one shared repo scan (conftest)
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert "ok" in proc.stdout
 
