@@ -1000,16 +1000,17 @@ def assoc_sweep(args, backend) -> None:
     dispatch over the series batch with compile excluded. Emits a
     single ``tayal_assoc_decode_throughput`` JSON record with
     sequential-vs-assoc series/s at every T plus the winner and what
-    the dispatch table (``use_assoc``) currently picks — a disagreement
-    between ``winner`` and ``dispatch_auto`` means the crossover table
-    is stale (re-run `scripts/tpu_assoc_probe.py`). Exit 0 always (the
-    record is the regression surface; `tests/test_assoc.py` gates the
-    --quick smoke in tier-1)."""
+    ``"auto"`` dispatch (`kernels/dispatch.py::resolve_auto`, full
+    {seq, assoc, pallas} enum) currently picks — a disagreement
+    between ``winner`` and ``dispatch_auto`` means the crossover
+    table/DB is stale (re-run `scripts/tpu_assoc_probe.py`). Exit 0
+    always (the record is the regression surface; `tests/test_assoc.py`
+    gates the --quick smoke in tier-1)."""
     from __graft_entry__ import _tayal_batch
     from hhmm_tpu.kernels import (
         forward_filter,
         forward_filter_assoc,
-        use_assoc,
+        resolve_branch,
         viterbi,
         viterbi_assoc,
     )
@@ -1065,9 +1066,9 @@ def assoc_sweep(args, backend) -> None:
         row["winner"] = (
             "assoc" if row["speedup_assoc"] > 1.0 else "seq"
         )
-        row["dispatch_auto"] = (
-            "assoc" if use_assoc(model.K, T) else "seq"
-        )
+        # the honest three-way stamp: a measured pallas winner must
+        # show as "pallas", not fold into "seq"
+        row["dispatch_auto"] = resolve_branch(model.K, T, "auto")
         points.append(row)
         print(json.dumps(row), file=sys.stderr, flush=True)
     assoc_record = stamp_record(
@@ -1146,13 +1147,20 @@ def profile_kernels(args, backend) -> None:
         points = [(2, 512), (4, 1024), (8, 1024)]
         B, reps = 64, 8
         kernel_names = ("filter", "viterbi", "ffbs")
+    # the pallas branch races on TPU always (that is the row a probe
+    # run flips dispatch with); on CPU only in --quick plumbing smoke —
+    # full-mode CPU reps through the Pallas INTERPRETER are minutes of
+    # wall for rows whose honest verdict ("interpreted pallas loses")
+    # the dispatch default already encodes
+    pallas_here = backend["backend"] == "tpu" or args.quick
+    branch_names = ("seq", "assoc", "pallas") if pallas_here else ("seq", "assoc")
 
     # the SHARED measurement surface (obs/profile.py): both cost-DB
     # writers — this bench and scripts/tpu_assoc_probe.py — must time
     # the exact same computation per (kernel, branch) key, or the DB's
     # winner arbitration compares different programs
     inputs = lambda K, T: obs_profile.dirichlet_hmm_inputs(rng, K, T, batch=B)
-    kernels = obs_profile.decode_kernel_pairs()
+    kernels = obs_profile.decode_kernel_fns()
     db = obs_profile.KernelCostDB(kernel_costs_path(args)).load()
     device_kind = obs_manifest.device_info().get("device_kind")
     rows_stanza = []
@@ -1161,8 +1169,8 @@ def profile_kernels(args, backend) -> None:
 
     for K, T in points:
         for name in kernel_names:
-            seq_fn, assoc_fn = kernels[name]
-            for branch, body in (("seq", seq_fn), ("assoc", assoc_fn)):
+            for branch in branch_names:
+                body = kernels[name][branch]
                 fn = telemetry.register_jit(
                     f"bench.profile.{name}.{branch}", jax.jit(jax.vmap(body))
                 )
@@ -1233,13 +1241,15 @@ def profile_kernels(args, backend) -> None:
                     "kernel": name,
                     "K": K,
                     "T": T,
-                    "auto": "assoc" if branch else "seq",
+                    "auto": branch,
                     "source": source,
+                    "raced": list(branch_names),
                 }
             )
     stanza = {
         "db_path": db.path,
         "device_kind": device_kind,
+        "branches": list(branch_names),
         "rows": rows_stanza,
         "dispatch": dispatch_audit,
     }
@@ -1695,7 +1705,7 @@ def main() -> None:
 
     elif args.sampler == "chees":
         from hhmm_tpu.infer import make_lp_bc, sample_chees_batched
-        from hhmm_tpu.kernels.pallas_traj import make_tayal_trajectory
+        from hhmm_tpu.kernels.dispatch import make_tayal_trajectory
 
         def run_chunk(x, sign, init, keys):
             # shared-adaptation ChEES: one program over the chunk, every

@@ -95,7 +95,7 @@ def _time_fit(model, data, config, key, fused_traj=False):
             # whole-trajectory Pallas kernel (kernels/pallas_traj.py)
             # run as a B=1 batch — VERDICT r2 #4: the single-fit path
             # gets the same fused hot loop as the batched bench
-            from hhmm_tpu.kernels.pallas_traj import make_tayal_trajectory
+            from hhmm_tpu.kernels.dispatch import make_tayal_trajectory
 
             try:
                 traj = make_tayal_trajectory(data_b, cap=config.max_leapfrogs)

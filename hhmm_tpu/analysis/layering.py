@@ -101,6 +101,79 @@ def _import_targets(node: ast.AST, rel: str) -> List[Tuple[int, str]]:
     return out
 
 
+PALLAS_ALLOWED_PREFIX = "hhmm_tpu/kernels/"
+
+
+def _pallas_import_sites(node: ast.AST, rel: str) -> List[Tuple[int, str]]:
+    """(line, dotted-target) pairs where this import reaches a Pallas
+    kernel module (``hhmm_tpu.kernels.pallas_*``), any spelling:
+    absolute ``import``/``from ... import``, the
+    ``from hhmm_tpu.kernels import pallas_x`` alias form, and relative
+    imports resolved against the file's own package path."""
+    out: List[Tuple[int, str]] = []
+    if isinstance(node, ast.Import):
+        for a in node.names:
+            p = a.name.split(".")
+            if p[:2] == ["hhmm_tpu", "kernels"] and len(p) > 2 and p[2].startswith("pallas"):
+                out.append((node.lineno, a.name))
+    elif isinstance(node, ast.ImportFrom):
+        if node.level == 0 and node.module:
+            p = node.module.split(".")
+            if p[:2] == ["hhmm_tpu", "kernels"]:
+                if len(p) > 2 and p[2].startswith("pallas"):
+                    out.append((node.lineno, node.module))
+                elif len(p) == 2:
+                    for a in node.names:
+                        if a.name.startswith("pallas"):
+                            out.append((node.lineno, f"{node.module}.{a.name}"))
+        elif node.level >= 1:
+            pkg_parts = rel.split("/")[:-1]
+            base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+            mod = base + (node.module.split(".") if node.module else [])
+            if mod[:2] == ["hhmm_tpu", "kernels"]:
+                if len(mod) > 2 and mod[2].startswith("pallas"):
+                    out.append((node.lineno, ".".join(mod)))
+                elif len(mod) == 2:
+                    for a in node.names:
+                        if a.name.startswith("pallas"):
+                            out.append((node.lineno, ".".join(mod) + f".{a.name}"))
+    return out
+
+
+@register
+class PallasImportRule(Rule):
+    id = "pallas-import"
+    title = "Pallas kernels entered only through kernels/dispatch.py"
+    doc = (
+        "No `hhmm_tpu.kernels.pallas_*` (or `pallas_semiring`) import "
+        "outside the kernels package: `kernels/dispatch.py` re-exports "
+        "the sanctioned entries (`semiring_*`, `*_pallas`, "
+        "`make_tayal_trajectory`) and is the ONE auto-tuned entry per "
+        "decode primitive — a direct import bypasses the measured "
+        "{seq, assoc, pallas} branch arbitration, the eligibility "
+        "checks (homogeneous f32), and the span/plan/digest "
+        "observability, and re-couples callers to deprecated shim "
+        "modules scheduled for deletion. Mirrors the placement and "
+        "metrics-plane single-entry invariants."
+    )
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for mod in project.iter_modules():
+            if mod.rel.startswith(PALLAS_ALLOWED_PREFIX):
+                continue
+            for node in cached_walk(mod.tree):
+                for line, target in _pallas_import_sites(node, mod.rel):
+                    yield self.finding(
+                        mod.rel,
+                        line,
+                        f"direct Pallas kernel import `{target}` outside "
+                        "hhmm_tpu/kernels/ — go through the dispatch "
+                        "layer (`hhmm_tpu.kernels.dispatch` re-exports "
+                        "the sanctioned entries; `time_parallel=` "
+                        "selects the branch); see docs/parallel_scan.md",
+                    )
+
+
 @register
 class LayerImportRule(Rule):
     id = "layer-import"

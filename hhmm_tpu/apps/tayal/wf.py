@@ -315,13 +315,15 @@ def wf_trade(
     from collections import defaultdict
 
     from hhmm_tpu.kernels import use_assoc
+    from hhmm_tpu.kernels.dispatch import resolve_routed
 
     # RESOLVED dispatch branch per decode bucket, for the cache key: a
     # raw "auto" string would let a resumed run on a different backend
-    # (or after a crossover re-probe) silently mix scan- and
-    # assoc-decoded tasks, which can differ at argmax ties. Mirrors the
-    # two resolutions the decode actually uses: _seg_alpha's (auto on
-    # TPU pins the fused Pallas forward) and viterbi_dispatch's.
+    # (or after a crossover re-probe) silently mix scan-, assoc-, and
+    # pallas-decoded tasks, which can differ at argmax ties. Mirrors
+    # the two resolutions the decode actually uses: _seg_alpha's (auto
+    # on TPU pins the fused Pallas forward) and viterbi_dispatch's
+    # three-way branch.
     _tp_alpha = (
         False
         if time_parallel == "auto" and jax.default_backend() == "tpu"
@@ -330,12 +332,18 @@ def wf_trade(
 
     def _tp_resolved(b_t: int) -> str:
         # per-kernel DB families (obs/profile.py): the v component must
-        # resolve exactly as viterbi_dispatch does (kernel="viterbi"),
-        # or a DB whose viterbi winner differs from the filter pair's
-        # would stamp a cache key disagreeing with the branch run
+        # resolve exactly as viterbi_dispatch does (kernel="viterbi",
+        # full {seq, assoc, pallas} enum), or a DB whose viterbi winner
+        # differs from the filter pair's would stamp a cache key
+        # disagreeing with the branch run. That includes the
+        # pallas-eligibility degrade: under x64 the decode operands are
+        # f64, the blocked kernel cannot run, and viterbi_dispatch
+        # falls back to the measured seq/assoc pick — resolve_routed IS
+        # that resolution (resolve first, THEN degrade only a pallas
+        # winner), so the stamp and the executed branch cannot diverge
         return (
             f"a{int(use_assoc(model.K, b_t, _tp_alpha))}"
-            f"v{int(use_assoc(model.K, b_t, time_parallel, kernel='viterbi'))}"
+            f"v:{resolve_routed(model.K, b_t, time_parallel, kernel='viterbi', pallas_ok=not jax.config.jax_enable_x64)}"
         )
 
     sub = defaultdict(float)  # raw-float sub-profile; rounded once below

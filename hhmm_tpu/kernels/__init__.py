@@ -26,6 +26,7 @@ from hhmm_tpu.kernels.dispatch import (
     backward_dispatch,
     ffbs_dispatch,
     forward_filter_dispatch,
+    resolve_branch,
     smooth_dispatch,
     use_assoc,
     viterbi_dispatch,
@@ -47,6 +48,7 @@ __all__ = [
     "viterbi_dispatch",
     "ffbs_dispatch",
     "use_assoc",
+    "resolve_branch",
     "forward_filter",
     "forward_alpha",
     "backward_pass",

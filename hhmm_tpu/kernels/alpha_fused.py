@@ -43,7 +43,7 @@ def _dispatch(log_pi, log_A, log_obs, mask, gate=()):
     from hhmm_tpu.kernels.vg import _pallas_chunked_eligible, chunk_for_k
 
     if _pallas_chunked_eligible(log_pi, log_A, log_obs):
-        from hhmm_tpu.kernels.pallas_forward_chunked import (
+        from hhmm_tpu.kernels.pallas_semiring import (
             _LANES,
             _pad_chunked,
             _run_chunked_forward,

@@ -1,36 +1,50 @@
-"""``time_parallel=`` dispatch: sequential scan vs associative-scan kernels.
+"""``time_parallel=`` dispatch: ONE auto-tuned entry per decode
+primitive, over the three measured branches ``{seq, assoc, pallas}``.
 
-The sequential ``lax.scan`` kernels are O(T) depth with O(T·K²) work;
-the time-parallel kernels (`kernels/assoc.py`) are O(log T) depth with
-O(T·K³) work (semiring matrix products). Which wins is a measured
-(K, T) question, not a principle:
+- **seq** — the sequential ``lax.scan`` kernels: O(T) depth, O(T·K²)
+  work, the baseline every host can run;
+- **assoc** — the time-parallel kernels (`kernels/assoc.py`):
+  O(log T) depth, O(T·K³) work (semiring matrix products);
+- **pallas** — the blocked Pallas semiring mega-kernel
+  (`kernels/pallas_semiring.py`): O(T) work like the scan but the
+  whole recursion staged through VMEM blocks in a handful of kernel
+  launches instead of 2(T−1) XLA-sequenced microkernels. Homogeneous
+  f32 operands only; ineligible signatures (time-varying ``log_A``,
+  f64 test modes) fall back to the measured seq/assoc pick.
 
-- **small T**: the scan's dependency chain is short; the assoc kernels
-  pay K× more work plus scan-tree overheads for nothing;
-- **large K**: O(K³) work grows faster than the depth saving — the
-  crossover T rises steeply with K and beyond K≈8 the scan wins at any
-  realistic T;
-- **small K, long T** (the zig-zag tick windows): the assoc form turns
-  the longest serial dependency in the system into log-depth work.
-
-Measured crossover sources, in priority order (``"auto"`` only —
-explicit ``True``/``False`` always wins, then an active plan scope):
+Which branch wins is a measured (K, T, B) question, not a principle.
+Branch sources, in priority order (``"auto"`` only — explicit forces
+always win, then an active plan scope):
 
 1. **the kernel cost database** (`hhmm_tpu/obs/profile.py`,
    ``results/kernel_costs.json``) — rows written by
    ``bench.py --profile-kernels`` and `scripts/tpu_assoc_probe.py`; a
-   populated row for this exact (kernel, K, T) on the CURRENT
-   ``device_kind`` decides the branch. A TPU probe run lands directly
-   in dispatch without a code change.
-2. **the checked-in ``ASSOC_CROSSOVER`` table** below — the hand-pasted
-   fallback for points/hosts the DB hasn't measured (methodology and
-   the full grids are in `docs/parallel_scan.md`).
+   populated row group for this exact (kernel, K, T) on the CURRENT
+   ``device_kind`` decides the branch, N-way across every branch the
+   group measured. A TPU probe run lands directly in dispatch without
+   a code change — including the ``pallas`` branch, which is NEVER
+   dispatched off theory: like assoc, it routes only from measured
+   rows (on CPU the checked-in DB holds no pallas winners, so CPU
+   stays seq).
+2. **the checked-in ``ASSOC_CROSSOVER`` table** below — the
+   hand-pasted seq-vs-assoc fallback for points/hosts the DB hasn't
+   measured (methodology and the full grids are in
+   `docs/parallel_scan.md`).
 
-Every consumer takes ``time_parallel=`` — ``"auto"`` (measured lookup,
-the default), ``True`` (force assoc), or ``False`` (force scan) — so
+Every consumer takes ``time_parallel=`` — ``"auto"`` (measured
+lookup, the default), ``True`` (force assoc), ``False`` (force scan),
+or an explicit branch name ``"seq"``/``"assoc"``/``"pallas"`` — so
 callers can override per call. Shapes are static under ``jit``, so
 dispatch is plain Python with zero trace cost (the DB read is memoized
-per (kernel, K, T) in `obs/profile.py`).
+per (kernel, K, T) in `obs/profile.py`). The resolved branch shows in
+the span name, the plan stanza, and the wf decode digest.
+
+This module is also the ONLY sanctioned entry to the Pallas kernels
+from outside ``hhmm_tpu/kernels/`` (analysis rule ``pallas-import``,
+error severity): probes, benches, and tests reach them through the
+re-exports below (``semiring_*``, ``*_pallas``,
+``make_tayal_trajectory``), never by importing ``pallas_*`` modules
+directly.
 """
 
 from __future__ import annotations
@@ -50,24 +64,64 @@ from hhmm_tpu.kernels.assoc import (
     viterbi_assoc,
 )
 from hhmm_tpu.kernels.ffbs import backward_sample, ffbs_fused
-from hhmm_tpu.kernels.filtering import backward_pass, forward_backward, forward_filter
+from hhmm_tpu.kernels.filtering import (
+    backward_pass,
+    forward_backward,
+    forward_filter,
+    smooth,
+)
+from hhmm_tpu.kernels.pallas_semiring import (
+    beta_pallas,
+    default_block,
+    ffbs_pallas,
+    ffbs_pallas_sample,
+    filter_pallas,
+    semiring_beta,
+    semiring_ffbs,
+    semiring_filter,
+    semiring_vg,
+    semiring_viterbi,
+    viterbi_pallas,
+)
+from hhmm_tpu.kernels.pallas_traj import make_tayal_trajectory, tayal_trajectory
 from hhmm_tpu.kernels.viterbi import viterbi
 from hhmm_tpu.obs import profile as obs_profile
 from hhmm_tpu.obs.trace import span
 
 __all__ = [
     "ASSOC_CROSSOVER",
+    "BRANCHES",
     "plan_time_parallel",
     "use_assoc",
     "resolve_auto",
+    "resolve_branch",
+    "resolve_routed",
     "forward_filter_dispatch",
     "backward_dispatch",
     "smooth_dispatch",
     "viterbi_dispatch",
     "ffbs_dispatch",
+    # sanctioned Pallas entries (analysis rule pallas-import): the
+    # unified blocked semiring kernel + the Tayal trajectory kernel
+    "filter_pallas",
+    "beta_pallas",
+    "viterbi_pallas",
+    "ffbs_pallas",
+    "ffbs_pallas_sample",
+    "semiring_filter",
+    "semiring_beta",
+    "semiring_viterbi",
+    "semiring_ffbs",
+    "semiring_vg",
+    "default_block",
+    "make_tayal_trajectory",
+    "tayal_trajectory",
 ]
 
 TimeParallel = Union[bool, str]
+
+# the dispatchable branch enum — every resolve returns one of these
+BRANCHES = ("seq", "assoc", "pallas")
 
 
 def _branch_span(name: str, branch: str, K: int, T: int):
@@ -161,11 +215,12 @@ _PLAN_TLS = threading.local()
 
 
 @contextlib.contextmanager
-def plan_time_parallel(value: Optional[bool]):
+def plan_time_parallel(value):
     """Scope an execution-plan branch decision over ``"auto"`` dispatch
-    (installed by ``hhmm_tpu.plan.Plan.dispatch_scope``). ``True`` pins
-    assoc, ``False`` pins the sequential scan, ``None`` restores table
-    lookup. Explicit ``time_parallel=True/False`` call sites still win.
+    (installed by ``hhmm_tpu.plan.Plan.dispatch_scope``). ``True`` (or
+    ``"assoc"``) pins assoc, ``False`` (or ``"seq"``) the sequential
+    scan, ``"pallas"`` the blocked Pallas branch, ``None`` restores
+    measured lookup. Explicit ``time_parallel=`` call sites still win.
     Per-thread: the scope only affects dispatch on the installing
     thread."""
     prev = getattr(_PLAN_TLS, "value", None)
@@ -176,6 +231,19 @@ def plan_time_parallel(value: Optional[bool]):
         _PLAN_TLS.value = prev
 
 
+def _coerce_branch(value) -> Optional[str]:
+    """A plan-scope / explicit ``time_parallel`` value as a branch
+    name: ``True``→assoc, ``False``→seq, a literal branch name passes
+    through, anything else is not a force (``None``)."""
+    if value is True:
+        return "assoc"
+    if value is False:
+        return "seq"
+    if isinstance(value, str) and value in BRANCHES:
+        return value
+    return None
+
+
 def use_assoc(
     K: int,
     T: int,
@@ -183,20 +251,50 @@ def use_assoc(
     platform: Optional[str] = None,
     kernel: str = "filter",
 ) -> bool:
-    """Resolve a ``time_parallel`` setting to a concrete choice for a
-    (K, T) shape: explicit ``True``/``False`` pass through; ``"auto"``
-    defers to an active plan scope (:func:`plan_time_parallel`), then
-    to a measured kernel-cost-DB row for the current device kind
-    (`obs/profile.py`), then to the checked-in crossover table for the
-    active backend. ``kernel`` names the DB row family this dispatch
-    belongs to (``"filter"`` / ``"viterbi"`` / ``"ffbs"``)."""
-    if time_parallel is True or time_parallel is False:
-        return time_parallel
+    """Whether the assoc branch is the resolved choice for a (K, T)
+    shape — the two-way legacy surface over :func:`resolve_branch`
+    (callers that only fork scan-vs-assoc, e.g. the seg-alpha route in
+    `models/tayal.py`, keep this contract). Explicit forces —
+    ``True``/``False`` or a literal branch name — pass through
+    (``"pallas"`` takes the non-assoc fork: these callers' scan arm is
+    where the fused Pallas kernels already live); ``"auto"`` resolves
+    plan scope → measured DB → crossover table → seq."""
+    forced = _coerce_branch(time_parallel)
+    if forced is not None:
+        return forced == "assoc"
     if time_parallel != "auto":
         raise ValueError(
-            f"time_parallel must be True, False, or 'auto', got {time_parallel!r}"
+            "time_parallel must be True, False, 'auto', or one of "
+            f"{BRANCHES}, got {time_parallel!r}"
         )
-    return resolve_auto(K, T, kernel=kernel, platform=platform)[0]
+    return resolve_auto(K, T, kernel=kernel, platform=platform)[0] == "assoc"
+
+
+def resolve_branch(
+    K: int,
+    T: int,
+    time_parallel: TimeParallel = "auto",
+    platform: Optional[str] = None,
+    kernel: str = "filter",
+    allowed: Optional[Tuple[str, ...]] = None,
+) -> str:
+    """The resolved branch name for one dispatch: explicit forces
+    (``True``/``False``/a literal branch name) pass through;
+    ``"auto"`` goes through :func:`resolve_auto`. This is the surface
+    the wf decode digest and the planner stamp — the SAME resolution
+    the dispatch functions run, so a recorded branch and the branch
+    that executes can never disagree."""
+    forced = _coerce_branch(time_parallel)
+    if forced is not None:
+        return forced
+    if time_parallel != "auto":
+        raise ValueError(
+            "time_parallel must be True, False, 'auto', or one of "
+            f"{BRANCHES}, got {time_parallel!r}"
+        )
+    return resolve_auto(
+        K, T, kernel=kernel, platform=platform, allowed=allowed
+    )[0]
 
 
 def resolve_auto(
@@ -205,20 +303,29 @@ def resolve_auto(
     *,
     kernel: str = "filter",
     platform: Optional[str] = None,
-) -> Tuple[bool, str]:
-    """``(use_assoc, source)`` for an ``"auto"`` dispatch at (K, T):
-    the branch decision plus WHERE it came from — ``"plan"`` (an
-    active :func:`plan_time_parallel` scope), ``"db"`` (a measured
-    kernel-cost-DB row for this device kind), ``"table"`` (the
-    checked-in ``ASSOC_CROSSOVER`` fallback matched a row), or
-    ``"default"`` (nothing measured anywhere: the sequential scan).
-    The source is the observability surface — ``bench.py
-    --profile-kernels`` stamps it into its manifest stanza and
-    `scripts/obs_report.py` renders which branches are DB-backed vs
-    table-backed vs unmeasured."""
+    allowed: Optional[Tuple[str, ...]] = None,
+) -> Tuple[str, str]:
+    """``(branch, source)`` for an ``"auto"`` dispatch at (K, T): the
+    resolved branch name (``"seq"`` / ``"assoc"`` / ``"pallas"``) plus
+    WHERE it came from — ``"plan"`` (an active
+    :func:`plan_time_parallel` scope), ``"db"`` (a measured
+    kernel-cost-DB row group for this device kind, N-way arbitrated),
+    ``"table"`` (the checked-in ``ASSOC_CROSSOVER`` fallback matched a
+    row), or ``"default"`` (nothing measured anywhere: the sequential
+    scan). ``allowed`` restricts the DB arbitration to a branch subset
+    — the dispatch functions pass ``("seq", "assoc")`` when the call
+    signature is pallas-ineligible, so a measured pallas win cannot
+    strand such a call on an unmeasured default. The source is the
+    observability surface — ``bench.py --profile-kernels`` stamps it
+    into its manifest stanza and `scripts/obs_report.py` renders which
+    branches are DB-backed vs table-backed vs unmeasured."""
     plan_value = getattr(_PLAN_TLS, "value", None)
     if plan_value is not None:
-        return bool(plan_value), "plan"
+        branch = _coerce_branch(plan_value)
+        if branch is not None:
+            if allowed is not None and branch not in allowed:
+                branch = "seq"
+            return branch, "plan"
     # the DB holds rows keyed by THIS host's device kind — it can only
     # answer for the local platform. A caller asking about a foreign
     # platform (planner what-ifs, tests pinning a table) must get that
@@ -231,28 +338,91 @@ def resolve_auto(
     # dispatch under kernel="filter" deliberately: the backward pass
     # IS the filter combine run in suffix order — same cost shape.)
     if platform is None or platform == _platform():
-        hint = obs_profile.dispatch_winner(kernel, K, T, _device_kind())
+        hint = obs_profile.dispatch_winner(
+            kernel, K, T, _device_kind(), allowed=allowed
+        )
         if hint is not None:
-            return bool(hint), "db"
+            return hint, "db"
     table = ASSOC_CROSSOVER.get(
         platform or _platform(), ASSOC_CROSSOVER["default"]
     )
     for k_max, t_min in table:
         if K <= k_max:
-            return T >= t_min, "table"
+            return ("assoc" if T >= t_min else "seq"), "table"
     # fall-through (empty table, or K above every row): nothing
     # measured for this point — the sequential scan, labeled as such
-    return False, "default"
+    return "seq", "default"
+
+
+def _pallas_decode_ok(log_A, *arrs) -> bool:
+    """Whether this call signature can take the blocked Pallas branch:
+    homogeneous transitions and f32 operands (the kernel's BlockSpecs
+    are f32; the f64 x64 test mode and time-varying IOHMM kernels fall
+    back to the measured seq/assoc pick). Gradients do NOT flow
+    through the pallas branch — the decode dispatch surface is
+    gradient-free by contract (the HMC value-and-grad path runs
+    `kernels/vg.py`'s fused kernel instead)."""
+    if log_A.ndim != 2:
+        return False
+    return all(a.dtype == jnp.float32 for a in (log_A,) + arrs)
+
+
+def resolve_routed(
+    K: int,
+    T: int,
+    time_parallel: TimeParallel = "auto",
+    *,
+    kernel: str = "filter",
+    pallas_ok: bool = True,
+) -> str:
+    """The per-call branch EXACTLY as the dispatch functions run it:
+    :func:`resolve_branch` first, then — only if the winner is pallas
+    and ``pallas_ok`` is False — the measured seq/assoc re-resolution.
+    The two-step order matters: restricting the arbitration up front
+    would let a smaller/staler seq-assoc stamp group decide points
+    where the honest largest-batch group's winner was not pallas at
+    all. An EXPLICIT ``"pallas"`` force with an incompatible signature
+    raises — silently running a different kernel than the caller
+    demanded would un-pin every parity test. Callers that stamp a
+    resolved branch (the wf decode cache key) use this so the record
+    and the executed branch can never disagree."""
+    branch = resolve_branch(K, T, time_parallel, kernel=kernel)
+    if branch == "pallas" and not pallas_ok:
+        if _coerce_branch(time_parallel) == "pallas":
+            raise ValueError(
+                "time_parallel='pallas' requires homogeneous f32 "
+                "log_A/operands (blocked Pallas kernel eligibility)"
+            )
+        branch = resolve_branch(
+            K, T, time_parallel, kernel=kernel, allowed=("seq", "assoc")
+        )
+        if branch == "pallas":  # a plan scope pinned it: degrade to seq
+            branch = "seq"
+    return branch
+
+
+def _route(
+    K: int, T: int, time_parallel, kernel: str, pallas_ok: bool
+) -> str:
+    return resolve_routed(
+        K, T, time_parallel, kernel=kernel, pallas_ok=pallas_ok
+    )
 
 
 def forward_filter_dispatch(
     log_pi, log_A, log_obs, mask=None, *, time_parallel: TimeParallel = "auto"
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """:func:`~hhmm_tpu.kernels.filtering.forward_filter` contract,
-    routed to the sequential scan or the associative-scan kernel by the
-    measured (K, T) crossover."""
+    routed across {seq, assoc, pallas} by the measured (K, T)
+    crossover."""
     T, K = log_obs.shape
-    if use_assoc(K, T, time_parallel):
+    branch = _route(
+        K, T, time_parallel, "filter", _pallas_decode_ok(log_A, log_pi, log_obs)
+    )
+    if branch == "pallas":
+        with _branch_span("forward_filter", "pallas", K, T):
+            return filter_pallas(log_pi, log_A, log_obs, mask)
+    if branch == "assoc":
         with _branch_span("forward_filter", "assoc", K, T):
             return forward_filter_assoc(log_pi, log_A, log_obs, mask)
     with _branch_span("forward_filter", "seq", K, T):
@@ -263,9 +433,17 @@ def backward_dispatch(
     log_A, log_obs, mask=None, *, time_parallel: TimeParallel = "auto"
 ) -> jnp.ndarray:
     """:func:`~hhmm_tpu.kernels.filtering.backward_pass` contract with
-    crossover routing."""
+    three-way crossover routing (kernel family ``"filter"``: the beta
+    recursion is the filter combine run in suffix order — same cost
+    shape)."""
     T, K = log_obs.shape
-    if use_assoc(K, T, time_parallel):
+    branch = _route(
+        K, T, time_parallel, "filter", _pallas_decode_ok(log_A, log_obs)
+    )
+    if branch == "pallas":
+        with _branch_span("backward", "pallas", K, T):
+            return beta_pallas(log_A, log_obs, mask)
+    if branch == "assoc":
         with _branch_span("backward", "assoc", K, T):
             return backward_assoc(log_A, log_obs, mask)
     with _branch_span("backward", "seq", K, T):
@@ -276,10 +454,20 @@ def smooth_dispatch(
     log_pi, log_A, log_obs, mask=None, *, time_parallel: TimeParallel = "auto"
 ):
     """:func:`~hhmm_tpu.kernels.filtering.forward_backward` contract
-    (``log_alpha, log_beta, log_gamma, loglik``) with crossover
-    routing — both passes take the same branch."""
+    (``log_alpha, log_beta, log_gamma, loglik``) with three-way
+    crossover routing — both passes take the same branch."""
     T, K = log_obs.shape
-    if use_assoc(K, T, time_parallel):
+    branch = _route(
+        K, T, time_parallel, "filter", _pallas_decode_ok(log_A, log_pi, log_obs)
+    )
+    if branch == "pallas":
+        with _branch_span("smooth", "pallas", K, T):
+            log_alpha, loglik = filter_pallas(log_pi, log_A, log_obs, mask)
+            log_beta = beta_pallas(log_A, log_obs, mask)
+            # the ONE guarded gamma normalization, shared with the
+            # seq/assoc branches (filtering.smooth)
+            return log_alpha, log_beta, smooth(log_alpha, log_beta), loglik
+    if branch == "assoc":
         with _branch_span("smooth", "assoc", K, T):
             return smooth_assoc(log_pi, log_A, log_obs, mask)
     with _branch_span("smooth", "seq", K, T):
@@ -290,9 +478,15 @@ def viterbi_dispatch(
     log_pi, log_A, log_obs, mask=None, *, time_parallel: TimeParallel = "auto"
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """:func:`~hhmm_tpu.kernels.viterbi.viterbi` contract with
-    crossover routing."""
+    three-way crossover routing."""
     T, K = log_obs.shape
-    if use_assoc(K, T, time_parallel, kernel="viterbi"):
+    branch = _route(
+        K, T, time_parallel, "viterbi", _pallas_decode_ok(log_A, log_pi, log_obs)
+    )
+    if branch == "pallas":
+        with _branch_span("viterbi", "pallas", K, T):
+            return viterbi_pallas(log_pi, log_A, log_obs, mask)
+    if branch == "assoc":
         with _branch_span("viterbi", "assoc", K, T):
             return viterbi_assoc(log_pi, log_A, log_obs, mask)
     with _branch_span("viterbi", "seq", K, T):
@@ -325,13 +519,15 @@ def ffbs_dispatch(
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """FFBS draw ``(z [T] int32, loglik)`` with crossover routing.
 
-    ``"auto"`` prefers :func:`~hhmm_tpu.kernels.ffbs.ffbs_fused`
+    ``"auto"`` resolves the measured three-way branch first; with
+    nothing measured it prefers :func:`~hhmm_tpu.kernels.ffbs.ffbs_fused`
     wherever the fused Pallas kernel is in play (TPU, homogeneous f32 —
-    it dominates both scan and assoc there), the associative-scan FFBS
-    past the (K, T) crossover otherwise, and the sequential scan below
-    it. The same pre-drawn-uniform convention everywhere means the
-    routes are draw-for-draw interchangeable. Time-varying ``log_A``
-    (no gate-key form) always takes the sequential forward filter +
+    the measured ladder has it 6.5× the scan path), the
+    associative-scan FFBS past the (K, T) crossover otherwise, and the
+    sequential scan below it. The same pre-drawn-uniform convention
+    everywhere means the routes are draw-for-draw interchangeable.
+    Time-varying ``log_A`` (no gate-key form) always takes the
+    sequential forward filter +
     :func:`~hhmm_tpu.kernels.ffbs.backward_sample` (Gumbel draws —
     identical to :func:`~hhmm_tpu.kernels.ffbs.ffbs_sample`).
     """
@@ -343,10 +539,28 @@ def ffbs_dispatch(
             log_alpha, ll = forward_filter(log_pi, log_A, log_obs, mask)
             return backward_sample(key, log_alpha, log_A, mask), ll
     T, K = log_obs.shape
-    tp = time_parallel
-    if tp == "auto" and _fused_ffbs_likely(log_pi, log_A, log_obs):
-        tp = False
-    if use_assoc(K, T, tp, kernel="ffbs"):
+    pallas_ok = _pallas_decode_ok(log_A, log_pi, log_obs)
+    if time_parallel == "auto":
+        branch, source = resolve_auto(K, T, kernel="ffbs")
+        if branch == "pallas" and not pallas_ok:
+            branch, source = resolve_auto(
+                K, T, kernel="ffbs", allowed=("seq", "assoc")
+            )
+            branch = "seq" if branch == "pallas" else branch
+        if source in ("table", "default") and _fused_ffbs_likely(
+            log_pi, log_A, log_obs
+        ):
+            # nothing measured: the fused kernel's measured ladder win
+            # keeps priority over the unmeasured table fallbacks
+            branch = "seq"
+    else:
+        branch = _route(K, T, time_parallel, "ffbs", pallas_ok)
+    if branch == "pallas":
+        with _branch_span("ffbs", "pallas", K, T):
+            return ffbs_pallas_sample(
+                key, log_pi, log_A, log_obs, mask, gate_key, state_key
+            )
+    if branch == "assoc":
         with _branch_span("ffbs", "assoc", K, T):
             return ffbs_assoc_sample(
                 key, log_pi, log_A, log_obs, mask, gate_key, state_key

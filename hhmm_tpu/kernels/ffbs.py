@@ -169,15 +169,19 @@ def _dispatch_ffbs(u, log_pi, log_A, log_obs, mask, gate=()):
     if u.dtype == jnp.float32:
         # u joins the f32 gate (x64 mode promotes jax.random.uniform)
         if _pallas_eligible(log_pi, log_A, log_obs):
-            from hhmm_tpu.kernels.pallas_ffbs import pallas_ffbs
+            from hhmm_tpu.kernels.pallas_semiring import semiring_ffbs
 
-            return pallas_ffbs(log_pi, log_A, log_obs, mask, u, *gate)
-        if _pallas_chunked_eligible(log_pi, log_A, log_obs):
-            from hhmm_tpu.kernels.pallas_ffbs_chunked import pallas_ffbs_chunked
-
-            return pallas_ffbs_chunked(
+            # resident schedule: the whole window in one VMEM block
+            return semiring_ffbs(
                 log_pi, log_A, log_obs, mask, u, *gate,
-                t_chunk=chunk_for_k(log_obs.shape[2]),
+                t_block=log_obs.shape[1],
+            )
+        if _pallas_chunked_eligible(log_pi, log_A, log_obs):
+            from hhmm_tpu.kernels.pallas_semiring import semiring_ffbs
+
+            return semiring_ffbs(
+                log_pi, log_A, log_obs, mask, u, *gate,
+                t_block=chunk_for_k(log_obs.shape[2]),
             )
     return jax.vmap(
         lambda ui, pi, A, obs, m, *g: ffbs_invcdf_reference(pi, A, obs, m, ui, *g)

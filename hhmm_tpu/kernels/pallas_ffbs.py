@@ -1,160 +1,38 @@
-"""Fused Pallas TPU kernel: batched FFBS (forward filter + backward
-state sampling) in one kernel launch.
+"""DEPRECATED shim — the resident fused FFBS kernel now lives in the
+blocked semiring mega-kernel
+(`kernels/pallas_semiring.py::semiring_ffbs`).
 
-The blocked Gibbs sampler (`infer/gibbs.py`) is latency-bound by its two
-sequential ``lax.scan``s per draw — XLA sequences 2(T-1) microkernel loop
-iterations, exactly the overhead `kernels/pallas_forward.py` removes for
-the HMC gradient path. This kernel does the same for FFBS:
+Historical contract (kept verbatim): batched ``(z [B, T] int32,
+loglik [B])`` from pre-drawn uniforms ``u [B, T]`` (inverse-CDF draws,
+draw-for-draw identical to `kernels/ffbs.py::ffbs_invcdf_reference`),
+optional gate keys, masked-step carry-copy, ``A`` clamped at kernel
+entry so accidental −inf degrades instead of NaN. The "resident" VMEM
+staging is the unified kernel's single-block schedule (``t_block=T``).
 
-- layout identical to the vg kernel: batch on the 128-lane axis, K
-  states on sublanes, one grid step per 128-series tile, the forward
-  filter held in a VMEM scratch as the backward pass's residual;
-- backward *sampling* instead of backward smoothing: states are drawn
-  by inverse-CDF against pre-drawn uniforms ``u [T]`` (generated with
-  ``jax.random`` OUTSIDE the kernel — no in-kernel PRNG), with the
-  transition column ``A[:, z_{t+1}]`` selected by an unrolled masked
-  sum over the (static, small) K destinations;
-- optionally gated transitions (same mechanism as the vg kernels,
-  `kernels/vg.py` module docstring): the per-(step, destination) gate
-  ``c[t, j] = (gate_key[t] == state_key[j])`` multiplies ``log_A`` in
-  the forward filter, and the backward draw at step t applies the
-  ``A[:, z_{t+1}]`` factor only when ``z_{t+1}`` is gate-consistent at
-  step t+1 (`hhmm-tayal2009.stan:46-70` — an inconsistent successor
-  contributes a unit pairwise factor, so the draw falls back to the
-  filter alone, exactly like a masked successor);
-- outputs: ``z [T] (f32 lanes, cast to int32 outside)`` and the
-  marginal ``loglik [B]`` — the two things a Gibbs step needs.
-
-Masked steps follow the scan-kernel convention: padded steps copy the
-forward carry, and a state whose successor step is padding is drawn
-from the filter alone. The padded tail is overwritten with the last
-valid state by the wrapper (same as `kernels/ffbs.py`).
-
-The draw differs from ``jax.random.categorical`` (Gumbel) in its use of
-randomness but targets the identical distribution; parity with the JAX
-reference implementation `kernels/ffbs.py::ffbs_invcdf_reference` given
-the SAME uniforms is exact and pinned in interpreter mode
-(`tests/test_pallas_ffbs.py`).
+Do not import this module in new code: `kernels/dispatch.py` is the
+only sanctioned Pallas entry outside the kernels package (analysis
+rule ``pallas-import``); inside it, use
+`hhmm_tpu.kernels.pallas_semiring` directly.
 """
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional, Tuple
 
-import jax
 import jax.numpy as jnp
-from jax import lax
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+
+# legacy re-exports: the unrolled draw/select helpers historically
+# defined here (the chunked shim and probes imported them)
+from hhmm_tpu.kernels.pallas_semiring import (  # noqa: F401
+    _CLAMP,
+    _LANES,
+    _sample_invcdf,
+    _select_col,
+    _select_row,
+    semiring_ffbs,
+)
 
 __all__ = ["pallas_ffbs"]
-
-_LANES = 128
-_CLAMP = -1.0e30
-
-
-def _lse0(x):
-    m = jnp.maximum(jnp.max(x, axis=0), _CLAMP)
-    return m + jnp.log(jnp.sum(jnp.exp(x - m[None]), axis=0))
-
-
-def _sample_invcdf(logits, u):
-    """Inverse-CDF categorical draw over axis 0 of ``logits [K, B]``
-    using uniforms ``u [B]``: z = #{k : cum_k <= u}. Unrolled over the
-    static K axis."""
-    K = logits.shape[0]
-    p = jnp.exp(logits - _lse0(logits)[None])  # [K, B], sums to 1
-    z = jnp.zeros(u.shape, jnp.float32)
-    cum = jnp.zeros(u.shape, jnp.float32)
-    for k in range(K - 1):  # last bucket catches the remainder
-        cum = cum + p[k]
-        z = z + (u >= cum).astype(jnp.float32)
-    return z
-
-
-def _select_col(A, z_next):
-    """``A[:, z_next, :]`` per lane — unrolled masked sum over the
-    static K destinations. ``A [K, K, B]``, ``z_next [B] f32``."""
-    K = A.shape[0]
-    col = jnp.zeros((K, A.shape[2]), jnp.float32)
-    for j in range(K):
-        col = col + A[:, j, :] * (z_next[None] == float(j)).astype(jnp.float32)
-    return col
-
-
-def _select_row(sk, z_next):
-    """``sk[z_next]`` per lane over the static K axis. ``sk [K, B]``."""
-    out = jnp.zeros(z_next.shape, jnp.float32)
-    for j in range(sk.shape[0]):
-        out = out + sk[j] * (z_next == float(j)).astype(jnp.float32)
-    return out
-
-
-def _ffbs_kernel(
-    gated,
-    pi_ref,  # [K, B]
-    A_ref,  # [K, K, B]
-    obs_ref,  # [T, K, B]
-    mask_ref,  # [T, B]
-    u_ref,  # [T, B]
-    *refs,  # (+ gate_ref [T, B], sk_ref [K, B]), ll_ref, z_ref, alpha_scr
-):
-    if gated:
-        gate_ref, sk_ref, ll_ref, z_ref, alpha_scr = refs
-        sk = sk_ref[:]
-    else:
-        ll_ref, z_ref, alpha_scr = refs
-    T, K, B = obs_ref.shape
-    # clamp at kernel entry: a caller passing an accidental -inf in A
-    # would NaN both the unrolled column select (`0 * -inf` in
-    # _select_col) and the backward-draw logits (`g * Acol` with g = 0);
-    # at the clamp floor exp underflows to exactly 0, so bad input
-    # degrades to zero-probability paths instead of NaN-ing every draw.
-    # Model-produced inputs (safe_log / MASK_NEG floors) pass unchanged.
-    A = jnp.maximum(A_ref[:], _CLAMP)
-
-    def A_at(t):
-        if not gated:
-            return A
-        c_t = (gate_ref[t][None] == sk).astype(jnp.float32)  # [K(j), B]
-        return A * c_t[None, :, :]
-
-    # ---- forward filter (identical to pallas_forward.py) ----
-    m0 = mask_ref[0][None]
-    alpha = jnp.where(m0 > 0, pi_ref[:] + obs_ref[0], pi_ref[:])
-    alpha_scr[0] = alpha
-
-    def fwd_body(t, alpha):
-        new = _lse0(alpha[:, None, :] + A_at(t)) + obs_ref[t]
-        alpha = jnp.where(mask_ref[t][None] > 0, new, alpha)
-        alpha_scr[t] = alpha
-        return alpha
-
-    alpha = lax.fori_loop(1, T, fwd_body, alpha)
-    ll_ref[0] = _lse0(alpha)
-
-    # ---- backward sampling ----
-    z_last = _sample_invcdf(alpha, u_ref[T - 1])
-    z_ref[T - 1] = z_last
-
-    def bwd_body(i, z_next):
-        t = T - 2 - i  # T-2 .. 0
-        Acol = _select_col(A, z_next)
-        # transition factor applies only when step t+1 is unmasked AND
-        # (if gated) z_{t+1} is gate-consistent at t+1; else the draw
-        # falls back to the filter alone (unit pairwise factor)
-        g = (mask_ref[t + 1] > 0).astype(jnp.float32)  # [B]
-        if gated:
-            g = g * (gate_ref[t + 1] == _select_row(sk, z_next)).astype(
-                jnp.float32
-            )
-        logits = alpha_scr[t] + g[None] * Acol
-        z_t = _sample_invcdf(logits, u_ref[t])
-        z_ref[t] = z_t
-        return z_t
-
-    lax.fori_loop(0, T - 1, bwd_body, z_last)
 
 
 def pallas_ffbs(
@@ -168,55 +46,10 @@ def pallas_ffbs(
     *,
     interpret: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Batched fused FFBS: returns ``(z [B, T] int32, loglik [B])``.
-    Pads the batch to a multiple of 128 lanes; one grid step per tile."""
-    B, T, K = log_obs.shape
-    Bp = -(-B // _LANES) * _LANES
-    gated = gate_key is not None
-
-    def pad(x):
-        return jnp.pad(x, [(0, Bp - B)] + [(0, 0)] * (x.ndim - 1))
-
-    pi_t = pad(log_pi).transpose(1, 0)
-    A_t = pad(log_A).transpose(1, 2, 0)
-    obs_t = pad(log_obs).transpose(1, 2, 0)
-    mask_t = jnp.pad(mask, [(0, Bp - B), (0, 0)], constant_values=1.0).transpose(1, 0)
-    u_t = pad(u).transpose(1, 0)
-
-    grid = (Bp // _LANES,)
-
-    def lanes(*blk):
-        return pl.BlockSpec(
-            blk + (_LANES,),
-            index_map=lambda b: (0,) * len(blk) + (b,),
-            memory_space=pltpu.VMEM,
-        )
-
-    in_specs = [lanes(K), lanes(K, K), lanes(T, K), lanes(T), lanes(T)]
-    args = [pi_t, A_t, obs_t, mask_t, u_t]
-    if gated:
-        in_specs += [lanes(T), lanes(K)]
-        args += [
-            pad(gate_key.astype(jnp.float32)).transpose(1, 0),
-            pad(state_key.astype(jnp.float32)).transpose(1, 0),
-        ]
-
-    ll, z = pl.pallas_call(
-        partial(_ffbs_kernel, gated),
-        grid=grid,
-        in_specs=in_specs,
-        out_specs=(lanes(1), lanes(T)),
-        out_shape=(
-            jax.ShapeDtypeStruct((1, Bp), jnp.float32),
-            jax.ShapeDtypeStruct((T, Bp), jnp.float32),
-        ),
-        scratch_shapes=[pltpu.VMEM((T, K, _LANES), jnp.float32)],
-        interpret=interpret,
-    )(*args)
-
-    z = z.transpose(1, 0)[:B].astype(jnp.int32)  # [B, T]
-    # padded tail: repeat the last valid state (scan-kernel convention)
-    T_last = jnp.sum(mask, axis=1).astype(jnp.int32) - 1  # [B]
-    last = jnp.take_along_axis(z, T_last[:, None], axis=1)  # [B, 1]
-    z = jnp.where(jnp.arange(T)[None, :] <= T_last[:, None], z, last)
-    return z, ll[0, :B]
+    """Batched fused FFBS — the unified blocked kernel at its
+    single-block (fully VMEM-resident) schedule."""
+    T = log_obs.shape[1]
+    return semiring_ffbs(
+        log_pi, log_A, log_obs, mask, u, gate_key, state_key,
+        t_block=T, interpret=interpret,
+    )

@@ -1,112 +1,31 @@
-"""Chunked-T Pallas TPU kernel: fused FFBS for LONG sequences.
+"""DEPRECATED shim — the chunked-T fused FFBS kernel now lives in the
+blocked semiring mega-kernel
+(`kernels/pallas_semiring.py::semiring_ffbs`), whose pass 2 applies
+the per-step inverse-CDF sampling maps over reversed blocks — the
+K-ary map algebra of `kernels/semiring.py` run as a blocked scan.
 
-`kernels/pallas_ffbs.py` holds the whole [T, K, 128] filter residual in
-VMEM, capping it at T*K <= 4096 — but the flagship conjugate-Gibbs
-workload (the Tayal soft-gate sampler on real tick windows,
-`hhmm-tayal2009.stan:46-70` semantics at T ≈ 8-12k zig-zag legs) runs
-far past that, where the dispatcher used to fall back to the scan pair
-at ~2(T-1) sequenced microkernels per draw. This kernel streams the
-time axis, reusing the chunked-vg machinery
-(`kernels/pallas_forward_chunked.py`):
+Historical contract (kept verbatim): batched ``(z [B, T] int32,
+loglik [B])`` for long T from pre-drawn uniforms, time axis streamed
+in ``t_chunk`` blocks, gating/masking identical to the resident form.
+(The chunked path additionally gained the resident kernel's entry
+clamp on ``A`` — accidental −inf now degrades instead of NaN on every
+schedule.)
 
-- pass 1 IS the chunked forward filter shared with the vg kernel
-  (`_run_chunked_forward`): grid ``(batch_tile, t_chunk)`` with the
-  time axis minor (sequential on TPU, so VMEM scratch persists across
-  the t-chunks of one batch tile), per-step alpha written chunk by
-  chunk to an HBM residual;
-- pass 2 walks the chunks in REVERSED order (index_map ``nc-1-c``) and
-  *samples* instead of smoothing: inverse-CDF draws against pre-drawn
-  uniforms (identical math to the resident kernel). The only state
-  crossing a chunk boundary is the previously drawn state ``z_{t+1}``
-  plus that step's mask/gate rows — three [1, 128] scratch carries
-  written at local t=0 of each chunk and consumed at local t=Tc-1 of
-  the next grid step.
-
-Gating and masking semantics are identical to the resident kernel: a
-masked or gate-inconsistent successor contributes a unit pairwise
-factor (draw from the filter alone); the padded tail is overwritten by
-the wrapper. Draw parity with `kernels/ffbs.py::ffbs_invcdf_reference`
-given the same uniforms is exact — chunking changes the schedule, not
-a single arithmetic operation — and pinned across chunk boundaries in
-interpreter mode plus one on-device record (`tests/test_pallas_ffbs.py`,
-`results/`).
-
-VMEM per grid step in pass 2 at ``t_chunk=512``, K=4: one [Tc, K, 128]
-alpha block (~1 MB) + four [Tc, 128] rows + small blocks, double-
-buffered — lighter than the vg backward. The HBM residual is
-[Tp, K, 128] per tile (~17 MB at T=8.4k), streamed once.
+Do not import this module in new code: `kernels/dispatch.py` is the
+only sanctioned Pallas entry outside the kernels package (analysis
+rule ``pallas-import``); inside it, use
+`hhmm_tpu.kernels.pallas_semiring` directly.
 """
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional, Tuple
 
-import jax
 import jax.numpy as jnp
-from jax import lax
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
-from hhmm_tpu.kernels.pallas_ffbs import _sample_invcdf, _select_col, _select_row
-from hhmm_tpu.kernels.pallas_forward_chunked import (
-    _LANES,
-    _fixed,
-    _pad_chunked,
-    _run_chunked_forward,
-    _t_rev,
-)
+from hhmm_tpu.kernels.pallas_semiring import semiring_ffbs
 
 __all__ = ["pallas_ffbs_chunked"]
-
-
-def _bwd_sample_kernel(
-    gated,
-    A_ref,  # [K, K, B]
-    mask_ref,  # [Tc, B]    (reversed chunk order)
-    alpha_ref,  # [Tc, K, B] (reversed chunk order)
-    u_ref,  # [Tc, B]    (reversed chunk order)
-    *refs,  # (+ gate_ref [Tc, B], sk_ref [K, B]), z_ref, zc, mc, gc
-):
-    if gated:
-        gate_ref, sk_ref, z_ref, zc, mc, gc = refs
-        sk = sk_ref[:]
-    else:
-        z_ref, zc, mc, gc = refs
-    Tc, K, B = alpha_ref.shape
-    A = A_ref[:]
-    c = pl.program_id(1)
-
-    # last chunk (first grid step): draw the final state from the filter
-    @pl.when(c == 0)
-    def _():
-        z_last = _sample_invcdf(alpha_ref[Tc - 1], u_ref[Tc - 1])
-        z_ref[Tc - 1] = z_last
-        zc[0] = z_last
-
-    def body(i, z_next):
-        t = Tc - 1 - i
-        # at the chunk boundary (local t=Tc-1, only reached when c > 0)
-        # the successor's mask/gate rows live in the carries written by
-        # the previous grid step; inside the chunk they are local rows
-        boundary = t == Tc - 1
-        tn = jnp.minimum(t + 1, Tc - 1)
-        m_next = jnp.where(boundary, mc[0], mask_ref[tn])
-        g = (m_next > 0).astype(jnp.float32)  # [B]
-        if gated:
-            g_next = jnp.where(boundary, gc[0], gate_ref[tn])
-            g = g * (g_next == _select_row(sk, z_next)).astype(jnp.float32)
-        logits = alpha_ref[t] + g[None] * _select_col(A, z_next)
-        z_t = _sample_invcdf(logits, u_ref[t])
-        z_ref[t] = z_t
-        return z_t
-
-    start = jnp.where(c == 0, 1, 0)
-    z0 = lax.fori_loop(start, Tc, body, zc[0])
-    zc[0] = z0
-    mc[0] = mask_ref[0]
-    if gated:
-        gc[0] = gate_ref[0]
 
 
 def pallas_ffbs_chunked(
@@ -121,50 +40,9 @@ def pallas_ffbs_chunked(
     t_chunk: int = 512,
     interpret: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Batched fused FFBS for long T: ``(z [B, T] int32, loglik [B])``.
-    Pads the batch to 128 lanes and T to a ``t_chunk`` multiple; padded
-    time steps are mask-0 (carry-copy forward, filter-alone draws
-    backward) so the draws at real steps match the unpadded reference
-    exactly, and the padded tail is overwritten below."""
-    B, T, K = log_obs.shape
-    Tc = t_chunk
-    gated = gate_key is not None
-    pi_t, A_t, obs_t, mask_t, gate_t, sk_t, Bp, Tp, nc = _pad_chunked(
-        log_pi, log_A, log_obs, mask, gate_key, state_key, Tc
+    """Batched fused FFBS for long T — the unified blocked kernel at
+    an explicit ``t_chunk`` block size."""
+    return semiring_ffbs(
+        log_pi, log_A, log_obs, mask, u, gate_key, state_key,
+        t_block=t_chunk, interpret=interpret,
     )
-    u_t = jnp.pad(
-        jnp.pad(u, [(0, Bp - B), (0, 0)]), [(0, 0), (0, Tp - T)]
-    ).transpose(1, 0)  # [Tp, Bp]
-    grid = (Bp // _LANES, nc)
-
-    # ---- pass 1: shared chunked forward filter, residual to HBM ----
-    ll, alpha_all = _run_chunked_forward(
-        pi_t, A_t, obs_t, mask_t, gate_t, sk_t, grid, Tc, interpret
-    )
-
-    # ---- pass 2: backward sampling over reversed chunks ----
-    bwd_in = [_fixed(K, K), _t_rev(nc, Tc), _t_rev(nc, Tc, K), _t_rev(nc, Tc)]
-    bwd_args = [A_t, mask_t, alpha_all, u_t]
-    if gated:
-        bwd_in += [_t_rev(nc, Tc), _fixed(K)]
-        bwd_args += [gate_t, sk_t]
-    (z,) = pl.pallas_call(
-        partial(_bwd_sample_kernel, gated),
-        grid=grid,
-        in_specs=bwd_in,
-        out_specs=(_t_rev(nc, Tc),),
-        out_shape=(jax.ShapeDtypeStruct((Tp, Bp), jnp.float32),),
-        scratch_shapes=[
-            pltpu.VMEM((1, _LANES), jnp.float32),  # z carry
-            pltpu.VMEM((1, _LANES), jnp.float32),  # mask carry
-            pltpu.VMEM((1, _LANES), jnp.float32),  # gate carry
-        ],
-        interpret=interpret,
-    )(*bwd_args)
-
-    z = z.transpose(1, 0)[:B, :T].astype(jnp.int32)  # [B, T]
-    # padded tail: repeat the last valid state (scan-kernel convention)
-    T_last = jnp.sum(mask, axis=1).astype(jnp.int32) - 1  # [B]
-    last = jnp.take_along_axis(z, T_last[:, None], axis=1)
-    z = jnp.where(jnp.arange(T)[None, :] <= T_last[:, None], z, last)
-    return z, ll[0, :B]

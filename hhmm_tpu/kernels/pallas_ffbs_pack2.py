@@ -1,150 +1,31 @@
-"""Sublane-packed Pallas FFBS: TWO series per 128-lane tile.
+"""DEPRECATED shim — the sublane-packed (2-series-per-tile) FFBS
+experiment is retired; calls route to the blocked semiring mega-kernel
+(`kernels/pallas_semiring.py::semiring_ffbs`).
 
-The resident FFBS kernel (`kernels/pallas_ffbs.py`) lays states on
-sublanes — at K=4 that uses 4 of the 8 f32 sublanes, and a B-series
-batch runs ``B/128`` sequential grid steps of ``2(T-1)`` loop
-iterations each (the TPU grid is sequential, and these kernels are
-latency-bound: bench roofline records peak_fraction ~1e-3). This
-variant packs series PAIRS along the sublane axis (VERDICT r4 ask 5):
+The pack2 layout stacked two series' K states on 2K sublanes to raise
+tile occupancy at small K. The measured verdict
+(`scripts/tpu_pack2_probe.py`, results/) never justified promoting it
+over the plain 128-lane layout, and the unified kernel's blocked
+schedule subsumed the launch-count argument. Draws are unchanged: the
+inverse-CDF math against pre-drawn uniforms is identical in every
+schedule, so this shim is draw-for-draw compatible with the packed
+kernel it replaces.
 
-- lane b of a tile holds series ``(pair_tile, b)`` in sublane rows
-  0..K-1 (half 0) and series ``(pair_tile, b + 128·tiles)`` in rows
-  K..2K-1 (half 1) — alpha/obs blocks are ``[T, 2K, 128]`` full tiles;
-- the transition matrix is packed block-diagonally OUTSIDE the kernel
-  (``A_blk [2K, 2K]`` per lane, off-blocks at the MASK_NEG clamp), so
-  the forward update ``lse_i(alpha[i] + A_blk[i, j])`` never mixes the
-  halves — the elementwise body runs on full tiles with HALF the grid
-  steps of the unpacked kernel;
-- the only per-half operations are the normalizations: the final
-  loglik and each backward draw's inverse-CDF normalize within a half
-  (static slices — the same [K, 128] work the unpacked kernel does,
-  paid once per step instead of once per step per tile);
-- per-series step data (mask, uniforms, gate key, drawn states) ride
-  as ``[T, 2, 128]`` rows, broadcast to the K sublane rows of their
-  half in-kernel (`_rep`).
-
-Semantics (masked-step carry-copy, gate-inconsistent successor = unit
-pairwise factor, padded-tail overwrite) are identical to the unpacked
-kernel; draws given the same uniforms are exactly equal, pinned in
-interpreter mode by `tests/test_pallas_ffbs.py::TestPack2`. Whether
-packing wins on hardware is an empirical question recorded by
-`scripts/tpu_pack2_probe.py` — the dispatcher only adopts it where
-measured faster.
+Do not import this module in new code: `kernels/dispatch.py` is the
+only sanctioned Pallas entry outside the kernels package (analysis
+rule ``pallas-import``); inside it, use
+`hhmm_tpu.kernels.pallas_semiring` directly.
 """
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional, Tuple
 
-import jax
 import jax.numpy as jnp
-from jax import lax
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
-from hhmm_tpu.kernels.pallas_forward import _CLAMP, _LANES, _lse0
+from hhmm_tpu.kernels.pallas_semiring import semiring_ffbs
 
 __all__ = ["pallas_ffbs_pack2"]
-
-
-def _rep(row2, K):
-    """[2, B] per-series rows -> [2K, B]: each half's row broadcast to
-    its K sublane rows."""
-    return jnp.repeat(row2, K, axis=0)
-
-
-def _half_lse(x, K):
-    """Per-half logsumexp over sublanes of ``x [2K, B]`` -> [2, B]."""
-    return jnp.stack([_lse0(x[:K]), _lse0(x[K:])])
-
-
-def _half_invcdf(logits, u2, K):
-    """Inverse-CDF draw per half: ``logits [2K, B]``, ``u2 [2, B]`` ->
-    ``z2 [2, B]`` in 0..K-1 (local state index within the half)."""
-    p = jnp.exp(logits - _rep(_half_lse(logits, K), K))
-    z2 = jnp.zeros(u2.shape, jnp.float32)
-    cum = jnp.zeros(u2.shape, jnp.float32)
-    for k in range(K - 1):
-        cum = cum + jnp.stack([p[k], p[K + k]])
-        z2 = z2 + (u2 >= cum).astype(jnp.float32)
-    return z2
-
-
-def _ffbs_pack2_kernel(
-    gated,
-    K,  # static: states per series (sublane rows per half)
-    pi_ref,  # [2K, B]
-    A_ref,  # [2K, 2K, B] block-diagonal per lane
-    obs_ref,  # [T, 2K, B]
-    mask_ref,  # [T, 2, B]
-    u_ref,  # [T, 2, B]
-    *refs,  # (+ gate_ref [T, 2, B], sk_ref [2K, B]), ll_ref, z_ref, alpha_scr
-):
-    if gated:
-        gate_ref, sk_ref, ll_ref, z_ref, alpha_scr = refs
-        sk = sk_ref[:]
-    else:
-        ll_ref, z_ref, alpha_scr = refs
-    T = obs_ref.shape[0]
-    A = A_ref[:]
-    if gated:
-        # the gate's unit factor (A * 0) must NOT reopen the clamped
-        # off-diagonal blocks — cross-half leakage; gate within blocks
-        ri = lax.broadcasted_iota(jnp.float32, (2 * K, 2 * K, 1), 0)
-        rj = lax.broadcasted_iota(jnp.float32, (2 * K, 2 * K, 1), 1)
-        same_half = ((ri < K) == (rj < K)).astype(jnp.float32)
-
-    def A_at(t):
-        if not gated:
-            return A
-        c_t = (_rep(gate_ref[t], K) == sk).astype(jnp.float32)  # [2K, B]
-        return jnp.where(same_half > 0, A * c_t[None, :, :], A)
-
-    # ---- forward filter: full-tile body, halves never mix (block-diag A)
-    m0 = _rep(mask_ref[0], K)
-    alpha = jnp.where(m0 > 0, pi_ref[:] + obs_ref[0], pi_ref[:])
-    alpha_scr[0] = alpha
-
-    def fwd_body(t, alpha):
-        new = _lse0(alpha[:, None, :] + A_at(t)) + obs_ref[t]
-        alpha = jnp.where(_rep(mask_ref[t], K) > 0, new, alpha)
-        alpha_scr[t] = alpha
-        return alpha
-
-    alpha = lax.fori_loop(1, T, fwd_body, alpha)
-    ll_ref[:] = _half_lse(alpha, K)  # [2, B] per-series logliks
-
-    # ---- backward sampling: per-half inverse-CDF draws ----
-    z_last = _half_invcdf(alpha, u_ref[T - 1], K)
-    z_ref[T - 1] = z_last
-
-    # row-half indicator (pallas kernels cannot capture host constants)
-    row_iota = lax.broadcasted_iota(jnp.float32, (2 * K, 1), 0)
-
-    def bwd_body(i, z2_next):
-        t = T - 2 - i
-        # A[:, z_{t+1}]: each sublane row selects its own half's column
-        # — global column index = local successor + K for half-1 rows
-        zglob = _rep(z2_next, K) + jnp.float32(K) * (row_iota >= K).astype(
-            jnp.float32
-        )  # [2K, B]
-        Acol = jnp.zeros(A.shape[::2], jnp.float32)  # [2K, B]
-        for j in range(2 * K):
-            Acol = Acol + A[:, j, :] * (zglob == float(j)).astype(jnp.float32)
-        g2 = (mask_ref[t + 1] > 0).astype(jnp.float32)  # [2, B]
-        if gated:
-            sk_at_z = jnp.zeros(z2_next.shape, jnp.float32)  # [2, B]
-            for j in range(K):
-                sel2 = (z2_next == float(j)).astype(jnp.float32)
-                sk_at_z = sk_at_z + jnp.stack([sk[j], sk[K + j]]) * sel2
-            g2 = g2 * (gate_ref[t + 1] == sk_at_z).astype(jnp.float32)
-        logits = alpha_scr[t] + _rep(g2, K) * Acol
-        z2 = _half_invcdf(logits, u_ref[t], K)
-        z_ref[t] = z2
-        return z2
-
-    lax.fori_loop(0, T - 1, bwd_body, z_last)
 
 
 def pallas_ffbs_pack2(
@@ -158,77 +39,10 @@ def pallas_ffbs_pack2(
     *,
     interpret: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Batched fused FFBS with 2 series per tile: ``(z [B, T] int32,
-    loglik [B])``. Pads the batch to a multiple of 256 (2 x 128 lanes);
-    series ``i`` and ``i + half`` share tile ``i // 128``'s lanes."""
-    B, T, K = log_obs.shape
-    Bp = -(-B // (2 * _LANES)) * (2 * _LANES)
-    half = Bp // 2
-    gated = gate_key is not None
-
-    def pad(x):
-        return jnp.pad(x, [(0, Bp - B)] + [(0, 0)] * (x.ndim - 1))
-
-    def pack_states(x):
-        """[Bp, ..., K] -> [..., 2K, Bp/2]: halves stacked on sublanes."""
-        x2 = jnp.stack([x[:half], x[half:]])  # [2, half, ..., K]
-        # -> [..., 2, K, half] -> [..., 2K, half]
-        x2 = jnp.moveaxis(x2, (0, 1), (-3, -1))  # [..., 2, K, half]
-        return x2.reshape(x2.shape[:-3] + (2 * K, half))
-
-    def pack_rows(x):
-        """[Bp, T] -> [T, 2, Bp/2] per-series step rows."""
-        return jnp.stack([x[:half], x[half:]], axis=1).transpose(2, 1, 0)
-
-    pi_t = pack_states(pad(log_pi))  # [2K, half]
-    obs_t = pack_states(pad(log_obs))  # [T, 2K, half]
-    # block-diagonal per-lane A: [2K, 2K, half], off-blocks clamped
-    A_p = pad(log_A)
-    blk = jnp.full((Bp // 2, 2 * K, 2 * K), _CLAMP, log_A.dtype)
-    blk = blk.at[:, :K, :K].set(A_p[:half])
-    blk = blk.at[:, K:, K:].set(A_p[half:])
-    A_t = blk.transpose(1, 2, 0)
-    mask_t = pack_rows(
-        jnp.pad(mask, [(0, Bp - B), (0, 0)], constant_values=1.0)
+    """Batched fused FFBS — routed to the unified blocked kernel (the
+    pack2 packing is retired; draws are identical)."""
+    T = log_obs.shape[1]
+    return semiring_ffbs(
+        log_pi, log_A, log_obs, mask, u, gate_key, state_key,
+        t_block=T, interpret=interpret,
     )
-    u_t = pack_rows(pad(u))
-
-    grid = (half // _LANES,)
-
-    def lanes(*blk_shape):
-        return pl.BlockSpec(
-            blk_shape + (_LANES,),
-            index_map=lambda b: (0,) * len(blk_shape) + (b,),
-            memory_space=pltpu.VMEM,
-        )
-
-    in_specs = [lanes(2 * K), lanes(2 * K, 2 * K), lanes(T, 2 * K),
-                lanes(T, 2), lanes(T, 2)]
-    args = [pi_t, A_t, obs_t, mask_t, u_t]
-    if gated:
-        in_specs += [lanes(T, 2), lanes(2 * K)]
-        args += [
-            pack_rows(pad(gate_key.astype(jnp.float32))),
-            pack_states(pad(state_key.astype(jnp.float32))),
-        ]
-
-    ll, z = pl.pallas_call(
-        partial(_ffbs_pack2_kernel, gated, K),
-        grid=grid,
-        in_specs=in_specs,
-        out_specs=(lanes(2), lanes(T, 2)),
-        out_shape=(
-            jax.ShapeDtypeStruct((2, half), jnp.float32),
-            jax.ShapeDtypeStruct((T, 2, half), jnp.float32),
-        ),
-        scratch_shapes=[pltpu.VMEM((T, 2 * K, _LANES), jnp.float32)],
-        interpret=interpret,
-    )(*args)
-
-    # unpack: [T, 2, half] -> [Bp, T]
-    z = z.transpose(1, 2, 0).reshape(Bp, T)[:B].astype(jnp.int32)
-    ll = ll.reshape(Bp)[:B]
-    T_last = jnp.sum(mask, axis=1).astype(jnp.int32) - 1
-    last = jnp.take_along_axis(z, T_last[:, None], axis=1)
-    z = jnp.where(jnp.arange(T)[None, :] <= T_last[:, None], z, last)
-    return z, ll

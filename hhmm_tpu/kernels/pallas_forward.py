@@ -1,141 +1,38 @@
-"""Fused Pallas TPU kernel: batched forward + backward + gradients.
+"""DEPRECATED shim — the resident fused forward+backward+gradients
+kernel now lives in the blocked semiring mega-kernel
+(`kernels/pallas_semiring.py::semiring_vg`).
 
-The NUTS hot loop evaluates (loglik, gradients) of the marginalized
-forward recursion at every leapfrog step. Under XLA, the vmapped
-``lax.scan`` pair costs 2(T-1) sequenced loop iterations whose bodies
-are a few-microsecond elementwise kernels — loop sequencing dominates
-(SURVEY.md §3.1: the hot loop is the forward recursion, evaluated at
-every leapfrog of every NUTS iteration). This kernel runs the WHOLE
-forward + backward time loop inside one ``pallas_call``:
+Historical contract (kept verbatim): batched ``(loglik, d_pi, d_A,
+d_obs)`` with batch on the 128-lane axis, K states on sublanes,
+optional gated transitions from a [T] key per series, masked-step
+carry-copy, finite-input clamp semantics. The "resident" VMEM staging
+is the unified kernel's single-block schedule (``t_block=T``); the
+restrictions the `kernels/vg.py` dispatcher enforces (homogeneous f32,
+T*K <= 4096) are unchanged.
 
-- layout: batch on the 128-wide lane axis, K states on sublanes. One
-  grid step owns a 128-series tile; all state lives in VMEM/registers.
-- forward pass: ``alpha`` carried functionally through a
-  ``fori_loop``, per-step filter stored to a VMEM scratch (the backward
-  residual — never round-trips to HBM);
-- backward pass: a reverse ``fori_loop`` carrying ``beta`` and the
-  expected-transition-count accumulator ``d_A`` (the xi sums are
-  accumulated on the fly — the [T,K,K] intermediate of the pure-JAX
-  VJP is never materialized);
-- outputs: ``loglik [B]``, ``d_pi [B,K]``, ``d_A [B,K,K]``,
-  ``d_obs [B,T,K]`` — the Baum-Welch identities (kernels/grad.py);
-- optionally gated transitions (`kernels/vg.py` module docstring): the
-  per-(step, destination) gate ``c[t,j] = (gate_key[t] == state_key[j])``
-  multiplies ``log_A`` — the Tayal sign-gating / semisup group-evidence
-  semantics — computed in-kernel from a [T] key per series.
-
-Restrictions (dispatcher `kernels/vg.py:_pallas_eligible` enforces):
-homogeneous transitions, f32, T*K <= 4096 (VMEM blocks). Semantics —
-including masked-step carry-copy and the MASK_NEG gating convention —
-match the lax.scan kernels; `tests/test_pallas.py` pins equality in
-interpreter mode, and the TPU path is exercised by bench.py.
-
-Inputs may not contain true -inf (models use `core.lmath.safe_log` /
-``MASK_NEG``, so they never do); the max-subtracted logsumexp here
-clamps at -1e30 to keep padding lanes finite, and the gate multiplies
-``log_A`` (``-inf * 0`` would be NaN).
+Do not import this module in new code: `kernels/dispatch.py` is the
+only sanctioned Pallas entry outside the kernels package (analysis
+rule ``pallas-import``); inside it, use
+`hhmm_tpu.kernels.pallas_semiring` directly.
 """
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional, Tuple
 
-import jax
 import jax.numpy as jnp
-from jax import lax
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+
+# legacy re-exports: the clamp/lane constants and clamped reductions
+# other (also deprecated) shims historically imported from here
+from hhmm_tpu.kernels.pallas_semiring import (  # noqa: F401
+    _CLAMP,
+    _LANES,
+    _lse0,
+    _lse1,
+    semiring_vg,
+)
 
 __all__ = ["pallas_forward_vg"]
-
-_LANES = 128
-_CLAMP = -1.0e30
-
-
-def _lse0(x):
-    """logsumexp over axis 0 with clamped max."""
-    m = jnp.maximum(jnp.max(x, axis=0), _CLAMP)
-    return m + jnp.log(jnp.sum(jnp.exp(x - m[None]), axis=0))
-
-
-def _lse1(x):
-    """logsumexp over axis 1 of [K, K, B] with clamped max."""
-    m = jnp.maximum(jnp.max(x, axis=1), _CLAMP)
-    return m + jnp.log(jnp.sum(jnp.exp(x - m[:, None, :]), axis=1))
-
-
-def _fused_kernel(
-    gated,  # static: whether gate refs are present
-    pi_ref,  # [K, B]
-    A_ref,  # [K, K, B]
-    obs_ref,  # [T, K, B]
-    mask_ref,  # [T, B]
-    *refs,  # (+ gate_ref [T, B], sk_ref [K, B] if gated), outputs, scratch
-):
-    if gated:
-        gate_ref, sk_ref, ll_ref, dpi_ref, dA_ref, dobs_ref, alpha_scr = refs
-        sk = sk_ref[:]  # [K, B]
-    else:
-        ll_ref, dpi_ref, dA_ref, dobs_ref, alpha_scr = refs
-    T, K, B = obs_ref.shape
-    A = A_ref[:]
-
-    def A_at(t):
-        """Transition factor entering step t (possibly gated per dest j)."""
-        if not gated:
-            return A
-        c_t = (gate_ref[t][None] == sk).astype(jnp.float32)  # [K(j), B]
-        return A * c_t[None, :, :], c_t
-
-    # ---- forward: alpha_t, stored per-step to scratch ----
-    m0 = mask_ref[0][None]  # [1, B]
-    alpha = jnp.where(m0 > 0, pi_ref[:] + obs_ref[0], pi_ref[:])
-    alpha_scr[0] = alpha
-
-    def fwd_body(t, alpha):
-        Ag = A_at(t)[0] if gated else A
-        new = _lse0(alpha[:, None, :] + Ag) + obs_ref[t]  # [K(j), B]
-        alpha = jnp.where(mask_ref[t][None] > 0, new, alpha)
-        alpha_scr[t] = alpha
-        return alpha
-
-    alpha = lax.fori_loop(1, T, fwd_body, alpha)
-    ll = _lse0(alpha)  # [B]
-    ll_ref[0] = ll
-
-    # ---- backward: beta + on-the-fly gradient accumulation ----
-    beta0 = jnp.zeros((K, B), jnp.float32)
-    dA0 = jnp.zeros((K, K, B), jnp.float32)
-
-    def bwd_body(i, carry):
-        beta, dA = carry
-        t = T - 1 - i  # T-1 .. 1
-        m_t = mask_ref[t][None]  # [1, B]
-        m01 = (m_t > 0).astype(jnp.float32)
-        gamma_t = jnp.exp(alpha_scr[t] + beta - ll[None]) * m01
-        dobs_ref[t] = gamma_t
-        e = obs_ref[t] + beta  # [K, B]
-        if gated:
-            Ag, c_t = A_at(t)
-            xi = jnp.exp(
-                alpha_scr[t - 1][:, None, :] + Ag + e[None, :, :] - ll[None, None, :]
-            ) * c_t[None]
-        else:
-            Ag = A
-            xi = jnp.exp(
-                alpha_scr[t - 1][:, None, :] + Ag + e[None, :, :] - ll[None, None, :]
-            )
-        dA = dA + xi * m01[None]
-        new_beta = _lse1(Ag + e[None, :, :])  # [K(i), B]
-        beta = jnp.where(m_t > 0, new_beta, beta)
-        return beta, dA
-
-    beta, dA = lax.fori_loop(0, T - 1, bwd_body, (beta0, dA0))
-    gamma0 = jnp.exp(alpha_scr[0] + beta - ll[None])
-    dpi_ref[:] = gamma0
-    dobs_ref[0] = gamma0 * (mask_ref[0][None] > 0).astype(jnp.float32)
-    dA_ref[:] = dA
 
 
 def pallas_forward_vg(
@@ -148,59 +45,10 @@ def pallas_forward_vg(
     *,
     interpret: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Batched fused (loglik, d_pi, d_A, d_obs). Pads the batch to a
-    multiple of 128 lanes; one grid step per 128-series tile."""
-    B, T, K = log_obs.shape
-    Bp = -(-B // _LANES) * _LANES
-    gated = gate_key is not None
-
-    # batch -> lanes (last axis); pad with zeros (mask=1, harmless finite
-    # values — padded lanes produce garbage that is sliced away)
-    def pad(x):
-        return jnp.pad(x, [(0, Bp - B)] + [(0, 0)] * (x.ndim - 1))
-
-    pi_t = pad(log_pi).transpose(1, 0)  # [K, Bp]
-    A_t = pad(log_A).transpose(1, 2, 0)  # [K, K, Bp]
-    obs_t = pad(log_obs).transpose(1, 2, 0)  # [T, K, Bp]
-    mask_t = jnp.pad(mask, [(0, Bp - B), (0, 0)], constant_values=1.0).transpose(1, 0)
-
-    grid = (Bp // _LANES,)
-
-    def lanes(*blk):
-        """BlockSpec with all leading dims whole and lanes tiled."""
-        return pl.BlockSpec(
-            blk + (_LANES,),
-            index_map=lambda b: (0,) * len(blk) + (b,),
-            memory_space=pltpu.VMEM,
-        )
-
-    in_specs = [lanes(K), lanes(K, K), lanes(T, K), lanes(T)]
-    args = [pi_t, A_t, obs_t, mask_t]
-    if gated:
-        gate_t = pad(gate_key.astype(jnp.float32)).transpose(1, 0)  # [T, Bp]
-        sk_t = pad(state_key.astype(jnp.float32)).transpose(1, 0)  # [K, Bp]
-        in_specs += [lanes(T), lanes(K)]
-        args += [gate_t, sk_t]
-
-    out_shape = (
-        jax.ShapeDtypeStruct((1, Bp), jnp.float32),  # ll
-        jax.ShapeDtypeStruct((K, Bp), jnp.float32),  # d_pi
-        jax.ShapeDtypeStruct((K, K, Bp), jnp.float32),  # d_A
-        jax.ShapeDtypeStruct((T, K, Bp), jnp.float32),  # d_obs
-    )
-    ll, dpi, dA, dobs = pl.pallas_call(
-        partial(_fused_kernel, gated),
-        grid=grid,
-        in_specs=in_specs,
-        out_specs=(lanes(1), lanes(K), lanes(K, K), lanes(T, K)),
-        out_shape=out_shape,
-        scratch_shapes=[pltpu.VMEM((T, K, _LANES), jnp.float32)],
-        interpret=interpret,
-    )(*args)
-
-    return (
-        ll[0, :B],
-        dpi.transpose(1, 0)[:B],
-        dA.transpose(2, 0, 1)[:B],
-        dobs.transpose(2, 0, 1)[:B],
+    """Batched fused (loglik, d_pi, d_A, d_obs) — the unified blocked
+    kernel at its single-block (fully VMEM-resident) schedule."""
+    T = log_obs.shape[1]
+    return semiring_vg(
+        log_pi, log_A, log_obs, mask, gate_key, state_key,
+        t_block=T, interpret=interpret,
     )

@@ -1,268 +1,39 @@
-"""Chunked-T Pallas TPU kernel: fused forward+backward+gradients for
-LONG sequences.
+"""DEPRECATED shim — the chunked-T fused value-and-grad kernel now
+lives in the blocked semiring mega-kernel
+(`kernels/pallas_semiring.py::semiring_vg`), where the chunked grid
+``(batch_tile, t_block)`` with sequential time-minor iteration IS the
+unified schedule shared by filter/Viterbi/FFBS/vg.
 
-`kernels/pallas_forward.py` keeps the whole [T, K, 128] observation
-block and the alpha residual in VMEM, which caps it at T*K <= 4096 —
-real Tayal windows run to ~12k zig-zag legs (the walk-forward fit
-phase), where the dispatcher fell back to XLA scans. This kernel
-streams the time axis instead:
+Historical contract (kept verbatim): batched ``(loglik, d_pi, d_A,
+d_obs)`` for long T, time axis streamed in ``t_chunk`` blocks, alpha
+residual to HBM, gradients accumulated across reversed blocks.
 
-- grid ``(batch_tile, t_chunk)`` with the time axis minor — on TPU the
-  minor grid dimension iterates sequentially, so VMEM scratch persists
-  across t-chunks of one batch tile (the standard accumulation
-  pattern): the filter state ``alpha`` [K, 128] carries forward across
-  chunks, the smoother state ``beta`` carries backward.
-- pass 1 (forward) writes the per-step filter to an HBM residual
-  (``alpha_all``) chunk by chunk; pass 2 (backward) re-reads it in
-  REVERSED chunk order (index_map ``nc-1-c``) plus a one-chunk lookback
-  block for the ``alpha[t-1]`` needed at chunk boundaries, and
-  accumulates ``d_A`` in its persistent output block.
-- semantics (masked-step carry-copy, optional per-destination gating
-  from a [T] key, clamped logsumexp) are identical to the resident
-  kernel and the lax.scan reference; parity is pinned in interpreter
-  mode by `tests/test_pallas.py::TestChunkedKernel` across chunk
-  boundaries, ragged masks, and gating.
-
-VMEM per grid step at the default ``t_chunk=512`` (K=4): ~1 MB per
-[Tc, K, 128] block x (obs + alpha + lookback + d_obs) + small blocks,
-double-buffered — comfortably inside the ~16 MB budget.
+Do not import this module in new code: `kernels/dispatch.py` is the
+only sanctioned Pallas entry outside the kernels package (analysis
+rule ``pallas-import``); inside it, use
+`hhmm_tpu.kernels.pallas_semiring` directly.
 """
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional, Tuple
 
-import jax
 import jax.numpy as jnp
-from jax import lax
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
-# shared lane width, clamp, and clamped-logsumexp helpers: the two
-# kernels are dispatcher-interchangeable, so their numerics must come
-# from one definition
-from hhmm_tpu.kernels.pallas_forward import _CLAMP, _LANES, _lse0, _lse1
+# legacy re-exports: the blocked-grid plumbing historically defined
+# here (tests and the alpha_fused op imported these names)
+from hhmm_tpu.kernels.pallas_semiring import (  # noqa: F401
+    _LANES,
+    _fixed,
+    _pad_chunked,
+    _run_chunked_forward,
+    _t_fwd,
+    _t_rev,
+    _t_rev_prev,
+    semiring_vg,
+)
 
 __all__ = ["pallas_forward_vg_chunked"]
-
-
-# ---- shared chunked-grid plumbing (also used by pallas_ffbs_chunked) ----
-
-
-def _fixed(*blk):
-    """Chunk-invariant block: same tile for every t-chunk of a batch tile."""
-    return pl.BlockSpec(
-        blk + (_LANES,),
-        index_map=lambda b, c: (0,) * len(blk) + (b,),
-        memory_space=pltpu.VMEM,
-    )
-
-
-def _t_fwd(*blk):
-    """Time-chunked block in forward chunk order."""
-    return pl.BlockSpec(
-        blk + (_LANES,),
-        index_map=lambda b, c: (c,) + (0,) * (len(blk) - 1) + (b,),
-        memory_space=pltpu.VMEM,
-    )
-
-
-def _t_rev(nc, *blk):
-    """Time-chunked block in reversed chunk order (backward passes)."""
-    return pl.BlockSpec(
-        blk + (_LANES,),
-        index_map=lambda b, c: (nc - 1 - c,) + (0,) * (len(blk) - 1) + (b,),
-        memory_space=pltpu.VMEM,
-    )
-
-
-def _t_rev_prev(nc, *blk):
-    """One-chunk lookback alongside `_t_rev` (clamped at the first chunk,
-    where the lookback block is unused)."""
-    return pl.BlockSpec(
-        blk + (_LANES,),
-        index_map=lambda b, c: (jnp.maximum(nc - 2 - c, 0),)
-        + (0,) * (len(blk) - 1)
-        + (b,),
-        memory_space=pltpu.VMEM,
-    )
-
-
-def _pad_chunked(log_pi, log_A, log_obs, mask, gate_key, state_key, t_chunk):
-    """Lane-pad the batch, chunk-pad the time axis (mask-0 carry-copy
-    steps), and transpose everything batch-minor. Returns the transposed
-    operands plus ``(Bp, Tp, nc)``."""
-    B, T, K = log_obs.shape
-    Bp = -(-B // _LANES) * _LANES
-    Tp = -(-T // t_chunk) * t_chunk
-    nc = Tp // t_chunk
-
-    def pad_b(x):
-        return jnp.pad(x, [(0, Bp - B)] + [(0, 0)] * (x.ndim - 1))
-
-    pi_t = pad_b(log_pi).transpose(1, 0)  # [K, Bp]
-    A_t = pad_b(log_A).transpose(1, 2, 0)  # [K, K, Bp]
-    obs_t = jnp.pad(pad_b(log_obs), [(0, 0), (0, Tp - T), (0, 0)]).transpose(
-        1, 2, 0
-    )  # [Tp, K, Bp]
-    mask_t = jnp.pad(
-        jnp.pad(mask.astype(jnp.float32), [(0, Bp - B), (0, 0)], constant_values=1.0),
-        [(0, 0), (0, Tp - T)],  # time padding: mask 0 (carry-copy steps)
-    ).transpose(1, 0)  # [Tp, Bp]  (f32: the FFBS kernel stores a mask
-    # row into its f32 carry scratch, so an int/bool mask must not
-    # reach the kernel)
-    gate_t = sk_t = None
-    if gate_key is not None:
-        gate_t = jnp.pad(
-            pad_b(gate_key.astype(jnp.float32)), [(0, 0), (0, Tp - T)]
-        ).transpose(1, 0)
-        sk_t = pad_b(state_key.astype(jnp.float32)).transpose(1, 0)
-    return pi_t, A_t, obs_t, mask_t, gate_t, sk_t, Bp, Tp, nc
-
-
-def _run_chunked_forward(
-    pi_t, A_t, obs_t, mask_t, gate_t, sk_t, grid, Tc, interpret
-):
-    """Pass 1 shared by the vg and FFBS chunked kernels: forward filter
-    with the per-step alpha written chunk-by-chunk to an HBM residual.
-    Returns ``(ll [1, Bp], alpha_all [Tp, K, Bp])``."""
-    Tp, K, Bp = obs_t.shape
-    gated = gate_t is not None
-    fwd_in = [_fixed(K), _fixed(K, K), _t_fwd(Tc, K), _t_fwd(Tc)]
-    fwd_args = [pi_t, A_t, obs_t, mask_t]
-    if gated:
-        fwd_in += [_t_fwd(Tc), _fixed(K)]
-        fwd_args += [gate_t, sk_t]
-    return pl.pallas_call(
-        partial(_fwd_kernel, gated),
-        grid=grid,
-        in_specs=fwd_in,
-        out_specs=(_fixed(1), _t_fwd(Tc, K)),
-        out_shape=(
-            jax.ShapeDtypeStruct((1, Bp), jnp.float32),
-            jax.ShapeDtypeStruct((Tp, K, Bp), jnp.float32),
-        ),
-        scratch_shapes=[pltpu.VMEM((K, _LANES), jnp.float32)],
-        interpret=interpret,
-    )(*fwd_args)
-
-
-def _fwd_kernel(
-    gated,
-    pi_ref,  # [K, B]
-    A_ref,  # [K, K, B]
-    obs_ref,  # [Tc, K, B] (chunk c)
-    mask_ref,  # [Tc, B]
-    *refs,  # (+ gate_ref [Tc, B], sk_ref [K, B]), ll_ref, alpha_out, carry
-):
-    if gated:
-        gate_ref, sk_ref, ll_ref, aout_ref, carry = refs
-        sk = sk_ref[:]
-    else:
-        ll_ref, aout_ref, carry = refs
-    Tc, K, B = obs_ref.shape
-    A = A_ref[:]
-    c = pl.program_id(1)
-
-    def A_at(t):
-        if not gated:
-            return A
-        c_t = (gate_ref[t][None] == sk).astype(jnp.float32)
-        return A * c_t[None, :, :]
-
-    # chunk 0 initializes from pi; later chunks resume from the carry
-    m0 = mask_ref[0][None]
-    alpha0 = jnp.where(m0 > 0, pi_ref[:] + obs_ref[0], pi_ref[:])
-    alpha_init = jnp.where(c == 0, alpha0, carry[:])
-
-    @pl.when(c == 0)
-    def _():
-        aout_ref[0] = alpha_init
-
-    def body(t, alpha):
-        new = _lse0(alpha[:, None, :] + A_at(t)) + obs_ref[t]
-        alpha = jnp.where(mask_ref[t][None] > 0, new, alpha)
-        aout_ref[t] = alpha
-        return alpha
-
-    start = jnp.where(c == 0, 1, 0)
-    alpha = lax.fori_loop(start, Tc, body, alpha_init)
-    carry[:] = alpha
-    ll_ref[0] = _lse0(alpha)  # every chunk writes; the last one stands
-
-
-def _bwd_kernel(
-    gated,
-    A_ref,  # [K, K, B]
-    obs_ref,  # [Tc, K, B]   (reversed chunk order)
-    mask_ref,  # [Tc, B]
-    alpha_ref,  # [Tc, K, B]
-    aprev_ref,  # [Tc, K, B]  (chunk rc-1; clamped to 0 for rc==0, unused)
-    ll_ref,  # [1, B]
-    *refs,  # (+ gate_ref, sk_ref), dpi_ref, dA_ref, dobs_ref, beta_scr
-):
-    if gated:
-        gate_ref, sk_ref, dpi_ref, dA_ref, dobs_ref, beta_scr = refs
-        sk = sk_ref[:]
-    else:
-        dpi_ref, dA_ref, dobs_ref, beta_scr = refs
-    Tc, K, B = obs_ref.shape
-    A = A_ref[:]
-    ll = ll_ref[0]
-    c = pl.program_id(1)
-    nc = pl.num_programs(1)
-    rc = nc - 1 - c  # the time-chunk this grid step owns
-
-    def A_at(t):
-        if not gated:
-            return A, None
-        c_t = (gate_ref[t][None] == sk).astype(jnp.float32)
-        return A * c_t[None, :, :], c_t
-
-    @pl.when(c == 0)
-    def _():
-        beta_scr[:] = jnp.zeros((K, B), jnp.float32)
-        dA_ref[:] = jnp.zeros((K, K, B), jnp.float32)
-        dpi_ref[:] = jnp.zeros((K, B), jnp.float32)
-
-    beta0 = beta_scr[:]
-    dA0 = jnp.zeros((K, K, B), jnp.float32)
-
-    def body(i, carry):
-        beta, dA = carry
-        t = Tc - 1 - i  # local step, descending
-        m_t = mask_ref[t][None]
-        m01 = (m_t > 0).astype(jnp.float32)
-        gamma_t = jnp.exp(alpha_ref[t] + beta - ll[None]) * m01
-        dobs_ref[t] = gamma_t
-        e = obs_ref[t] + beta
-        # alpha entering step t: previous local row, or the lookback
-        # chunk's last row at the chunk boundary
-        a_in = jnp.where(
-            t == 0, aprev_ref[Tc - 1], alpha_ref[jnp.maximum(t - 1, 0)]
-        )
-        Ag, c_t = A_at(t)
-        xi = jnp.exp(a_in[:, None, :] + Ag + e[None, :, :] - ll[None, None, :])
-        if gated:
-            xi = xi * c_t[None]
-        dA = dA + xi * m01[None]
-        new_beta = _lse1(Ag + e[None, :, :])
-        beta = jnp.where(m_t > 0, new_beta, beta)
-        return beta, dA
-
-    # the earliest chunk stops before local t=0 (the pi step, handled
-    # below); every other chunk walks its whole block
-    n_steps = jnp.where(rc == 0, Tc - 1, Tc)
-    beta, dA = lax.fori_loop(0, n_steps, body, (beta0, dA0))
-    beta_scr[:] = beta
-    dA_ref[:] += dA
-
-    @pl.when(rc == 0)
-    def _():
-        gamma0 = jnp.exp(alpha_ref[0] + beta_scr[:] - ll[None])
-        dpi_ref[:] = gamma0
-        dobs_ref[0] = gamma0 * (mask_ref[0][None] > 0).astype(jnp.float32)
 
 
 def pallas_forward_vg_chunked(
@@ -276,52 +47,9 @@ def pallas_forward_vg_chunked(
     t_chunk: int = 512,
     interpret: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Batched fused (loglik, d_pi, d_A, d_obs) for long T. Pads the
-    batch to 128 lanes and T to a ``t_chunk`` multiple (mask-0 padding
-    steps carry alpha unchanged and contribute no gradient)."""
-    B, T, K = log_obs.shape
-    Tc = t_chunk
-    gated = gate_key is not None
-    pi_t, A_t, obs_t, mask_t, gate_t, sk_t, Bp, Tp, nc = _pad_chunked(
-        log_pi, log_A, log_obs, mask, gate_key, state_key, Tc
-    )
-    grid = (Bp // _LANES, nc)
-
-    # ---- pass 1: forward filter, residual to HBM ----
-    ll, alpha_all = _run_chunked_forward(
-        pi_t, A_t, obs_t, mask_t, gate_t, sk_t, grid, Tc, interpret
-    )
-
-    # ---- pass 2: backward smoother + gradients, reversed chunks ----
-    bwd_in = [
-        _fixed(K, K),
-        _t_rev(nc, Tc, K),
-        _t_rev(nc, Tc),
-        _t_rev(nc, Tc, K),
-        _t_rev_prev(nc, Tc, K),
-        _fixed(1),
-    ]
-    bwd_args = [A_t, obs_t, mask_t, alpha_all, alpha_all, ll]
-    if gated:
-        bwd_in += [_t_rev(nc, Tc), _fixed(K)]
-        bwd_args += [gate_t, sk_t]
-    dpi, dA, dobs = pl.pallas_call(
-        partial(_bwd_kernel, gated),
-        grid=grid,
-        in_specs=bwd_in,
-        out_specs=(_fixed(K), _fixed(K, K), _t_rev(nc, Tc, K)),
-        out_shape=(
-            jax.ShapeDtypeStruct((K, Bp), jnp.float32),
-            jax.ShapeDtypeStruct((K, K, Bp), jnp.float32),
-            jax.ShapeDtypeStruct((Tp, K, Bp), jnp.float32),
-        ),
-        scratch_shapes=[pltpu.VMEM((K, _LANES), jnp.float32)],
-        interpret=interpret,
-    )(*bwd_args)
-
-    return (
-        ll[0, :B],
-        dpi.transpose(1, 0)[:B],
-        dA.transpose(2, 0, 1)[:B],
-        dobs.transpose(2, 0, 1)[:B, :T],
+    """Batched fused (loglik, d_pi, d_A, d_obs) for long T — the
+    unified blocked kernel at an explicit ``t_chunk`` block size."""
+    return semiring_vg(
+        log_pi, log_A, log_obs, mask, gate_key, state_key,
+        t_block=t_chunk, interpret=interpret,
     )

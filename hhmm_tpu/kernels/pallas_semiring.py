@@ -1,0 +1,1049 @@
+"""Blocked Pallas semiring mega-kernel: ONE VMEM-staged scan for
+filter, beta, Viterbi, FFBS, and the fused value-and-grad.
+
+The five hand-written Pallas variants this module subsumes
+(`pallas_forward[_chunked]`, `pallas_ffbs[_chunked|_pack2]`, now thin
+deprecated shims) each re-implemented the same blocked schedule with a
+different per-step combine. The time-parallel engine (PR 3, after
+Särkkä & García-Fernández's semiring view of the Bayesian smoother
+family) already names those combines: every HMM recursion in this repo
+is a prefix/suffix product in one of the `kernels/semiring.py`
+algebras. This module is that observation turned into ONE kernel:
+
+- **blocked schedule** — the sequence is tiled into ``t_block``-step
+  VMEM-resident blocks on a grid ``(batch_tile, time_block)`` with the
+  time axis minor (sequential on TPU, so VMEM scratch persists across
+  the blocks of one 128-lane batch tile). Within a block the combine
+  runs sequentially against the carried state; across blocks the carry
+  crosses in scratch — the O(T) work / O(T/S) launch-glue schedule
+  that beats both the XLA scan pair (2(T−1) sequenced microkernels)
+  and the O(K³ log T) associative form at production (K, T, B) points.
+- **one body, three algebras** — the forward body
+  (:func:`_semiring_fwd_kernel`) is parameterized by the semiring:
+  the (logsumexp, +) vector-operator product for the filter (and the
+  FFBS/vg forward), the (max, +) product for Viterbi; the reverse
+  map-scan body applies the K-ary index-map composition algebra —
+  Viterbi backtrack composes argmax backpointer maps, FFBS sampling
+  applies inverse-CDF sampling maps against pre-drawn uniforms — and
+  the beta/vg reverse bodies run the (logsumexp, +) suffix recursion.
+- **guarded reductions** — the filter/beta/Viterbi modes reduce
+  through `core.lmath.safe_logsumexp` (and plain max, which needs no
+  shift), so an all-(−inf) fiber (impossible evidence, fully gated
+  column) degrades to −inf exactly like the `lax.scan` references —
+  bitwise parity is pinned in interpreter mode, −inf rows included.
+  The FFBS/vg paths keep the legacy clamp discipline (``A`` clamped at
+  ``_CLAMP`` on the FFBS entry; the vg kernel documents a finite-input
+  contract) — at the clamp floor ``exp`` underflows to exactly 0, so
+  bad input degrades to zero-probability paths instead of NaN.
+- **batched via the custom_vmap discipline** — the single-series
+  entries (``filter_pallas``/``beta_pallas``/``viterbi_pallas``/
+  ``ffbs_pallas_sample``) collapse any ``vmap`` nesting into the flat
+  128-lane batch the block specs tile (`kernels/vg.py`'s pattern), so
+  a vmapped decode dispatch lands in one kernel launch.
+
+Layout (shared with the legacy kernels): batch on the 128-wide lane
+axis, K states on sublanes, one grid step owns a ``t_block`` slice of
+one 128-series tile. Homogeneous f32 ``log_A`` only — the eligibility
+`kernels/dispatch.py` enforces before routing the ``"pallas"`` branch;
+`interpret=None` auto-selects interpreter mode off-TPU so CPU tests
+exercise the identical program.
+
+Entry points: `kernels/dispatch.py` is the ONLY sanctioned importer
+outside this package (analysis rule ``pallas-import``, error
+severity) — everything else reaches these kernels through the
+measured three-way (seq/assoc/pallas) dispatch layer.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.custom_batching import custom_vmap
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from hhmm_tpu.core.lmath import safe_logsumexp
+
+__all__ = [
+    "default_block",
+    "semiring_filter",
+    "semiring_beta",
+    "semiring_viterbi",
+    "semiring_ffbs",
+    "semiring_vg",
+    "filter_pallas",
+    "beta_pallas",
+    "viterbi_pallas",
+    "ffbs_pallas",
+    "ffbs_pallas_sample",
+]
+
+_LANES = 128
+_CLAMP = -1.0e30
+
+
+def _interpret_default(interpret: Optional[bool]) -> bool:
+    """Auto-interpret off TPU: the CPU parity tests and the quick cost
+    probes run the IDENTICAL kernel program through the Pallas
+    interpreter instead of needing a Mosaic backend."""
+    if interpret is not None:
+        return bool(interpret)
+    return jax.default_backend() != "tpu"
+
+
+def default_block(T: int, K: int) -> int:
+    """Block (chunk) size keeping the per-grid-step VMEM blocks
+    (~[t_block, K, 128] f32, double-buffered) near the measured ~1 MB
+    sweet spot of the legacy chunked kernels, never padding a short
+    sequence past itself."""
+    return max(1, min(int(T), max(128, 2048 // max(int(K), 1))))
+
+
+# ---------------------------------------------------------------------------
+# in-kernel semiring adapters
+# ---------------------------------------------------------------------------
+# The (logsumexp, +) and (max, +) vector-operator products below are the
+# in-VMEM specializations of `kernels/semiring.py`'s matrix products to
+# the [K, B]-carry layout (a carried vector times one [K, K] operator
+# per step — the O(K²) sequential form, not the O(K³) scan-tree form).
+
+
+def _safe_lse0(x):
+    """Guarded logsumexp over the leading (state) axis — the
+    `core.lmath.safe_logsumexp` semantics, so all-(−inf) fibers degrade
+    to −inf instead of NaN, bitwise-matching the scan references."""
+    return safe_logsumexp(x, axis=0)
+
+
+def _safe_lse1(x):
+    """Guarded logsumexp over axis 1 of [K, K, B] (the beta combine)."""
+    return safe_logsumexp(x, axis=1)
+
+
+def _lse0(x):
+    """Clamped logsumexp over axis 0 — the legacy vg/FFBS numerics
+    (finite-input contract; padding lanes stay finite)."""
+    m = jnp.maximum(jnp.max(x, axis=0), _CLAMP)
+    return m + jnp.log(jnp.sum(jnp.exp(x - m[None]), axis=0))
+
+
+def _lse1(x):
+    """Clamped logsumexp over axis 1 of [K, K, B]."""
+    m = jnp.maximum(jnp.max(x, axis=1), _CLAMP)
+    return m + jnp.log(jnp.sum(jnp.exp(x - m[:, None, :]), axis=1))
+
+
+def _argmax0(scores):
+    """First-max argmax over axis 0 of ``scores [K, K, B]`` → f32
+    [K, B], unrolled over the static K axis (the Mosaic-safe spelling
+    of ``jnp.argmax(scores, axis=0)`` — identical tie-breaking: the
+    LOWEST index among equal maxima wins, as in the scan Viterbi)."""
+    K = scores.shape[0]
+    m = jnp.max(scores, axis=0)  # [K, B]
+    out = jnp.zeros(m.shape, jnp.float32)
+    found = jnp.zeros(m.shape, jnp.float32)
+    for k in range(K):
+        hit = (scores[k] == m).astype(jnp.float32) * (1.0 - found)
+        out = out + float(k) * hit
+        found = jnp.minimum(found + hit, 1.0)
+    return out
+
+
+def _argmax_vec(x):
+    """First-max argmax over axis 0 of ``x [K, B]`` → f32 [B]."""
+    K = x.shape[0]
+    m = jnp.max(x, axis=0)
+    out = jnp.zeros(m.shape, jnp.float32)
+    found = jnp.zeros(m.shape, jnp.float32)
+    for k in range(K):
+        hit = (x[k] == m).astype(jnp.float32) * (1.0 - found)
+        out = out + float(k) * hit
+        found = jnp.minimum(found + hit, 1.0)
+    return out
+
+
+def _sample_invcdf(logits, u):
+    """Inverse-CDF categorical draw over axis 0 of ``logits [K, B]``
+    using uniforms ``u [B]``: z = #{k : cum_k <= u}. Unrolled over the
+    static K axis — the exact draw semantics of
+    `kernels/ffbs.py::ffbs_invcdf_reference`."""
+    K = logits.shape[0]
+    p = jnp.exp(logits - _lse0(logits)[None])  # [K, B], sums to 1
+    z = jnp.zeros(u.shape, jnp.float32)
+    cum = jnp.zeros(u.shape, jnp.float32)
+    for k in range(K - 1):  # last bucket catches the remainder
+        cum = cum + p[k]
+        z = z + (u >= cum).astype(jnp.float32)
+    return z
+
+
+def _select_col(A, z_next):
+    """``A[:, z_next, :]`` per lane — unrolled masked sum over the
+    static K destinations. ``A [K, K, B]``, ``z_next [B] f32``."""
+    K = A.shape[0]
+    col = jnp.zeros((K, A.shape[2]), jnp.float32)
+    for j in range(K):
+        col = col + A[:, j, :] * (z_next[None] == float(j)).astype(jnp.float32)
+    return col
+
+
+def _select_row(sk, z_next):
+    """``sk[z_next]`` per lane over the static K axis — the K-ary
+    index-map APPLICATION of `kernels/semiring.py`'s composition
+    algebra, specialized to one map row per step. ``sk [K, B]``."""
+    out = jnp.zeros(z_next.shape, jnp.float32)
+    for j in range(sk.shape[0]):
+        out = out + sk[j] * (z_next == float(j)).astype(jnp.float32)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# blocked-grid plumbing (shared by every mode)
+# ---------------------------------------------------------------------------
+
+
+def _fixed(*blk):
+    """Block-invariant block: same tile for every time block of a
+    batch tile."""
+    return pl.BlockSpec(
+        blk + (_LANES,),
+        index_map=lambda b, c: (0,) * len(blk) + (b,),
+        memory_space=pltpu.VMEM,
+    )
+
+
+def _t_fwd(*blk):
+    """Time-blocked block in forward block order."""
+    return pl.BlockSpec(
+        blk + (_LANES,),
+        index_map=lambda b, c: (c,) + (0,) * (len(blk) - 1) + (b,),
+        memory_space=pltpu.VMEM,
+    )
+
+
+def _t_rev(nc, *blk):
+    """Time-blocked block in reversed block order (backward passes)."""
+    return pl.BlockSpec(
+        blk + (_LANES,),
+        index_map=lambda b, c: (nc - 1 - c,) + (0,) * (len(blk) - 1) + (b,),
+        memory_space=pltpu.VMEM,
+    )
+
+
+def _t_rev_prev(nc, *blk):
+    """One-block lookback alongside `_t_rev` (clamped at the first
+    block, where the lookback block is unused)."""
+    return pl.BlockSpec(
+        blk + (_LANES,),
+        index_map=lambda b, c: (jnp.maximum(nc - 2 - c, 0),)
+        + (0,) * (len(blk) - 1)
+        + (b,),
+        memory_space=pltpu.VMEM,
+    )
+
+
+def _pad_chunked(log_pi, log_A, log_obs, mask, gate_key, state_key, t_block):
+    """Lane-pad the batch, block-pad the time axis (mask-0 carry-copy
+    steps), and transpose everything batch-minor. Returns the
+    transposed operands plus ``(Bp, Tp, nc)``. ``log_pi`` may be None
+    (the beta pass needs no initial row)."""
+    B, T, K = log_obs.shape
+    Bp = -(-B // _LANES) * _LANES
+    Tp = -(-T // t_block) * t_block
+    nc = Tp // t_block
+
+    def pad_b(x):
+        return jnp.pad(x, [(0, Bp - B)] + [(0, 0)] * (x.ndim - 1))
+
+    pi_t = None if log_pi is None else pad_b(log_pi).transpose(1, 0)  # [K, Bp]
+    A_t = pad_b(log_A).transpose(1, 2, 0)  # [K, K, Bp]
+    obs_t = jnp.pad(pad_b(log_obs), [(0, 0), (0, Tp - T), (0, 0)]).transpose(
+        1, 2, 0
+    )  # [Tp, K, Bp]
+    mask_t = jnp.pad(
+        jnp.pad(mask.astype(jnp.float32), [(0, Bp - B), (0, 0)], constant_values=1.0),
+        [(0, 0), (0, Tp - T)],  # time padding: mask 0 (carry-copy steps)
+    ).transpose(1, 0)  # [Tp, Bp]  (f32: the FFBS kernel stores a mask
+    # row into its f32 carry scratch, so an int/bool mask must not
+    # reach the kernel)
+    gate_t = sk_t = None
+    if gate_key is not None:
+        gate_t = jnp.pad(
+            pad_b(gate_key.astype(jnp.float32)), [(0, 0), (0, Tp - T)]
+        ).transpose(1, 0)
+        sk_t = pad_b(state_key.astype(jnp.float32)).transpose(1, 0)
+    return pi_t, A_t, obs_t, mask_t, gate_t, sk_t, Bp, Tp, nc
+
+
+# ---------------------------------------------------------------------------
+# forward bodies
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    gated,
+    pi_ref,  # [K, B]
+    A_ref,  # [K, K, B]
+    obs_ref,  # [Tc, K, B] (block c)
+    mask_ref,  # [Tc, B]
+    *refs,  # (+ gate_ref [Tc, B], sk_ref [K, B]), ll_ref, alpha_out, carry
+):
+    """The vg/FFBS forward filter (legacy clamped numerics): alpha
+    carried across blocks in scratch, per-step alpha streamed to the
+    HBM residual the backward passes re-read."""
+    if gated:
+        gate_ref, sk_ref, ll_ref, aout_ref, carry = refs
+        sk = sk_ref[:]
+    else:
+        ll_ref, aout_ref, carry = refs
+    Tc, K, B = obs_ref.shape
+    A = A_ref[:]
+    c = pl.program_id(1)
+
+    def A_at(t):
+        if not gated:
+            return A
+        c_t = (gate_ref[t][None] == sk).astype(jnp.float32)
+        return A * c_t[None, :, :]
+
+    # block 0 initializes from pi; later blocks resume from the carry
+    m0 = mask_ref[0][None]
+    alpha0 = jnp.where(m0 > 0, pi_ref[:] + obs_ref[0], pi_ref[:])
+    alpha_init = jnp.where(c == 0, alpha0, carry[:])
+
+    @pl.when(c == 0)
+    def _():
+        aout_ref[0] = alpha_init
+
+    def body(t, alpha):
+        new = _lse0(alpha[:, None, :] + A_at(t)) + obs_ref[t]
+        alpha = jnp.where(mask_ref[t][None] > 0, new, alpha)
+        aout_ref[t] = alpha
+        return alpha
+
+    start = jnp.where(c == 0, 1, 0)
+    alpha = lax.fori_loop(start, Tc, body, alpha_init)
+    carry[:] = alpha
+    ll_ref[0] = _lse0(alpha)  # every block writes; the last one stands
+
+
+def _run_chunked_forward(
+    pi_t, A_t, obs_t, mask_t, gate_t, sk_t, grid, Tc, interpret
+):
+    """The shared blocked forward filter (vg + FFBS pass 1): per-step
+    alpha written block-by-block to an HBM residual. Returns
+    ``(ll [1, Bp], alpha_all [Tp, K, Bp])``."""
+    Tp, K, Bp = obs_t.shape
+    gated = gate_t is not None
+    fwd_in = [_fixed(K), _fixed(K, K), _t_fwd(Tc, K), _t_fwd(Tc)]
+    fwd_args = [pi_t, A_t, obs_t, mask_t]
+    if gated:
+        fwd_in += [_t_fwd(Tc), _fixed(K)]
+        fwd_args += [gate_t, sk_t]
+    return pl.pallas_call(
+        partial(_fwd_kernel, gated),
+        grid=grid,
+        in_specs=fwd_in,
+        out_specs=(_fixed(1), _t_fwd(Tc, K)),
+        out_shape=(
+            jax.ShapeDtypeStruct((1, Bp), jnp.float32),
+            jax.ShapeDtypeStruct((Tp, K, Bp), jnp.float32),
+        ),
+        scratch_shapes=[pltpu.VMEM((K, _LANES), jnp.float32)],
+        interpret=interpret,
+    )(*fwd_args)
+
+
+def _semiring_fwd_kernel(
+    mode,  # static: "filter" (logsumexp, +) or "viterbi" (max, +)
+    pi_ref,  # [K, B]
+    A_ref,  # [K, K, B]
+    obs_ref,  # [Tc, K, B] (block c)
+    mask_ref,  # [Tc, B]
+    *refs,
+):
+    """ONE forward body, parameterized by the semiring combine:
+
+    - ``"filter"``: carried alpha, guarded (logsumexp, +) product,
+      per-step alpha streamed to the residual, final guarded loglik —
+      bitwise parity with `kernels/filtering.py::forward_filter`.
+    - ``"viterbi"``: carried delta, (max, +) product, the per-step
+      argmax backpointer MAP streamed to the residual (masked steps
+      emit the identity map — `kernels/semiring.py::identity_map`'s
+      carry-copy semantics), final delta row + max score out.
+    """
+    if mode == "viterbi":
+        ll_ref, dlast_ref, back_ref, carry = refs
+    else:
+        ll_ref, aout_ref, carry = refs
+    Tc, K, B = obs_ref.shape
+    A = A_ref[:]
+    c = pl.program_id(1)
+
+    if mode == "viterbi":
+        # the reference Viterbi has no mask special-case at t=0
+        init0 = pi_ref[:] + obs_ref[0]
+    else:
+        m0 = mask_ref[0][None]
+        init0 = jnp.where(m0 > 0, pi_ref[:] + obs_ref[0], pi_ref[:])
+    x_init = jnp.where(c == 0, init0, carry[:])
+
+    iota = jnp.broadcast_to(
+        jnp.arange(K, dtype=jnp.float32)[:, None], (K, B)
+    )
+
+    @pl.when(c == 0)
+    def _():
+        if mode == "viterbi":
+            back_ref[0] = iota  # slot 0 is never backtracked through
+        else:
+            aout_ref[0] = x_init
+
+    def body(t, x):
+        scores = x[:, None, :] + A  # [K(i), K(j), B]
+        if mode == "viterbi":
+            new = jnp.max(scores, axis=0) + obs_ref[t]
+            bk = _argmax0(scores)
+            bk = jnp.where(mask_ref[t][None] > 0, bk, iota)
+            back_ref[t] = bk
+        else:
+            new = _safe_lse0(scores) + obs_ref[t]
+        x = jnp.where(mask_ref[t][None] > 0, new, x)
+        if mode != "viterbi":
+            aout_ref[t] = x
+        return x
+
+    start = jnp.where(c == 0, 1, 0)
+    x = lax.fori_loop(start, Tc, body, x_init)
+    carry[:] = x
+    if mode == "viterbi":
+        ll_ref[0] = jnp.max(x, axis=0)
+        dlast_ref[:] = x
+    else:
+        ll_ref[0] = _safe_lse0(x)
+
+
+# ---------------------------------------------------------------------------
+# reverse bodies
+# ---------------------------------------------------------------------------
+
+
+def _bwd_kernel(
+    gated,
+    A_ref,  # [K, K, B]
+    obs_ref,  # [Tc, K, B]   (reversed block order)
+    mask_ref,  # [Tc, B]
+    alpha_ref,  # [Tc, K, B]
+    aprev_ref,  # [Tc, K, B]  (block rc-1; clamped to 0 for rc==0, unused)
+    ll_ref,  # [1, B]
+    *refs,  # (+ gate_ref, sk_ref), dpi_ref, dA_ref, dobs_ref, beta_scr
+):
+    """The vg backward: beta + on-the-fly Baum-Welch gradient
+    accumulation over reversed blocks (legacy clamped numerics)."""
+    if gated:
+        gate_ref, sk_ref, dpi_ref, dA_ref, dobs_ref, beta_scr = refs
+        sk = sk_ref[:]
+    else:
+        dpi_ref, dA_ref, dobs_ref, beta_scr = refs
+    Tc, K, B = obs_ref.shape
+    A = A_ref[:]
+    ll = ll_ref[0]
+    c = pl.program_id(1)
+    nc = pl.num_programs(1)
+    rc = nc - 1 - c  # the time-block this grid step owns
+
+    def A_at(t):
+        if not gated:
+            return A, None
+        c_t = (gate_ref[t][None] == sk).astype(jnp.float32)
+        return A * c_t[None, :, :], c_t
+
+    @pl.when(c == 0)
+    def _():
+        beta_scr[:] = jnp.zeros((K, B), jnp.float32)
+        dA_ref[:] = jnp.zeros((K, K, B), jnp.float32)
+        dpi_ref[:] = jnp.zeros((K, B), jnp.float32)
+
+    beta0 = beta_scr[:]
+    dA0 = jnp.zeros((K, K, B), jnp.float32)
+
+    def body(i, carry):
+        beta, dA = carry
+        t = Tc - 1 - i  # local step, descending
+        m_t = mask_ref[t][None]
+        m01 = (m_t > 0).astype(jnp.float32)
+        gamma_t = jnp.exp(alpha_ref[t] + beta - ll[None]) * m01
+        dobs_ref[t] = gamma_t
+        e = obs_ref[t] + beta
+        # alpha entering step t: previous local row, or the lookback
+        # block's last row at the block boundary
+        a_in = jnp.where(
+            t == 0, aprev_ref[Tc - 1], alpha_ref[jnp.maximum(t - 1, 0)]
+        )
+        Ag, c_t = A_at(t)
+        xi = jnp.exp(a_in[:, None, :] + Ag + e[None, :, :] - ll[None, None, :])
+        if gated:
+            xi = xi * c_t[None]
+        dA = dA + xi * m01[None]
+        new_beta = _lse1(Ag + e[None, :, :])
+        beta = jnp.where(m_t > 0, new_beta, beta)
+        return beta, dA
+
+    # the earliest block stops before local t=0 (the pi step, handled
+    # below); every other block walks its whole slice
+    n_steps = jnp.where(rc == 0, Tc - 1, Tc)
+    beta, dA = lax.fori_loop(0, n_steps, body, (beta0, dA0))
+    beta_scr[:] = beta
+    dA_ref[:] += dA
+
+    @pl.when(rc == 0)
+    def _():
+        gamma0 = jnp.exp(alpha_ref[0] + beta_scr[:] - ll[None])
+        dpi_ref[:] = gamma0
+        dobs_ref[0] = gamma0 * (mask_ref[0][None] > 0).astype(jnp.float32)
+
+
+def _beta_kernel(
+    A_ref,  # [K, K, B]
+    obs_ref,  # [Tc, K, B]  (reversed block order)
+    mask_ref,  # [Tc, B]    (reversed block order)
+    bout_ref,  # [Tc, K, B] out (reversed block order)
+    carry,  # [K, B] scratch: beta across blocks
+    oc,  # [K, B] scratch: obs row crossing the block boundary
+    mc,  # [1, B] scratch: mask row crossing the block boundary
+):
+    """Standalone guarded beta recursion over reversed blocks —
+    ``beta[t][i] = safe_lse_j(A[i,j] + obs[t+1,j] + beta[t+1,j])`` with
+    masked-step carry-copy; parity with
+    `kernels/filtering.py::backward_pass`."""
+    Tc, K, B = obs_ref.shape
+    A = A_ref[:]
+    c = pl.program_id(1)
+
+    @pl.when(c == 0)
+    def _():
+        z = jnp.zeros((K, B), jnp.float32)
+        carry[:] = z
+        bout_ref[Tc - 1] = z
+
+    def body(i, beta):
+        t = Tc - 1 - i
+        boundary = t == Tc - 1  # only reached when c > 0
+        tn = jnp.minimum(t + 1, Tc - 1)
+        obs_next = jnp.where(boundary, oc[:], obs_ref[tn])
+        m_next = jnp.where(boundary, mc[0], mask_ref[tn])
+        e = obs_next + beta  # [K(j), B]
+        new = _safe_lse1(A + e[None, :, :])  # [K(i), B]
+        beta = jnp.where(m_next[None] > 0, new, beta)
+        bout_ref[t] = beta
+        return beta
+
+    start = jnp.where(c == 0, 1, 0)
+    beta = lax.fori_loop(start, Tc, body, carry[:])
+    carry[:] = beta
+    oc[:] = obs_ref[0]
+    mc[0] = mask_ref[0]
+
+
+def _backtrack_kernel(
+    back_ref,  # [Tc, K, B] (reversed block order) argmax maps
+    dlast_ref,  # [K, B] final delta row
+    path_ref,  # [Tc, B] out (reversed block order)
+    zc,  # [1, B] scratch: z crossing the block boundary
+):
+    """Viterbi backtrack as a reverse map scan: the carried state is
+    one lane-wide index, each step applies the per-step backpointer
+    map (the semiring's K-ary map algebra) — ``z_{t-1} =
+    back[t][z_t]``. The carry crossing a block boundary is the state
+    already stepped THROUGH the boundary map, so each grid step starts
+    ready to write its own last row."""
+    Tc, K, B = back_ref.shape
+    c = pl.program_id(1)
+
+    @pl.when(c == 0)
+    def _():
+        zc[0] = _argmax_vec(dlast_ref[:])
+
+    z = zc[0]
+    path_ref[Tc - 1] = z
+
+    def body(i, z):
+        t = Tc - 2 - i
+        z = _select_row(back_ref[t + 1], z)
+        path_ref[t] = z
+        return z
+
+    z0 = lax.fori_loop(0, Tc - 1, body, z)
+    zc[0] = _select_row(back_ref[0], z0)
+
+
+def _bwd_sample_kernel(
+    gated,
+    A_ref,  # [K, K, B]
+    mask_ref,  # [Tc, B]    (reversed block order)
+    alpha_ref,  # [Tc, K, B] (reversed block order)
+    u_ref,  # [Tc, B]    (reversed block order)
+    *refs,  # (+ gate_ref [Tc, B], sk_ref [K, B]), z_ref, zc, mc, gc
+):
+    """FFBS backward sampling over reversed blocks: inverse-CDF draws
+    against pre-drawn uniforms; the only cross-block state is the
+    previously drawn z plus that step's mask/gate rows."""
+    if gated:
+        gate_ref, sk_ref, z_ref, zc, mc, gc = refs
+        sk = sk_ref[:]
+    else:
+        z_ref, zc, mc, gc = refs
+    Tc, K, B = alpha_ref.shape
+    A = A_ref[:]
+    c = pl.program_id(1)
+
+    # last block (first grid step): draw the final state from the filter
+    @pl.when(c == 0)
+    def _():
+        z_last = _sample_invcdf(alpha_ref[Tc - 1], u_ref[Tc - 1])
+        z_ref[Tc - 1] = z_last
+        zc[0] = z_last
+
+    def body(i, z_next):
+        t = Tc - 1 - i
+        # at the block boundary (local t=Tc-1, only reached when c > 0)
+        # the successor's mask/gate rows live in the carries written by
+        # the previous grid step; inside the block they are local rows
+        boundary = t == Tc - 1
+        tn = jnp.minimum(t + 1, Tc - 1)
+        m_next = jnp.where(boundary, mc[0], mask_ref[tn])
+        g = (m_next > 0).astype(jnp.float32)  # [B]
+        if gated:
+            g_next = jnp.where(boundary, gc[0], gate_ref[tn])
+            g = g * (g_next == _select_row(sk, z_next)).astype(jnp.float32)
+        logits = alpha_ref[t] + g[None] * _select_col(A, z_next)
+        z_t = _sample_invcdf(logits, u_ref[t])
+        z_ref[t] = z_t
+        return z_t
+
+    start = jnp.where(c == 0, 1, 0)
+    z0 = lax.fori_loop(start, Tc, body, zc[0])
+    zc[0] = z0
+    mc[0] = mask_ref[0]
+    if gated:
+        gc[0] = gate_ref[0]
+
+
+# ---------------------------------------------------------------------------
+# public batched entries
+# ---------------------------------------------------------------------------
+
+
+def _resolve_block(T: int, K: int, t_block: Optional[int]) -> int:
+    return int(t_block) if t_block else default_block(T, K)
+
+
+def semiring_filter(
+    log_pi: jnp.ndarray,  # [B, K]
+    log_A: jnp.ndarray,  # [B, K, K]
+    log_obs: jnp.ndarray,  # [B, T, K]
+    mask: jnp.ndarray,  # [B, T]
+    *,
+    t_block: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Blocked forward filter: ``(log_alpha [B, T, K], loglik [B])`` —
+    the `kernels/filtering.py::forward_filter` contract, guarded
+    reductions, −inf-tolerant."""
+    B, T, K = log_obs.shape
+    Tc = _resolve_block(T, K, t_block)
+    interpret = _interpret_default(interpret)
+    pi_t, A_t, obs_t, mask_t, _, _, Bp, Tp, nc = _pad_chunked(
+        log_pi, log_A, log_obs, mask, None, None, Tc
+    )
+    grid = (Bp // _LANES, nc)
+    ll, alpha_all = pl.pallas_call(
+        partial(_semiring_fwd_kernel, "filter"),
+        grid=grid,
+        in_specs=[_fixed(K), _fixed(K, K), _t_fwd(Tc, K), _t_fwd(Tc)],
+        out_specs=(_fixed(1), _t_fwd(Tc, K)),
+        out_shape=(
+            jax.ShapeDtypeStruct((1, Bp), jnp.float32),
+            jax.ShapeDtypeStruct((Tp, K, Bp), jnp.float32),
+        ),
+        scratch_shapes=[pltpu.VMEM((K, _LANES), jnp.float32)],
+        interpret=interpret,
+    )(pi_t, A_t, obs_t, mask_t)
+    return alpha_all.transpose(2, 0, 1)[:B, :T], ll[0, :B]
+
+
+def semiring_beta(
+    log_A: jnp.ndarray,  # [B, K, K]
+    log_obs: jnp.ndarray,  # [B, T, K]
+    mask: jnp.ndarray,  # [B, T]
+    *,
+    t_block: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Blocked beta recursion: ``log_beta [B, T, K]`` — the
+    `kernels/filtering.py::backward_pass` contract."""
+    B, T, K = log_obs.shape
+    Tc = _resolve_block(T, K, t_block)
+    interpret = _interpret_default(interpret)
+    _, A_t, obs_t, mask_t, _, _, Bp, Tp, nc = _pad_chunked(
+        None, log_A, log_obs, mask, None, None, Tc
+    )
+    grid = (Bp // _LANES, nc)
+    (beta_all,) = pl.pallas_call(
+        _beta_kernel,
+        grid=grid,
+        in_specs=[_fixed(K, K), _t_rev(nc, Tc, K), _t_rev(nc, Tc)],
+        out_specs=(_t_rev(nc, Tc, K),),
+        out_shape=(jax.ShapeDtypeStruct((Tp, K, Bp), jnp.float32),),
+        scratch_shapes=[
+            pltpu.VMEM((K, _LANES), jnp.float32),  # beta carry
+            pltpu.VMEM((K, _LANES), jnp.float32),  # obs boundary row
+            pltpu.VMEM((1, _LANES), jnp.float32),  # mask boundary row
+        ],
+        interpret=interpret,
+    )(A_t, obs_t, mask_t)
+    return beta_all.transpose(2, 0, 1)[:B, :T]
+
+
+def semiring_viterbi(
+    log_pi: jnp.ndarray,  # [B, K]
+    log_A: jnp.ndarray,  # [B, K, K]
+    log_obs: jnp.ndarray,  # [B, T, K]
+    mask: jnp.ndarray,  # [B, T]
+    *,
+    t_block: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Blocked Viterbi: ``(path [B, T] int32, log_prob [B])`` — the
+    (max, +) forward pass streams argmax backpointer MAPS to the
+    residual, and the backtrack is a reverse blocked map scan. Same
+    contract (and tie-breaking) as `kernels/viterbi.py::viterbi`."""
+    B, T, K = log_obs.shape
+    Tc = _resolve_block(T, K, t_block)
+    interpret = _interpret_default(interpret)
+    pi_t, A_t, obs_t, mask_t, _, _, Bp, Tp, nc = _pad_chunked(
+        log_pi, log_A, log_obs, mask, None, None, Tc
+    )
+    grid = (Bp // _LANES, nc)
+    score, dlast, back_all = pl.pallas_call(
+        partial(_semiring_fwd_kernel, "viterbi"),
+        grid=grid,
+        in_specs=[_fixed(K), _fixed(K, K), _t_fwd(Tc, K), _t_fwd(Tc)],
+        out_specs=(_fixed(1), _fixed(K), _t_fwd(Tc, K)),
+        out_shape=(
+            jax.ShapeDtypeStruct((1, Bp), jnp.float32),
+            jax.ShapeDtypeStruct((K, Bp), jnp.float32),
+            jax.ShapeDtypeStruct((Tp, K, Bp), jnp.float32),
+        ),
+        scratch_shapes=[pltpu.VMEM((K, _LANES), jnp.float32)],
+        interpret=interpret,
+    )(pi_t, A_t, obs_t, mask_t)
+    (path,) = pl.pallas_call(
+        _backtrack_kernel,
+        grid=grid,
+        in_specs=[_t_rev(nc, Tc, K), _fixed(K)],
+        out_specs=(_t_rev(nc, Tc),),
+        out_shape=(jax.ShapeDtypeStruct((Tp, Bp), jnp.float32),),
+        scratch_shapes=[pltpu.VMEM((1, _LANES), jnp.float32)],
+        interpret=interpret,
+    )(back_all, dlast)
+    path = path.transpose(1, 0)[:B, :T].astype(jnp.int32)
+    return path, score[0, :B]
+
+
+def semiring_ffbs(
+    log_pi: jnp.ndarray,  # [B, K]
+    log_A: jnp.ndarray,  # [B, K, K]
+    log_obs: jnp.ndarray,  # [B, T, K]
+    mask: jnp.ndarray,  # [B, T]
+    u: jnp.ndarray,  # [B, T] uniforms in [0, 1)
+    gate_key: Optional[jnp.ndarray] = None,  # [B, T]
+    state_key: Optional[jnp.ndarray] = None,  # [B, K]
+    *,
+    t_block: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Blocked fused FFBS: ``(z [B, T] int32, loglik [B])``. Pass 1 is
+    the blocked forward filter (residual to HBM); pass 2 walks the
+    blocks in reverse, drawing by inverse-CDF against the pre-drawn
+    uniforms — draw-for-draw identical to
+    `kernels/ffbs.py::ffbs_invcdf_reference` given the same ``u``.
+    ``A`` is clamped at ``_CLAMP`` on entry (the legacy resident
+    kernel's hygiene): an accidental −inf degrades to zero-probability
+    paths instead of NaN-ing every draw via ``0 * −inf``."""
+    B, T, K = log_obs.shape
+    Tc = _resolve_block(T, K, t_block)
+    interpret = _interpret_default(interpret)
+    gated = gate_key is not None
+    pi_t, A_t, obs_t, mask_t, gate_t, sk_t, Bp, Tp, nc = _pad_chunked(
+        log_pi, jnp.maximum(log_A, _CLAMP), log_obs, mask, gate_key, state_key, Tc
+    )
+    u_t = jnp.pad(
+        jnp.pad(u, [(0, Bp - B), (0, 0)]), [(0, 0), (0, Tp - T)]
+    ).transpose(1, 0)  # [Tp, Bp]
+    grid = (Bp // _LANES, nc)
+
+    # ---- pass 1: shared blocked forward filter, residual to HBM ----
+    ll, alpha_all = _run_chunked_forward(
+        pi_t, A_t, obs_t, mask_t, gate_t, sk_t, grid, Tc, interpret
+    )
+
+    # ---- pass 2: backward sampling over reversed blocks ----
+    bwd_in = [_fixed(K, K), _t_rev(nc, Tc), _t_rev(nc, Tc, K), _t_rev(nc, Tc)]
+    bwd_args = [A_t, mask_t, alpha_all, u_t]
+    if gated:
+        bwd_in += [_t_rev(nc, Tc), _fixed(K)]
+        bwd_args += [gate_t, sk_t]
+    (z,) = pl.pallas_call(
+        partial(_bwd_sample_kernel, gated),
+        grid=grid,
+        in_specs=bwd_in,
+        out_specs=(_t_rev(nc, Tc),),
+        out_shape=(jax.ShapeDtypeStruct((Tp, Bp), jnp.float32),),
+        scratch_shapes=[
+            pltpu.VMEM((1, _LANES), jnp.float32),  # z carry
+            pltpu.VMEM((1, _LANES), jnp.float32),  # mask carry
+            pltpu.VMEM((1, _LANES), jnp.float32),  # gate carry
+        ],
+        interpret=interpret,
+    )(*bwd_args)
+
+    z = z.transpose(1, 0)[:B, :T].astype(jnp.int32)  # [B, T]
+    # padded tail: repeat the last valid state (scan-kernel convention)
+    T_last = jnp.sum(mask, axis=1).astype(jnp.int32) - 1  # [B]
+    last = jnp.take_along_axis(z, T_last[:, None], axis=1)
+    z = jnp.where(jnp.arange(T)[None, :] <= T_last[:, None], z, last)
+    return z, ll[0, :B]
+
+
+def semiring_vg(
+    log_pi: jnp.ndarray,  # [B, K]
+    log_A: jnp.ndarray,  # [B, K, K]
+    log_obs: jnp.ndarray,  # [B, T, K]
+    mask: jnp.ndarray,  # [B, T]
+    gate_key: Optional[jnp.ndarray] = None,  # [B, T]
+    state_key: Optional[jnp.ndarray] = None,  # [B, K]
+    *,
+    t_block: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Blocked fused value-and-grad: ``(loglik [B], d_pi [B, K],
+    d_A [B, K, K], d_obs [B, T, K])`` — the Baum-Welch identities
+    accumulated in VMEM over reversed blocks (the NUTS leapfrog pair).
+    Inputs must be finite (models use ``safe_log``/``MASK_NEG``): the
+    gate multiplies ``log_A`` and ``0 * −inf`` would be NaN."""
+    B, T, K = log_obs.shape
+    Tc = _resolve_block(T, K, t_block)
+    interpret = _interpret_default(interpret)
+    gated = gate_key is not None
+    pi_t, A_t, obs_t, mask_t, gate_t, sk_t, Bp, Tp, nc = _pad_chunked(
+        log_pi, log_A, log_obs, mask, gate_key, state_key, Tc
+    )
+    grid = (Bp // _LANES, nc)
+
+    # ---- pass 1: forward filter, residual to HBM ----
+    ll, alpha_all = _run_chunked_forward(
+        pi_t, A_t, obs_t, mask_t, gate_t, sk_t, grid, Tc, interpret
+    )
+
+    # ---- pass 2: backward smoother + gradients, reversed blocks ----
+    bwd_in = [
+        _fixed(K, K),
+        _t_rev(nc, Tc, K),
+        _t_rev(nc, Tc),
+        _t_rev(nc, Tc, K),
+        _t_rev_prev(nc, Tc, K),
+        _fixed(1),
+    ]
+    bwd_args = [A_t, obs_t, mask_t, alpha_all, alpha_all, ll]
+    if gated:
+        bwd_in += [_t_rev(nc, Tc), _fixed(K)]
+        bwd_args += [gate_t, sk_t]
+    dpi, dA, dobs = pl.pallas_call(
+        partial(_bwd_kernel, gated),
+        grid=grid,
+        in_specs=bwd_in,
+        out_specs=(_fixed(K), _fixed(K, K), _t_rev(nc, Tc, K)),
+        out_shape=(
+            jax.ShapeDtypeStruct((K, Bp), jnp.float32),
+            jax.ShapeDtypeStruct((K, K, Bp), jnp.float32),
+            jax.ShapeDtypeStruct((Tp, K, Bp), jnp.float32),
+        ),
+        scratch_shapes=[pltpu.VMEM((K, _LANES), jnp.float32)],
+        interpret=interpret,
+    )(*bwd_args)
+
+    return (
+        ll[0, :B],
+        dpi.transpose(1, 0)[:B],
+        dA.transpose(2, 0, 1)[:B],
+        dobs.transpose(2, 0, 1)[:B, :T],
+    )
+
+
+# ---------------------------------------------------------------------------
+# single-series dispatch entries (the custom_vmap batch-collapse
+# discipline of kernels/vg.py: any vmap nesting folds into ONE flat
+# 128-lane batch; the unbatched call runs B=1)
+# ---------------------------------------------------------------------------
+
+
+def _broadcast_unbatched(axis_size, in_batched, args):
+    return tuple(
+        a if b else jnp.broadcast_to(a, (axis_size,) + a.shape)
+        for a, b in zip(args, in_batched)
+    )
+
+
+def _flatten_rule(op, n_out):
+    def rule(axis_size, in_batched, *args):
+        args = _broadcast_unbatched(axis_size, in_batched, args)
+        flat = tuple(a.reshape((-1,) + a.shape[2:]) for a in args)
+        outs = op(*flat)
+        outs = tuple(o.reshape((axis_size, -1) + o.shape[1:]) for o in outs)
+        return outs, (True,) * n_out
+
+    return rule
+
+
+def _promote_rule(batched_op, n_out):
+    def rule(axis_size, in_batched, *args):
+        args = _broadcast_unbatched(axis_size, in_batched, args)
+        return batched_op(*args), (True,) * n_out
+
+    return rule
+
+
+@custom_vmap
+def _filter_flat(log_pi, log_A, log_obs, mask):
+    return semiring_filter(log_pi, log_A, log_obs, mask)
+
+
+@custom_vmap
+def _filter_one(log_pi, log_A, log_obs, mask):
+    la, ll = semiring_filter(log_pi[None], log_A[None], log_obs[None], mask[None])
+    return la[0], ll[0]
+
+
+@custom_vmap
+def _beta_flat(log_A, log_obs, mask):
+    return (semiring_beta(log_A, log_obs, mask),)
+
+
+@custom_vmap
+def _beta_one(log_A, log_obs, mask):
+    return (semiring_beta(log_A[None], log_obs[None], mask[None])[0],)
+
+
+@custom_vmap
+def _viterbi_flat(log_pi, log_A, log_obs, mask):
+    return semiring_viterbi(log_pi, log_A, log_obs, mask)
+
+
+@custom_vmap
+def _viterbi_one(log_pi, log_A, log_obs, mask):
+    p, s = semiring_viterbi(log_pi[None], log_A[None], log_obs[None], mask[None])
+    return p[0], s[0]
+
+
+@custom_vmap
+def _ffbs_flat(u, log_pi, log_A, log_obs, mask):
+    return semiring_ffbs(log_pi, log_A, log_obs, mask, u)
+
+
+@custom_vmap
+def _ffbs_flat_gated(u, log_pi, log_A, log_obs, mask, gate_key, state_key):
+    return semiring_ffbs(log_pi, log_A, log_obs, mask, u, gate_key, state_key)
+
+
+@custom_vmap
+def _ffbs_one(u, log_pi, log_A, log_obs, mask):
+    z, ll = semiring_ffbs(log_pi[None], log_A[None], log_obs[None], mask[None], u[None])
+    return z[0], ll[0]
+
+
+@custom_vmap
+def _ffbs_one_gated(u, log_pi, log_A, log_obs, mask, gate_key, state_key):
+    z, ll = semiring_ffbs(
+        log_pi[None], log_A[None], log_obs[None], mask[None], u[None],
+        gate_key[None], state_key[None],
+    )
+    return z[0], ll[0]
+
+
+_filter_flat.def_vmap(_flatten_rule(_filter_flat, 2))
+_filter_one.def_vmap(_promote_rule(_filter_flat, 2))
+_beta_flat.def_vmap(_flatten_rule(_beta_flat, 1))
+_beta_one.def_vmap(_promote_rule(_beta_flat, 1))
+_viterbi_flat.def_vmap(_flatten_rule(_viterbi_flat, 2))
+_viterbi_one.def_vmap(_promote_rule(_viterbi_flat, 2))
+_ffbs_flat.def_vmap(_flatten_rule(_ffbs_flat, 2))
+_ffbs_flat_gated.def_vmap(_flatten_rule(_ffbs_flat_gated, 2))
+_ffbs_one.def_vmap(_promote_rule(_ffbs_flat, 2))
+_ffbs_one_gated.def_vmap(_promote_rule(_ffbs_flat_gated, 2))
+
+
+def _ones_mask(log_obs, mask):
+    if mask is None:
+        return jnp.ones(log_obs.shape[:1], log_obs.dtype)
+    return mask
+
+
+def filter_pallas(
+    log_pi, log_A, log_obs, mask=None
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Single-series `forward_filter` contract on the blocked Pallas
+    branch: ``(log_alpha [T, K], loglik)``; any vmap nesting collapses
+    into one flat kernel launch."""
+    return _filter_one(log_pi, log_A, log_obs, _ones_mask(log_obs, mask))
+
+
+def beta_pallas(log_A, log_obs, mask=None) -> jnp.ndarray:
+    """Single-series `backward_pass` contract on the blocked branch:
+    ``log_beta [T, K]``."""
+    return _beta_one(log_A, log_obs, _ones_mask(log_obs, mask))[0]
+
+
+def viterbi_pallas(
+    log_pi, log_A, log_obs, mask=None
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Single-series `viterbi` contract on the blocked branch:
+    ``(path [T] int32, log_prob)``."""
+    return _viterbi_one(log_pi, log_A, log_obs, _ones_mask(log_obs, mask))
+
+
+def ffbs_pallas(
+    log_pi, log_A, log_obs, mask, u, gate_key=None, state_key=None
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Single-series FFBS with pre-drawn uniforms ``u [T]`` — the
+    `ffbs_invcdf_reference` contract on the blocked branch."""
+    if (gate_key is None) != (state_key is None):
+        raise ValueError("gate_key and state_key must be given together")
+    if gate_key is None:
+        return _ffbs_one(u, log_pi, log_A, log_obs, mask)
+    return _ffbs_one_gated(u, log_pi, log_A, log_obs, mask, gate_key, state_key)
+
+
+def ffbs_pallas_sample(
+    key: jax.Array,
+    log_pi,
+    log_A,
+    log_obs,
+    mask=None,
+    gate_key=None,
+    state_key=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Key-based convenience over :func:`ffbs_pallas` with the SAME
+    uniform-draw convention as `kernels/ffbs.py::ffbs_fused` and
+    `kernels/assoc.py::ffbs_assoc_sample` (``uniform(key, (T,),
+    dtype)``) — the three branches are draw-for-draw interchangeable
+    under `kernels/dispatch.py`."""
+    T = log_obs.shape[0]
+    u = jax.random.uniform(key, (T,), log_obs.dtype)
+    return ffbs_pallas(
+        log_pi, log_A, log_obs, _ones_mask(log_obs, mask), u, gate_key, state_key
+    )

@@ -141,16 +141,17 @@ def _pallas_chunked_eligible(log_pi_b, log_A_b, log_obs_b) -> bool:
 def _vg_batched(log_pi, log_A, log_obs, mask):
     """One flat leading batch axis on every arg."""
     if _pallas_eligible(log_pi, log_A, log_obs):
-        from hhmm_tpu.kernels.pallas_forward import pallas_forward_vg
+        from hhmm_tpu.kernels.pallas_semiring import semiring_vg
 
-        return pallas_forward_vg(log_pi, log_A, log_obs, mask)
-    if _pallas_chunked_eligible(log_pi, log_A, log_obs):
-        from hhmm_tpu.kernels.pallas_forward_chunked import (
-            pallas_forward_vg_chunked,
+        # resident schedule: the whole window in one VMEM block
+        return semiring_vg(
+            log_pi, log_A, log_obs, mask, t_block=log_obs.shape[1]
         )
+    if _pallas_chunked_eligible(log_pi, log_A, log_obs):
+        from hhmm_tpu.kernels.pallas_semiring import semiring_vg
 
-        return pallas_forward_vg_chunked(
-            log_pi, log_A, log_obs, mask, t_chunk=chunk_for_k(log_obs.shape[2])
+        return semiring_vg(
+            log_pi, log_A, log_obs, mask, t_block=chunk_for_k(log_obs.shape[2])
         )
     return jax.vmap(_vg_single)(log_pi, log_A, log_obs, mask)
 
@@ -168,19 +169,18 @@ def _vg_batched_rule(axis_size, in_batched, *args):
 @custom_vmap
 def _vg_batched_gated(log_pi, log_A, log_obs, mask, gate_key, state_key):
     if _pallas_eligible(log_pi, log_A, log_obs):
-        from hhmm_tpu.kernels.pallas_forward import pallas_forward_vg
+        from hhmm_tpu.kernels.pallas_semiring import semiring_vg
 
-        return pallas_forward_vg(
-            log_pi, log_A, log_obs, mask, gate_key=gate_key, state_key=state_key
+        return semiring_vg(
+            log_pi, log_A, log_obs, mask, gate_key, state_key,
+            t_block=log_obs.shape[1],
         )
     if _pallas_chunked_eligible(log_pi, log_A, log_obs):
-        from hhmm_tpu.kernels.pallas_forward_chunked import (
-            pallas_forward_vg_chunked,
-        )
+        from hhmm_tpu.kernels.pallas_semiring import semiring_vg
 
-        return pallas_forward_vg_chunked(
+        return semiring_vg(
             log_pi, log_A, log_obs, mask, gate_key, state_key,
-            t_chunk=chunk_for_k(log_obs.shape[2]),
+            t_block=chunk_for_k(log_obs.shape[2]),
         )
     return jax.vmap(_vg_single_gated)(log_pi, log_A, log_obs, mask, gate_key, state_key)
 

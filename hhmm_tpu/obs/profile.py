@@ -73,7 +73,7 @@ __all__ = [
     "cost_analysis",
     "PEAKS",
     "roofline",
-    "decode_kernel_pairs",
+    "decode_kernel_fns",
     "dirichlet_hmm_inputs",
     "row_key",
     "KernelCostDB",
@@ -350,17 +350,20 @@ def roofline(
 # ---------------------------------------------------------------------------
 
 
-def decode_kernel_pairs() -> Dict[str, Tuple[Any, Any]]:
-    """``{kernel_name: (seq_fn, assoc_fn)}`` — the decode kernels every
-    cost-DB writer times, defined ONCE. `bench.py --profile-kernels`
-    and `scripts/tpu_assoc_probe.py` both feed rows into the same DB
-    under these (kernel, branch) keys, and :meth:`KernelCostDB.winner`
-    arbitrates across writers — so both writers MUST measure the exact
-    same computation per key (same blocked-on output, same FFBS
-    pre-drawn-uniform convention). Each fn takes
-    ``(log_pi, log_A, log_obs, mask)``. Lazy kernel imports: this
-    module sits below ``kernels/`` in the import graph
-    (`kernels/dispatch.py` imports it)."""
+def decode_kernel_fns() -> Dict[str, Dict[str, Any]]:
+    """``{kernel_name: {branch: fn}}`` over the full branch enum
+    ``{seq, assoc, pallas}`` — the decode kernels every cost-DB writer
+    times, defined ONCE. `bench.py --profile-kernels` and
+    `scripts/tpu_assoc_probe.py` both feed rows into the same DB under
+    these (kernel, branch) keys, and :meth:`KernelCostDB.winner`
+    arbitrates N-way across writers — so both writers MUST measure the
+    exact same computation per key (same blocked-on output, same FFBS
+    pre-drawn-uniform convention; the pallas fns are the single-series
+    dispatch entries whose ``vmap`` collapses into the flat blocked
+    kernel, reached through `kernels/dispatch.py` — the sanctioned
+    entry). Each fn takes ``(log_pi, log_A, log_obs, mask)``. Lazy
+    kernel imports: this module sits below ``kernels/`` in the import
+    graph (`kernels/dispatch.py` imports it)."""
     import jax
 
     from hhmm_tpu.kernels import (  # lint: ok layer-import -- deliberate lazy cycle-breaker: obs sits below kernels (dispatch imports obs.trace/profile); this driver-only helper resolves at call time, never at import time
@@ -371,24 +374,34 @@ def decode_kernel_pairs() -> Dict[str, Tuple[Any, Any]]:
         viterbi,
         viterbi_assoc,
     )
+    from hhmm_tpu.kernels.dispatch import (  # lint: ok layer-import -- same deliberate lazy cycle-breaker as above: the sanctioned Pallas entries live on the dispatch layer
+        ffbs_pallas_sample,
+        filter_pallas,
+        viterbi_pallas,
+    )
 
     return {
-        "filter": (
-            lambda lp, lA, lo, m: forward_filter(lp, lA, lo, m)[1],
-            lambda lp, lA, lo, m: forward_filter_assoc(lp, lA, lo, m)[1],
-        ),
-        "viterbi": (
-            lambda lp, lA, lo, m: viterbi(lp, lA, lo, m)[0],
-            lambda lp, lA, lo, m: viterbi_assoc(lp, lA, lo, m)[0],
-        ),
-        "ffbs": (
-            lambda lp, lA, lo, m: ffbs_fused(
+        "filter": {
+            "seq": lambda lp, lA, lo, m: forward_filter(lp, lA, lo, m)[1],
+            "assoc": lambda lp, lA, lo, m: forward_filter_assoc(lp, lA, lo, m)[1],
+            "pallas": lambda lp, lA, lo, m: filter_pallas(lp, lA, lo, m)[1],
+        },
+        "viterbi": {
+            "seq": lambda lp, lA, lo, m: viterbi(lp, lA, lo, m)[0],
+            "assoc": lambda lp, lA, lo, m: viterbi_assoc(lp, lA, lo, m)[0],
+            "pallas": lambda lp, lA, lo, m: viterbi_pallas(lp, lA, lo, m)[0],
+        },
+        "ffbs": {
+            "seq": lambda lp, lA, lo, m: ffbs_fused(
                 jax.random.PRNGKey(0), lp, lA, lo, m
             )[0],
-            lambda lp, lA, lo, m: ffbs_assoc_sample(
+            "assoc": lambda lp, lA, lo, m: ffbs_assoc_sample(
                 jax.random.PRNGKey(0), lp, lA, lo, m
             )[0],
-        ),
+            "pallas": lambda lp, lA, lo, m: ffbs_pallas_sample(
+                jax.random.PRNGKey(0), lp, lA, lo, m
+            )[0],
+        },
     }
 
 
@@ -598,47 +611,66 @@ class KernelCostDB:
         return out
 
     def winner(
-        self, kernel: str, K: int, T: int, device_kind: Optional[str]
+        self,
+        kernel: str,
+        K: int,
+        T: int,
+        device_kind: Optional[str],
+        allowed: Optional[Sequence[str]] = None,
     ) -> Optional[str]:
-        """``"assoc"`` / ``"seq"`` / ``None``: the measured branch
-        winner at one (kernel, K, T) point on ``device_kind``.
+        """The measured branch winner (a branch NAME — ``"seq"`` /
+        ``"assoc"`` / ``"pallas"`` / …) at one (kernel, K, T) point on
+        ``device_kind``, or ``None`` (unmeasured).
 
         Branches are only compared within one (B, dtype, jax) stamp —
         the comparability rule: a seq row timed at B=64 must not race
-        an assoc row timed single-series. Among complete pairs the
-        LARGEST batch wins the arbitration (the batched crossover is
-        the honest dispatch default — `docs/parallel_scan.md`), ties
-        broken by the NEWEST measurement (row ``ts``; the "%F %T"
-        stamp sorts lexicographically in time order — a re-probe after
-        a jax upgrade must outrank the obsolete pair, and a naive jax
+        an assoc row timed single-series. Arbitration is **N-way**: a
+        stamp group qualifies when it holds ≥ 2 measured branches (a
+        lone branch has raced nothing — a pallas-only group must not
+        route dispatch), and the winner is the group's fastest branch
+        by p50. Among qualifying groups the LARGEST batch wins the
+        arbitration (the batched crossover is the honest dispatch
+        default — `docs/parallel_scan.md`), ties broken by the NEWEST
+        measurement (row ``ts``; the "%F %T" stamp sorts
+        lexicographically in time order — a re-probe after a jax
+        upgrade must outrank the obsolete group, and a naive jax
         version-string compare would rank "0.4.9" over "0.4.30").
-        Timing-only rows need a finite ``p50_s``; anything less yields
-        ``None`` (unmeasured — the caller falls back to the static
-        table)."""
+        Within a group, exact-p50 ties break toward seq (then assoc)
+        — the conservative baseline, preserving the historical two-way
+        behavior. ``allowed`` restricts the race to a branch subset
+        (the dispatch layer passes ``("seq", "assoc")`` for
+        pallas-ineligible call signatures). Timing-only rows need a
+        finite ``p50_s``; anything less yields ``None`` (the caller
+        falls back to the static table)."""
         if device_kind is None:
             return None
-        pairs: Dict[Tuple[int, str, str], Dict[str, float]] = {}
-        pair_ts: Dict[Tuple[int, str, str], str] = {}
+        groups: Dict[Tuple[int, str, str], Dict[str, float]] = {}
+        group_ts: Dict[Tuple[int, str, str], str] = {}
         for row in self.matching(kernel, K, T, device_kind):
+            branch = str(row.get("branch"))
+            if allowed is not None and branch not in allowed:
+                continue
             t = row.get("timing") or {}
             p50 = t.get("p50_s")
             if not isinstance(p50, (int, float)) or not math.isfinite(p50) or p50 <= 0:
                 continue
             base = (int(row.get("B") or 0), str(row.get("dtype")), str(row.get("jax")))
-            pairs.setdefault(base, {})[str(row.get("branch"))] = float(p50)
+            groups.setdefault(base, {})[branch] = float(p50)
             ts = str(row.get("ts") or "")
-            if ts > pair_ts.get(base, ""):
-                pair_ts[base] = ts
-        complete = [
-            (base, d) for base, d in pairs.items() if "seq" in d and "assoc" in d
-        ]
+            if ts > group_ts.get(base, ""):
+                group_ts[base] = ts
+        complete = [(base, d) for base, d in groups.items() if len(d) >= 2]
         if not complete:
             return None
         complete.sort(
-            key=lambda it: (it[0][0], pair_ts.get(it[0], ""), it[0][1], it[0][2])
+            key=lambda it: (it[0][0], group_ts.get(it[0], ""), it[0][1], it[0][2])
         )
         _, best = complete[-1]
-        return "assoc" if best["assoc"] < best["seq"] else "seq"
+        # tie preference: the conservative ladder seq < assoc < anything
+        pref = {"seq": 0, "assoc": 1}
+        return min(
+            best, key=lambda b: (best[b], pref.get(b, 2), b)
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -706,22 +738,34 @@ def refresh() -> None:
 
 
 def dispatch_winner(
-    kernel: str, K: int, T: int, device_kind: Optional[str]
-) -> Optional[bool]:
-    """The dispatch-facing read: ``True`` (assoc) / ``False`` (seq)
-    when the DB holds a measured winner for this exact (kernel, K, T)
-    on this host's device kind, else ``None`` (fall back to the static
-    table). Memoized — `kernels/dispatch.py` calls this once per draw
-    per kernel at trace time and the answer cannot change between DB
-    writes. The miss path computes AND stores under ``_DB_LOCK`` — the
-    same lock every invalidation (:func:`set_db` / :func:`refresh` /
-    row writes) clears under — so a concurrent rebind can never
+    kernel: str,
+    K: int,
+    T: int,
+    device_kind: Optional[str],
+    allowed: Optional[Sequence[str]] = None,
+) -> Optional[str]:
+    """The dispatch-facing read: the measured winner's branch NAME
+    (``"seq"`` / ``"assoc"`` / ``"pallas"``) when the DB holds a
+    measured N-way race for this exact (kernel, K, T) on this host's
+    device kind, else ``None`` (fall back to the static table).
+    ``allowed`` restricts the race to a branch subset (part of the
+    memo key). Memoized — `kernels/dispatch.py` calls this once per
+    draw per kernel at trace time and the answer cannot change between
+    DB writes. The miss path computes AND stores under ``_DB_LOCK`` —
+    the same lock every invalidation (:func:`set_db` / :func:`refresh`
+    / row writes) clears under — so a concurrent rebind can never
     interleave between a stale compute and its cache write and pin the
     pre-refresh answer; the hit path stays lock-free, and the lazy
     first-touch disk read happens in :func:`active_db` BEFORE the lock
     is taken (held-lock-escape — the locked region re-reads
     ``_ACTIVE_DB`` so a rebind that won the race still governs)."""
-    ck = (str(kernel), int(K), int(T), device_kind)
+    ck = (
+        str(kernel),
+        int(K),
+        int(T),
+        device_kind,
+        None if allowed is None else tuple(allowed),
+    )
     w = _WINNER_CACHE.get(ck, _MISSING)
     while w is _MISSING:
         db = active_db()
@@ -737,8 +781,6 @@ def dispatch_winner(
                 # prevent — loop so active_db() re-binds and the
                 # answer comes from the post-restore DB
                 continue
-            w = _ACTIVE_DB.winner(kernel, K, T, device_kind)
+            w = _ACTIVE_DB.winner(kernel, K, T, device_kind, allowed=allowed)
             _WINNER_CACHE[ck] = w
-    if w is None:
-        return None
-    return w == "assoc"
+    return w

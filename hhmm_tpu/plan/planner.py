@@ -261,6 +261,8 @@ class Plan:
 
         if self.branch == "assoc":
             return dispatch.plan_time_parallel(True)
+        if self.branch == "pallas":
+            return dispatch.plan_time_parallel("pallas")
         if self.branch == "scan":
             return dispatch.plan_time_parallel(False)
         return dispatch.plan_time_parallel(None)
@@ -310,26 +312,29 @@ def _resolve_branch(shape: WorkloadShape, sp_ways: int, time_parallel, platform)
 
     The plan's branch is ONE decision pinned onto EVERY kernel that
     dispatches under ``plan.dispatch_scope()`` — so it is resolved at
-    the conservative bar: assoc only when ALL the decode families the
-    pin will govern (filter, viterbi, ffbs) resolve assoc for this
-    (K, T). A partial-family DB win must not route the others into
-    per-draw [T-1, K, K] operator materialization (the round-4 HBM
-    regression) through the planner pin — the same unmeasured bet the
-    per-kernel dispatch rule forbids at the direct call sites. (On a
-    table-only host every family reads the same table row, so this
-    reduces exactly to the pre-DB behavior.)"""
+    the conservative bar: a non-scan branch (assoc or pallas) only
+    when ALL the decode families the pin will govern (filter, viterbi,
+    ffbs) resolve the SAME branch for this (K, T). A partial-family DB
+    win must not route the others into an unmeasured kernel through
+    the planner pin — assoc's per-draw [T-1, K, K] operator
+    materialization (the round-4 HBM regression) and pallas alike;
+    that is the same unmeasured bet the per-kernel dispatch rule
+    forbids at the direct call sites. (On a table-only host every
+    family reads the same table row, so this reduces exactly to the
+    pre-DB behavior.)"""
     if sp_ways > 1:
         return "seqshard"
-    from hhmm_tpu.kernels.dispatch import use_assoc
+    from hhmm_tpu.kernels.dispatch import resolve_branch
 
-    return (
-        "assoc"
-        if all(
-            use_assoc(shape.K, shape.T, time_parallel, platform, kernel=k)
-            for k in ("filter", "viterbi", "ffbs")
-        )
-        else "scan"
-    )
+    branches = {
+        resolve_branch(shape.K, shape.T, time_parallel, platform, kernel=k)
+        for k in ("filter", "viterbi", "ffbs")
+    }
+    if branches == {"assoc"}:
+        return "assoc"
+    if branches == {"pallas"}:
+        return "pallas"
+    return "scan"
 
 
 def _round_up(n: int, multiple: int) -> int:

@@ -45,14 +45,76 @@ from hhmm_tpu.batch.cache import (
 
 __all__ = [
     "SNAPSHOT_VERSION",
+    "SNAPSHOT_DTYPES",
     "PosteriorSnapshot",
     "SnapshotRegistry",
     "model_spec",
     "build_model",
     "snapshot_from_fit",
+    "quantize_draws",
+    "dequantize_draws",
 ]
 
 SNAPSHOT_VERSION = "serve-snapshot-v1"
+
+# ---- draw-bank quantization ----
+#
+# The pager (`serve/pager.py`) budgets RESIDENT bytes; the draw bank is
+# ~all of a snapshot's bytes. Quantizing it bf16/f16 halves the
+# resident cost — 2× more snapshots under the same byte budget (the
+# `serve.pager_resident_bytes` gauge proves it) — at a posterior-draw
+# precision loss the one-step predictive loglik parity gate bounds
+# (tests/test_serve.py). Storage: the packed representation goes into
+# the .npz verbatim (bf16 as a uint16 bit-view — numpy has no native
+# bfloat16, and the .npz must load on jax-less hosts), tagged by
+# ``draws_dtype``; dequantization to f32 happens at ATTACH
+# (`serve/scheduler.py`), so residency stays packed end to end.
+
+SNAPSHOT_DTYPES = ("float32", "bfloat16", "float16")
+
+
+def quantize_draws(draws: np.ndarray, dtype: str) -> np.ndarray:
+    """Pack an f32/f64 draw bank into the storage representation of
+    ``dtype``: ``"float32"`` is the identity (legacy layout),
+    ``"float16"`` a native-numpy cast, ``"bfloat16"`` a
+    round-to-nearest-even truncation to the high 16 bits of the f32
+    pattern, stored as uint16 (portable — no ml_dtypes dependency)."""
+    if dtype == "float32":
+        return np.asarray(draws)
+    if dtype == "float16":
+        return np.asarray(draws, np.float32).astype(np.float16)
+    if dtype == "bfloat16":
+        f32 = np.ascontiguousarray(np.asarray(draws, np.float32))
+        # uint64 intermediate: the rounding add must not wrap the
+        # all-ones (-NaN) bit pattern around to +0
+        bits = f32.view(np.uint32).astype(np.uint64)
+        # IEEE round-to-nearest-even on the dropped 16 mantissa bits
+        rounded = ((bits + 0x7FFF + ((bits >> 16) & 1)) >> 16).astype(np.uint16)
+        # NaN payloads below bit 16 would round to ±inf; force a
+        # mantissa bit instead (the standard bf16-converter NaN rule)
+        # so a diverged draw bank keeps its NaN markers through the
+        # pack — downstream health checks must still see them
+        nan_packed = ((bits >> 16) | 0x40).astype(np.uint16)
+        return np.where(np.isnan(f32), nan_packed, rounded)
+    raise ValueError(
+        f"unsupported snapshot dtype {dtype!r} (supported: {SNAPSHOT_DTYPES})"
+    )
+
+
+def dequantize_draws(packed: np.ndarray, dtype: str) -> np.ndarray:
+    """The inverse of :func:`quantize_draws`, always returning
+    float32 — the serving numerics every attach path feeds the
+    device."""
+    if dtype == "float32":
+        return np.asarray(packed, np.float32)
+    if dtype == "float16":
+        return np.asarray(packed).astype(np.float32)
+    if dtype == "bfloat16":
+        u16 = np.ascontiguousarray(np.asarray(packed, np.uint16))
+        return (u16.astype(np.uint32) << 16).view(np.float32)
+    raise ValueError(
+        f"unsupported snapshot dtype {dtype!r} (supported: {SNAPSHOT_DTYPES})"
+    )
 
 
 # ---- model spec round-trip ----
@@ -125,16 +187,33 @@ def build_model(spec: Dict[str, Any]):
 
 @dataclass(frozen=True)
 class PosteriorSnapshot:
-    """A servable posterior: thinned draws + spec + health."""
+    """A servable posterior: thinned draws + spec + health.
+
+    ``draws`` holds the STORAGE representation: the raw float bank for
+    ``draws_dtype="float32"`` (the legacy layout), or the packed
+    quantized bank (f16, or bf16 as a uint16 bit-view) otherwise — so
+    a resident snapshot costs its quantized bytes in the pager.
+    Consumers that feed draws to the device go through
+    :meth:`dequantized_draws` (the attach-time dequantize)."""
 
     spec: Dict[str, Any]
-    draws: np.ndarray  # [D, dim] thinned unconstrained draws
+    draws: np.ndarray  # [D, dim] thinned unconstrained draws (packed)
     healthy: bool = True
     version: str = SNAPSHOT_VERSION
     meta: Dict[str, Any] = field(default_factory=dict)
+    draws_dtype: str = "float32"
 
     def model(self):
         return build_model(self.spec)
+
+    def dequantized_draws(self) -> np.ndarray:
+        """The draw bank in serving numerics: the stored array
+        untouched for float32 snapshots (legacy dtype behavior
+        preserved bit for bit), else the f32 dequantization of the
+        packed bank."""
+        if self.draws_dtype == "float32":
+            return np.asarray(self.draws)
+        return dequantize_draws(self.draws, self.draws_dtype)
 
 
 def snapshot_from_fit(
@@ -143,6 +222,7 @@ def snapshot_from_fit(
     chain_healthy=None,
     n_draws: int = 64,
     meta: Optional[Dict[str, Any]] = None,
+    dtype: str = "float32",
 ) -> PosteriorSnapshot:
     """Thin one series' fit into a servable snapshot.
 
@@ -155,7 +235,13 @@ def snapshot_from_fit(
     healthy serving state). Thinning is the evenly-spaced ``linspace``
     selection the walk-forward decode uses, repeat-padded so every
     snapshot carries exactly ``n_draws`` rows (fixed draw count = one
-    compile per scheduler bucket)."""
+    compile per scheduler bucket).
+
+    ``dtype`` opts the draw bank into quantized storage/residency
+    (``"bfloat16"``/``"float16"`` — see :func:`quantize_draws`): the
+    snapshot then costs half its f32 bytes in the pager budget, and
+    the scheduler dequantizes at attach. Gate adoption on the
+    one-step predictive-loglik parity test (tests/test_serve.py)."""
     samples = np.asarray(samples)
     if samples.ndim != 3:
         raise ValueError(f"samples must be [chains, draws, dim], got {samples.shape}")
@@ -174,11 +260,16 @@ def snapshot_from_fit(
     draws = flat[sel]
     if len(draws) < n_draws:  # repeat-pad tiny posteriors to the fixed D
         draws = draws[np.arange(n_draws) % len(draws)]
+    if dtype not in SNAPSHOT_DTYPES:
+        raise ValueError(
+            f"unsupported snapshot dtype {dtype!r} (supported: {SNAPSHOT_DTYPES})"
+        )
     return PosteriorSnapshot(
         spec=model_spec(model),
-        draws=np.ascontiguousarray(draws),
+        draws=np.ascontiguousarray(quantize_draws(draws, dtype)),
         healthy=healthy,
         meta=dict(meta or {}),
+        draws_dtype=dtype,
     )
 
 
@@ -248,7 +339,11 @@ class SnapshotRegistry:
             {
                 "version": np.asarray(snap.version),
                 "spec_json": np.asarray(json.dumps(snap.spec, sort_keys=True)),
+                # the PACKED bank goes to disk verbatim (bf16 stays a
+                # uint16 bit-view): quantized snapshots are quantized
+                # at rest AND resident, not just in flight
                 "draws": np.asarray(snap.draws),
+                "draws_dtype": np.asarray(str(snap.draws_dtype)),
                 "healthy": np.asarray(bool(snap.healthy)),
                 "meta_json": np.asarray(
                     json.dumps(snap.meta, sort_keys=True, default=str)
@@ -266,6 +361,12 @@ class SnapshotRegistry:
             version = str(raw["version"])
             spec = json.loads(str(raw["spec_json"]))
             draws = np.asarray(raw["draws"])
+            # pre-quantization archives carry no tag: they are f32
+            draws_dtype = (
+                str(raw["draws_dtype"]) if "draws_dtype" in raw else "float32"
+            )
+            if draws_dtype not in SNAPSHOT_DTYPES:
+                raise ValueError(f"unknown draws_dtype {draws_dtype!r}")
             healthy = bool(raw["healthy"])
             meta = json.loads(str(raw["meta_json"]))
         except Exception as e:
@@ -283,5 +384,10 @@ class SnapshotRegistry:
             )
             return None
         return PosteriorSnapshot(
-            spec=spec, draws=draws, healthy=healthy, version=version, meta=meta
+            spec=spec,
+            draws=draws,
+            healthy=healthy,
+            version=version,
+            meta=meta,
+            draws_dtype=draws_dtype,
         )

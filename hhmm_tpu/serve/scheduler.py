@@ -492,7 +492,10 @@ class MicroBatchScheduler:
                 ))
                 continue
             projected.add(series_id)
-            draws = np.asarray(use.draws)
+            # attach-time dequantize: a quantized snapshot stays packed
+            # at rest and in the pager's residency accounting, but the
+            # device always serves f32 (no-op for legacy f32 banks)
+            draws = use.dequantized_draws()
             if n_draws is None:
                 n_draws = draws.shape[0]
             resolved.append(

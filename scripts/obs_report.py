@@ -389,13 +389,23 @@ def render_kernel_costs(man: Dict[str, Any], out) -> None:
         "plan": "plan-pinned",
         "default": "unmeasured (scan default)",
     }
+    branches = kc.get("branches")
+    if isinstance(branches, (list, tuple)) and branches:
+        print(f"  raced branches: {'/'.join(str(b) for b in branches)}", file=out)
     for d in kc.get("dispatch") or []:
         if not isinstance(d, dict):
             continue
+        raced = d.get("raced")
+        tail = (
+            f" [raced {'/'.join(str(b) for b in raced)}]"
+            if isinstance(raced, (list, tuple)) and raced
+            else ""
+        )
         print(
             f"  auto {_fmt(d.get('kernel'))} K={_fmt(d.get('K'))} "
             f"T={_fmt(d.get('T'))}: {_fmt(d.get('auto'))} "
-            f"({src_label.get(d.get('source'), _fmt(d.get('source')))})",
+            f"({src_label.get(d.get('source'), _fmt(d.get('source')))})"
+            f"{tail}",
             file=out,
         )
     if kc.get("db_path"):

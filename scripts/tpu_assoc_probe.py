@@ -5,13 +5,19 @@ grid that `kernels/dispatch.py` dispatches on (mirrors
 where this measurement says it wins).
 
 Grid: K ∈ {2, 4, 8} × T ∈ {128, 256, 512, 1024, 2048, 4096}, three
-kernels per point — forward filter, Viterbi, FFBS — timed twice each:
-single-series jitted (the latency-bound decode path) and vmapped over a
-B=64 batch (the throughput path; batching already fills the machine, so
-the assoc win shrinks and the batched crossover is the honest one for
-dispatch defaults). Fresh pre-generated device inputs per timed call
-(host RNG + H2D outside the window), ``block_until_ready`` + host
-reduction — the tunnel-discipline rules of `tpu_pack2_probe.py`.
+kernels per point — forward filter, Viterbi, FFBS — and now the FULL
+branch enum per kernel: seq, assoc, and (on TPU hardware, or with
+``--pallas-interpret``) the blocked Pallas semiring branch, all
+reached through the `kernels/dispatch.py` entries. Each is timed
+twice: single-series jitted (the latency-bound decode path) and
+vmapped over a B=64 batch (the throughput path; batching already
+fills the machine, so the branch gaps shrink and the batched
+crossover is the honest one for dispatch defaults). Fresh
+pre-generated device inputs per timed call (host RNG + H2D outside
+the window), ``block_until_ready`` + host reduction — the
+tunnel-discipline rules of `tpu_pack2_probe.py`. A TPU run therefore
+writes branch="pallas" rows next to seq/assoc at the same (K, T, B)
+points and FLIPS three-way dispatch with zero code change.
 
 Writes TWO artifacts from one measurement:
 
@@ -72,6 +78,14 @@ def main():
         help="kernel cost DB to write the measured rows into (default: "
         "results/kernel_costs.json, or $HHMM_TPU_KERNEL_COSTS)",
     )
+    ap.add_argument(
+        "--pallas-interpret",
+        action="store_true",
+        help="race the pallas branch on a non-TPU backend through the "
+        "Pallas interpreter (plumbing smoke only — interpreter timings "
+        "are not dispatch-grade, so pair this with a scratch "
+        "--kernel-costs-out)",
+    )
     args = ap.parse_args()
 
     import jax
@@ -125,32 +139,36 @@ def main():
         "batch": B,
         "points": [],
     }
-    kernels = obs_profile.decode_kernel_pairs()
+    kernels = obs_profile.decode_kernel_fns()
+    # the pallas branch is raced on TPU hardware (the rows that flip
+    # three-way dispatch); on other backends only under the explicit
+    # interpreter smoke flag — interpreter wall time is not a device
+    # measurement and the grid Ts would take minutes per point
+    pallas_here = backend == "tpu" or args.pallas_interpret
+    branch_names = ("seq", "assoc", "pallas") if pallas_here else ("seq", "assoc")
+    rec["branches"] = list(branch_names)
     for K in args.Ks:
         for T in args.Ts:
             point = {"K": K, "T": T}
-            for name, (seq_fn, assoc_fn) in kernels.items():
+            for name, fns in kernels.items():
                 for tag, batch in (("", None), ("_b", B)):
                     sets = [inputs(K, T, batch) for _ in range(reps + 1)]
                     jax.block_until_ready(sets)
-                    f_seq = jax.jit(
-                        jax.vmap(seq_fn) if batch else seq_fn
-                    )
-                    f_assoc = jax.jit(
-                        jax.vmap(assoc_fn) if batch else assoc_fn
-                    )
-                    t_seq = timed(f_seq, sets)
-                    t_assoc = timed(f_assoc, sets)
-                    point[f"{name}{tag}_seq_ms"] = round(t_seq.mean_s * 1e3, 3)
-                    point[f"{name}{tag}_assoc_ms"] = round(
-                        t_assoc.mean_s * 1e3, 3
-                    )
+                    timings = {}
+                    for branch in branch_names:
+                        fn = jax.jit(
+                            jax.vmap(fns[branch]) if batch else fns[branch]
+                        )
+                        timings[branch] = timed(fn, sets)
+                        point[f"{name}{tag}_{branch}_ms"] = round(
+                            timings[branch].mean_s * 1e3, 3
+                        )
                     point[f"{name}{tag}_speedup"] = round(
-                        t_seq.mean_s / t_assoc.mean_s, 3
+                        timings["seq"].mean_s / timings["assoc"].mean_s, 3
                     )
                     # the same measurement lands in the dispatch-readable
                     # cost DB (single series recorded as B=1)
-                    for branch, timing in (("seq", t_seq), ("assoc", t_assoc)):
+                    for branch, timing in timings.items():
                         db.put_row(
                             kernel=name,
                             branch=branch,

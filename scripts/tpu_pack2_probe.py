@@ -1,32 +1,44 @@
-"""Measure the sublane-packed FFBS kernel vs the resident kernel on
-the headline-bench shape (VERDICT r4 ask 5).
+"""Measure the blocked semiring FFBS kernel across block sizes on the
+headline-bench shape (formerly the pack2-vs-resident probe; the
+sublane-packed experiment is retired — `kernels/pallas_ffbs_pack2.py`
+is a deprecated shim over the unified kernel, so the open tuning knob
+at this shape is now ``t_block``).
 
 B=256, T=1024, K=4, dense masks — the exact shape of the bench's Gibbs
 FFBS launches (the bench runs the HARD gate, which masks emissions and
 dispatches the UNGATED kernel; a gated row is measured too for the
-gate-key workloads that fit the resident bound). Records per-call wall
-times and speedups into `results/pack2_timing.json`; the dispatcher
-only adopts pack2 where this measurement says it wins. Tunnel
-discipline: fresh pre-generated device uniforms per timed call (host
-RNG + H2D stay OUTSIDE the timed window), block_until_ready + host
-reduction. Wall target < 4 min.
+gate-key workloads that fit the single-block bound). Records per-call
+wall times and speedups vs the single-block (resident) schedule into
+`results/pack2_timing.json`; `docs/parallel_scan.md`'s block-size
+guidance is anchored on this measurement. Tunnel discipline: fresh
+pre-generated device uniforms per timed call (host RNG + H2D stay
+OUTSIDE the timed window), timing through the canonical
+``device_time`` harness (`obs/profile.py`). Wall target < 4 min.
 """
 
 import json
 import os
+import sys
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:  # runnable as `python scripts/tpu_pack2_probe.py`
+    sys.path.insert(0, _ROOT)
+
 OUT = os.path.join(os.path.dirname(__file__), "..", "results", "pack2_timing.json")
+
+BLOCKS = (128, 256, 512, 1024)  # 1024 = single-block (resident) at T=1024
 
 
 def main():
     assert jax.default_backend() == "tpu", jax.default_backend()
-    from hhmm_tpu.kernels.pallas_ffbs import pallas_ffbs
-    from hhmm_tpu.kernels.pallas_ffbs_pack2 import pallas_ffbs_pack2
+    # the sanctioned Pallas entry (analysis rule pallas-import)
+    from hhmm_tpu.kernels.dispatch import semiring_ffbs
+    from hhmm_tpu.obs import profile as obs_profile
 
     rng = np.random.default_rng(7)
     B, T, K = 256, 1024, 4
@@ -38,15 +50,17 @@ def main():
     skey = jnp.asarray(np.tile((np.arange(K) % 2).astype(np.float32), (B, 1)))
 
     rec = {"device": str(jax.devices()[0]), "ts": time.strftime("%F %T"),
-           "shape": {"B": B, "T": T, "K": K}}
+           "shape": {"B": B, "T": T, "K": K}, "blocks": list(BLOCKS)}
     reps = 30
     for mode, gargs in (("ungated", ()), ("gated", (gate, skey))):
-        fns = {
-            "resident": jax.jit(pallas_ffbs),
-            "pack2": jax.jit(pallas_ffbs_pack2),
-        }
         times = {}
-        for name, fn in fns.items():
+        z_by_block = {}
+        for t_block in BLOCKS:
+            fn = jax.jit(
+                lambda lp, lA, lo, m, u, *g, tb=t_block: semiring_ffbs(
+                    lp, lA, lo, m, u, *g, t_block=tb
+                )
+            )
             # pre-generate every rep's uniforms ON DEVICE before the
             # timer: fresh inputs defeat tunnel memoization without
             # paying host RNG + transfer inside the measured window
@@ -57,36 +71,27 @@ def main():
                 for _ in range(reps + 1)
             ]
             jax.block_until_ready(us)
-            z, ll = fn(log_pi, log_A, log_obs, mask, us[-1], *gargs)  # compile
-            float(np.asarray(ll.sum()))
-            # monotonic clock only (check_guards invariant 5a): these
-            # per-call times feed the dispatcher's adoption decision
-            t0 = time.perf_counter()
-            for r in range(reps):
-                z, ll = fn(log_pi, log_A, log_obs, mask, us[r], *gargs)
-                float(np.asarray(ll.sum()))
-            dt = (time.perf_counter() - t0) / reps
-            times[name] = dt
-            print(f"{mode}/{name}: {dt * 1e3:.2f} ms/call", flush=True)
-        # parity on device: same uniforms -> same draws
-        u = jnp.asarray(rng.uniform(size=(B, T)), jnp.float32)
-        z_r, ll_r = fns["resident"](log_pi, log_A, log_obs, mask, u, *gargs)
-        z_p, ll_p = fns["pack2"](log_pi, log_A, log_obs, mask, u, *gargs)
+            sets = [(log_pi, log_A, log_obs, mask, u) + gargs for u in us]
+            t = obs_profile.device_time(fn, arg_sets=sets, reps=reps)
+            times[t_block] = t.p50_s
+            print(f"{mode}/t_block={t_block}: {t.p50_s * 1e3:.2f} ms/call",
+                  flush=True)
+            # parity across schedules: same uniforms -> same draws
+            z, _ = fn(log_pi, log_A, log_obs, mask, us[0], *gargs)
+            z_by_block[t_block] = np.asarray(z)
+        resident = times[max(BLOCKS)]
+        z_ref = z_by_block[max(BLOCKS)]
         rec[mode] = {
-            "resident_ms": round(times["resident"] * 1e3, 3),
-            "pack2_ms": round(times["pack2"] * 1e3, 3),
-            "speedup_pack2": round(times["resident"] / times["pack2"], 3),
-            "device_parity": {
-                "z_mismatch_steps": int(
-                    (np.asarray(z_r) != np.asarray(z_p)).sum()
-                ),
-                "ll_maxdev": float(
-                    np.max(np.abs(np.asarray(ll_r) - np.asarray(ll_p)))
-                ),
-            },
+            f"t{b}_ms": round(times[b] * 1e3, 3) for b in BLOCKS
         }
-        print(mode, "speedup:", rec[mode]["speedup_pack2"],
-              "parity:", rec[mode]["device_parity"], flush=True)
+        rec[mode]["best_block"] = int(min(times, key=times.get))
+        rec[mode]["speedup_best_vs_resident"] = round(
+            resident / min(times.values()), 3
+        )
+        rec[mode]["z_mismatch_steps"] = int(
+            sum((z_by_block[b] != z_ref).sum() for b in BLOCKS)
+        )
+        print(mode, rec[mode], flush=True)
     with open(OUT, "w") as f:
         json.dump(rec, f, indent=1)
     print("wrote", OUT)

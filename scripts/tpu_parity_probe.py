@@ -1,17 +1,19 @@
-"""On-device parity records for the chunked Pallas kernels.
+"""On-device parity records for the blocked Pallas semiring kernel.
 
 VERDICT r4 weak #6 / ask 8a: chunked-kernel parity was pinned only in
 interpreter mode. This probe runs the real Mosaic-compiled kernels on
 the TPU and records max-abs deviations against the XLA scan pair /
 scan FFBS reference, writing `results/device_parity.json`.
 
-Covers:
-- pallas_forward_vg_chunked (ungated + gated) vs the vmapped scan vg
-  at a long-T shape the dispatcher actually routes to the chunked
-  kernel (T=8192, K=4);
-- pallas_ffbs (resident, gated) and pallas_ffbs_chunked (ungated +
-  gated) vs ffbs_invcdf_reference given IDENTICAL uniforms — draws
-  must be exactly equal, logliks close to f32 reassociation.
+Covers (all through the `kernels/dispatch.py` sanctioned entries —
+the legacy pallas_* modules are deprecated shims):
+- semiring_vg at the blocked schedule (ungated + gated) vs the vmapped
+  scan vg at a long-T shape the dispatcher actually routes blocked
+  (T=8192, K=4);
+- semiring_ffbs at the single-block (resident) and blocked schedules
+  (ungated + gated) vs ffbs_invcdf_reference given IDENTICAL
+  uniforms — draws must be exactly equal, logliks close to f32
+  reassociation.
 
 Run on the axon tunnel (sole tunnel process). Wall target < 5 min.
 """
@@ -56,14 +58,17 @@ def main():
     B, T, K = 16, 8192, 4
     log_pi, log_A, log_obs, mask, gate, skey = _mk(rng, B, T, K)
 
-    # ---- vg chunked vs scan pair ----
-    from hhmm_tpu.kernels.pallas_forward_chunked import pallas_forward_vg_chunked
+    # ---- blocked vg vs scan pair (through the sanctioned dispatch
+    # entries — analysis rule pallas-import) ----
+    from hhmm_tpu.kernels.dispatch import semiring_vg
     from hhmm_tpu.kernels.vg import _vg_single, _vg_single_gated, chunk_for_k
 
     scan = jax.jit(jax.vmap(_vg_single))
     scan_g = jax.jit(jax.vmap(_vg_single_gated))
     chunked = jax.jit(
-        lambda *a: pallas_forward_vg_chunked(*a, t_chunk=chunk_for_k(K))
+        lambda lp, lA, lo, m, *gate: semiring_vg(
+            lp, lA, lo, m, *gate, t_block=chunk_for_k(K)
+        )
     )
 
     for name, fs, fc, args in [
@@ -88,21 +93,21 @@ def main():
         print(name, devs, flush=True)
 
     # ---- FFBS: exact draw parity given identical uniforms ----
+    from hhmm_tpu.kernels.dispatch import semiring_ffbs
     from hhmm_tpu.kernels.ffbs import ffbs_invcdf_reference
-    from hhmm_tpu.kernels.pallas_ffbs import pallas_ffbs
-    from hhmm_tpu.kernels.pallas_ffbs_chunked import pallas_ffbs_chunked
 
-    # resident shape (T*K <= 4096) and chunked shape
+    def _resident(lp, lA, lo, m, u, *gate):
+        return semiring_ffbs(lp, lA, lo, m, u, *gate, t_block=lo.shape[1])
+
+    def _blocked(lp, lA, lo, m, u, *gate):
+        return semiring_ffbs(lp, lA, lo, m, u, *gate, t_block=512)
+
+    # single-block (resident, T*K <= 4096) and blocked schedules
     for name, Tr, fn, gated in [
-        ("ffbs_resident", 1024, pallas_ffbs, False),
-        ("ffbs_resident_gated", 1024, pallas_ffbs, True),
-        ("ffbs_chunked", 8192, lambda *a: pallas_ffbs_chunked(*a, t_chunk=512), False),
-        (
-            "ffbs_chunked_gated",
-            8192,
-            lambda *a: pallas_ffbs_chunked(*a, t_chunk=512),
-            True,
-        ),
+        ("ffbs_resident", 1024, _resident, False),
+        ("ffbs_resident_gated", 1024, _resident, True),
+        ("ffbs_chunked", 8192, _blocked, False),
+        ("ffbs_chunked_gated", 8192, _blocked, True),
     ]:
         lp, lA, lo, m, g, sk = _mk(rng, B, Tr, K)
         u = jnp.asarray(rng.uniform(size=(B, Tr)), jnp.float32)

@@ -673,6 +673,91 @@ class TestLayering:
 
 
 # ---------------------------------------------------------------------------
+# rule: pallas-import (kernels/dispatch.py is the only Pallas entry)
+
+
+# paired known-bad / known-good fixtures: same consumer module, the
+# only difference is whether the Pallas kernels are reached directly or
+# through the sanctioned dispatch layer
+_PALLAS_BAD = (
+    "from hhmm_tpu.kernels.pallas_semiring import semiring_filter\n\n"
+    "def decode(lp, lA, lo, m):\n"
+    "    return semiring_filter(lp, lA, lo, m)\n"
+)
+_PALLAS_GOOD = (
+    "from hhmm_tpu.kernels.dispatch import forward_filter_dispatch\n\n"
+    "def decode(lp, lA, lo, m):\n"
+    "    return forward_filter_dispatch(lp, lA, lo, m, time_parallel='auto')\n"
+)
+
+
+class TestPallasImport:
+    def test_severity_is_error(self):
+        assert RULES["pallas-import"].severity == "error"
+
+    def test_known_bad_fires(self, tmp_path):
+        rep = _run(
+            tmp_path, {"hhmm_tpu/infer/toy.py": _PALLAS_BAD}, ["pallas-import"]
+        )
+        hits = _fires(rep, "pallas-import")
+        assert len(hits) == 1 and "dispatch" in hits[0].message
+        assert hits[0].severity == "error"
+
+    def test_known_good_silent(self, tmp_path):
+        rep = _run(
+            tmp_path, {"hhmm_tpu/infer/toy.py": _PALLAS_GOOD}, ["pallas-import"]
+        )
+        assert not _fires(rep, "pallas-import"), _ids(rep)
+
+    def test_all_import_spellings_fire(self, tmp_path):
+        src = (
+            "import hhmm_tpu.kernels.pallas_semiring\n"
+            "from hhmm_tpu.kernels.pallas_forward import pallas_forward_vg\n"
+            "from hhmm_tpu.kernels import pallas_ffbs\n"
+            "def f():\n"
+            "    from hhmm_tpu.kernels.pallas_traj import tayal_trajectory\n"
+            "    return tayal_trajectory\n"
+        )
+        rep = _run(tmp_path, {"hhmm_tpu/apps/toy.py": src}, ["pallas-import"])
+        assert len(_fires(rep, "pallas-import")) == 4
+
+    def test_relative_import_from_sibling_package_fires(self, tmp_path):
+        src = "from ..kernels.pallas_semiring import semiring_vg\n"
+        rep = _run(tmp_path, {"hhmm_tpu/infer/toy.py": src}, ["pallas-import"])
+        assert len(_fires(rep, "pallas-import")) == 1
+
+    def test_inside_kernels_package_allowed(self, tmp_path):
+        # dispatch.py and the shims live here: in-package imports are
+        # the sanctioned wiring, not an entry-point violation
+        src = "from hhmm_tpu.kernels.pallas_semiring import semiring_filter\n"
+        rep = _run(
+            tmp_path, {"hhmm_tpu/kernels/toy.py": src}, ["pallas-import"]
+        )
+        assert not _fires(rep, "pallas-import")
+
+    def test_scripts_and_bench_scope_fires(self, tmp_path):
+        # probes/benches are in the default scan set and must go
+        # through dispatch like everything else
+        src = "from hhmm_tpu.kernels import pallas_semiring\n"
+        rep = _run(
+            tmp_path,
+            {"scripts/toy_probe.py": src},
+            ["pallas-import"],
+            paths=("scripts",),
+        )
+        assert len(_fires(rep, "pallas-import")) == 1
+
+    def test_dispatch_reexport_and_non_pallas_imports_silent(self, tmp_path):
+        src = (
+            "from hhmm_tpu.kernels.dispatch import semiring_filter, ffbs_pallas\n"
+            "from hhmm_tpu.kernels.filtering import forward_filter\n"
+            "from hhmm_tpu.kernels import viterbi\n"
+        )
+        rep = _run(tmp_path, {"hhmm_tpu/infer/toy.py": src}, ["pallas-import"])
+        assert not _fires(rep, "pallas-import"), _ids(rep)
+
+
+# ---------------------------------------------------------------------------
 # the repo itself + CLI + shim contract
 
 

@@ -1,6 +1,10 @@
-"""Fused Pallas forward+backward kernel (kernels/pallas_forward.py) and
-the custom_vmap dispatcher (kernels/vg.py), in interpreter mode on CPU.
-The real-TPU path is exercised by bench.py on hardware."""
+"""Fused Pallas forward+backward value-and-grad on the unified blocked
+semiring kernel (kernels/pallas_semiring.py::semiring_vg — the
+contract the retired kernels/pallas_forward[_chunked].py shims keep)
+and the custom_vmap dispatcher (kernels/vg.py), in interpreter mode on
+CPU. The real-TPU path is exercised by bench.py on hardware. Imports
+go through `kernels/dispatch.py`, the only sanctioned Pallas entry
+outside the kernels package (analysis rule ``pallas-import``)."""
 
 import jax
 import jax.numpy as jnp
@@ -8,8 +12,21 @@ import numpy as np
 import pytest
 
 from hhmm_tpu.core.lmath import MASK_NEG, log_normalize, safe_log
-from hhmm_tpu.kernels.pallas_forward import pallas_forward_vg
+from hhmm_tpu.kernels.dispatch import semiring_vg
 from hhmm_tpu.kernels.vg import _vg_single, forward_value_and_grad
+
+
+def pallas_forward_vg(
+    log_pi, log_A, log_obs, mask, gate_key=None, state_key=None, *, interpret=False
+):
+    """The retired resident kernel's call shape on the unified blocked
+    kernel: one block owns the whole sequence (``t_block=T``), so the
+    whole recursion stays VMEM-resident — exactly what
+    `kernels/pallas_forward.py::pallas_forward_vg` shims to."""
+    return semiring_vg(
+        log_pi, log_A, log_obs, mask, gate_key, state_key,
+        t_block=log_obs.shape[1], interpret=interpret,
+    )
 
 
 def _batch(rng, B, T, K, ragged=False):
@@ -351,17 +368,11 @@ class TestChunkedKernel:
     path the walk-forward fit uses."""
 
     def _run(self, args, gate=None, t_chunk=16):
-        from hhmm_tpu.kernels.pallas_forward_chunked import (
-            pallas_forward_vg_chunked,
-        )
-
+        # the retired chunked kernel's schedule: t_block < T streams
+        # the sequence through VMEM blocks with a cross-block carry
         if gate is None:
-            return pallas_forward_vg_chunked(
-                *args, t_chunk=t_chunk, interpret=True
-            )
-        return pallas_forward_vg_chunked(
-            *args, *gate, t_chunk=t_chunk, interpret=True
-        )
+            return semiring_vg(*args, t_block=t_chunk, interpret=True)
+        return semiring_vg(*args, *gate, t_block=t_chunk, interpret=True)
 
     @pytest.mark.parametrize("T", [16, 33, 48, 100])
     def test_matches_reference_across_chunk_boundaries(self, rng, T):
@@ -432,7 +443,9 @@ class TestAlphaFused:
     `TayalHHMMLite.generated` previously ran."""
 
     def _residual(self, args, gate=None, t_chunk=16):
-        from hhmm_tpu.kernels.pallas_forward_chunked import (
+        # whitebox into the unified kernel module itself (not a shim):
+        # the shared blocked forward + its padding/transpose plumbing
+        from hhmm_tpu.kernels.pallas_semiring import (
             _LANES,
             _pad_chunked,
             _run_chunked_forward,

@@ -1,0 +1,249 @@
+"""Blocked Pallas semiring mega-kernel parity + three-way dispatch
+(`kernels/pallas_semiring.py` through `kernels/dispatch.py`, the only
+sanctioned entry — analysis rule ``pallas-import``), interpreter mode
+on CPU so tier-1 exercises the IDENTICAL kernel program the TPU runs.
+
+Pins, per the unified-dispatch contract:
+
+- BITWISE filter/beta/Viterbi agreement with the `lax.scan` references
+  across K ∈ {2, 4, 8}, ragged masks, block boundaries, and
+  impossible-evidence (−inf) rows — the guarded `safe_logsumexp`
+  semantics degrade, never NaN;
+- draw-for-draw FFBS agreement with `ffbs_invcdf_reference` given the
+  same pre-drawn uniforms;
+- routing: explicit ``time_parallel="pallas"`` runs the blocked branch
+  (and raises on ineligible signatures); CPU ``"auto"`` against the
+  checked-in cost DB never routes pallas (no unmeasured routing).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hhmm_tpu.core.lmath import MASK_NEG, log_normalize
+from hhmm_tpu.kernels import (
+    backward_pass,
+    forward_filter,
+    viterbi,
+    viterbi_assoc,
+)
+from hhmm_tpu.kernels.dispatch import (
+    backward_dispatch,
+    beta_pallas,
+    ffbs_dispatch,
+    ffbs_pallas,
+    filter_pallas,
+    forward_filter_dispatch,
+    resolve_auto,
+    semiring_beta,
+    semiring_filter,
+    semiring_viterbi,
+    smooth_dispatch,
+    viterbi_dispatch,
+    viterbi_pallas,
+)
+from hhmm_tpu.kernels.ffbs import ffbs_invcdf_reference
+
+
+def _series(rng, T, K, ragged=False, inf_row=None):
+    log_pi = log_normalize(jnp.asarray(rng.normal(size=(K,)), jnp.float32))
+    log_A = log_normalize(jnp.asarray(rng.normal(size=(K, K)), jnp.float32), axis=-1)
+    log_obs = jnp.asarray(rng.normal(size=(T, K)) - 1.0, jnp.float32)
+    if inf_row is not None:
+        # impossible evidence: one step rules out EVERY state
+        log_obs = log_obs.at[inf_row].set(-jnp.inf)
+    if ragged:
+        n = int(rng.integers(T // 2, T))
+        mask = jnp.asarray(np.arange(T) < n, jnp.float32)
+    else:
+        mask = jnp.ones((T,), jnp.float32)
+    return log_pi, log_A, log_obs, mask
+
+
+def _batch(rng, B, T, K, **kw):
+    cols = [_series(rng, T, K, **kw) for _ in range(B)]
+    return tuple(jnp.stack([c[i] for c in cols]) for i in range(4))
+
+
+class TestFilterParity:
+    @pytest.mark.parametrize("K", [2, 4, 8])
+    @pytest.mark.parametrize("ragged", [False, True])
+    def test_bitwise_vs_scan(self, rng, K, ragged):
+        args = _series(rng, 33, K, ragged=ragged)
+        la_p, ll_p = filter_pallas(*args)
+        la_r, ll_r = forward_filter(*args)
+        np.testing.assert_array_equal(np.asarray(la_p), np.asarray(la_r))
+        np.testing.assert_array_equal(np.asarray(ll_p), np.asarray(ll_r))
+
+    def test_block_boundaries_batched(self, rng):
+        # T=45 with t_block=8: six boundary crossings + a padded tail
+        args = _batch(rng, 3, 45, 4, ragged=True)
+        la_p, ll_p = semiring_filter(*args, t_block=8)
+        la_r, ll_r = jax.vmap(forward_filter)(*args)
+        np.testing.assert_array_equal(np.asarray(la_p), np.asarray(la_r))
+        np.testing.assert_array_equal(np.asarray(ll_p), np.asarray(ll_r))
+
+    def test_impossible_evidence_degrades_not_nan(self, rng):
+        args = _series(rng, 21, 4, inf_row=9)
+        la_p, ll_p = filter_pallas(*args)
+        la_r, ll_r = forward_filter(*args)
+        assert not np.any(np.isnan(np.asarray(la_p)))
+        assert np.all(np.asarray(la_p)[9:] == -np.inf)  # absorbed
+        assert float(ll_p) == -np.inf
+        np.testing.assert_array_equal(np.asarray(la_p), np.asarray(la_r))
+        np.testing.assert_array_equal(np.asarray(ll_p), np.asarray(ll_r))
+
+    def test_hard_gated_sparse_A(self, rng):
+        # MASK_NEG-sparse transitions (the Tayal production shape)
+        log_pi, log_A, log_obs, mask = _series(rng, 33, 4)
+        gate = jnp.asarray(rng.random((4, 4)) < 0.4)
+        log_A = jnp.where(gate, MASK_NEG, log_A)
+        la_p, ll_p = filter_pallas(log_pi, log_A, log_obs, mask)
+        la_r, ll_r = forward_filter(log_pi, log_A, log_obs, mask)
+        np.testing.assert_array_equal(np.asarray(la_p), np.asarray(la_r))
+        np.testing.assert_array_equal(np.asarray(ll_p), np.asarray(ll_r))
+
+
+class TestBetaParity:
+    @pytest.mark.parametrize("K", [2, 4, 8])
+    def test_bitwise_vs_scan(self, rng, K):
+        log_pi, log_A, log_obs, mask = _series(rng, 33, K, ragged=True)
+        b_p = beta_pallas(log_A, log_obs, mask)
+        b_r = backward_pass(log_A, log_obs, mask)
+        np.testing.assert_array_equal(np.asarray(b_p), np.asarray(b_r))
+
+    def test_block_boundaries_batched(self, rng):
+        _, log_A, log_obs, mask = _batch(rng, 3, 45, 4, ragged=True)
+        b_p = semiring_beta(log_A, log_obs, mask, t_block=8)
+        b_r = jax.vmap(backward_pass)(log_A, log_obs, mask)
+        np.testing.assert_array_equal(np.asarray(b_p), np.asarray(b_r))
+
+    def test_impossible_evidence_degrades_not_nan(self, rng):
+        _, log_A, log_obs, mask = _series(rng, 21, 4, inf_row=9)
+        b_p = beta_pallas(log_A, log_obs, mask)
+        b_r = backward_pass(log_A, log_obs, mask)
+        assert not np.any(np.isnan(np.asarray(b_p)))
+        np.testing.assert_array_equal(np.asarray(b_p), np.asarray(b_r))
+
+
+class TestViterbiParity:
+    @pytest.mark.parametrize("K", [2, 4, 8])
+    @pytest.mark.parametrize("ragged", [False, True])
+    def test_bitwise_vs_scan(self, rng, K, ragged):
+        args = _series(rng, 33, K, ragged=ragged)
+        p_p, s_p = viterbi_pallas(*args)
+        p_r, s_r = viterbi(*args)
+        np.testing.assert_array_equal(np.asarray(p_p), np.asarray(p_r))
+        np.testing.assert_array_equal(np.asarray(s_p), np.asarray(s_r))
+
+    def test_matches_assoc_branch(self, rng):
+        args = _series(rng, 48, 4)
+        p_p, s_p = viterbi_pallas(*args)
+        p_a, s_a = viterbi_assoc(*args)
+        np.testing.assert_array_equal(np.asarray(p_p), np.asarray(p_a))
+        np.testing.assert_allclose(float(s_p), float(s_a), rtol=1e-6)
+
+    def test_tie_breaking_lowest_index(self, rng):
+        # flat scores everywhere: every argmax ties, and the scan
+        # reference resolves each tie to the LOWEST index — the
+        # unrolled first-max argmax must agree step for step
+        K, T = 4, 17
+        log_pi = jnp.full((K,), -jnp.log(float(K)))
+        log_A = jnp.full((K, K), -jnp.log(float(K)))
+        log_obs = jnp.zeros((T, K), jnp.float32)
+        mask = jnp.ones((T,), jnp.float32)
+        p_p, _ = viterbi_pallas(log_pi, log_A, log_obs, mask)
+        p_r, _ = viterbi(log_pi, log_A, log_obs, mask)
+        np.testing.assert_array_equal(np.asarray(p_p), np.asarray(p_r))
+
+    def test_block_boundaries_batched(self, rng):
+        args = _batch(rng, 3, 45, 4, ragged=True)
+        p_p, s_p = semiring_viterbi(*args, t_block=8)
+        p_r, s_r = jax.vmap(viterbi)(*args)
+        np.testing.assert_array_equal(np.asarray(p_p), np.asarray(p_r))
+        np.testing.assert_array_equal(np.asarray(s_p), np.asarray(s_r))
+
+    def test_impossible_evidence_stays_argmax_valid(self, rng):
+        args = _series(rng, 21, 4, inf_row=9)
+        p_p, s_p = viterbi_pallas(*args)
+        p_r, s_r = viterbi(*args)
+        np.testing.assert_array_equal(np.asarray(p_p), np.asarray(p_r))
+        assert np.all((np.asarray(p_p) >= 0) & (np.asarray(p_p) < 4))
+
+
+class TestFFBSParity:
+    @pytest.mark.parametrize("K", [2, 4, 8])
+    def test_draw_for_draw_vs_reference(self, rng, K):
+        """Same pre-drawn uniforms → the same draws, draw for draw."""
+        log_pi, log_A, log_obs, mask = _series(rng, 33, K, ragged=True)
+        u = jnp.asarray(rng.uniform(size=(33,)), jnp.float32)
+        z_p, ll_p = ffbs_pallas(log_pi, log_A, log_obs, mask, u)
+        z_r, ll_r = ffbs_invcdf_reference(log_pi, log_A, log_obs, mask, u)
+        np.testing.assert_array_equal(np.asarray(z_p), np.asarray(z_r))
+        np.testing.assert_allclose(float(ll_p), float(ll_r), rtol=1e-5)
+
+    def test_dispatch_draw_interchangeable(self, rng):
+        """The dispatch-level key convention: forcing the pallas
+        branch draws exactly what the seq (fused) branch draws from
+        the same key — the routes are draw-for-draw interchangeable."""
+        args = _series(rng, 33, 4)
+        key = jax.random.PRNGKey(7)
+        z_p, ll_p = ffbs_dispatch(key, *args, time_parallel="pallas")
+        z_s, ll_s = ffbs_dispatch(key, *args, time_parallel=False)
+        np.testing.assert_array_equal(np.asarray(z_p), np.asarray(z_s))
+        np.testing.assert_allclose(float(ll_p), float(ll_s), rtol=1e-5)
+
+
+class TestThreeWayRouting:
+    def test_cpu_auto_audit_stays_seq(self):
+        """Against the checked-in cost DB + empty crossover table, CPU
+        "auto" must resolve seq for every decode family — the pallas
+        branch routes only from MEASURED rows, and none exist here."""
+        for kernel in ("filter", "viterbi", "ffbs"):
+            branch, source = resolve_auto(4, 1024, kernel=kernel)
+            assert branch == "seq", (kernel, branch, source)
+            assert source in ("table", "default", "db")
+            assert branch != "pallas"
+
+    def test_explicit_pallas_force_runs_blocked_branch(self, rng):
+        args = _series(rng, 33, 4, ragged=True)
+        la_p, ll_p = forward_filter_dispatch(*args, time_parallel="pallas")
+        la_r, ll_r = forward_filter(*args)
+        np.testing.assert_array_equal(np.asarray(la_p), np.asarray(la_r))
+        b_p = backward_dispatch(args[1], args[2], args[3], time_parallel="pallas")
+        np.testing.assert_array_equal(
+            np.asarray(b_p), np.asarray(backward_pass(args[1], args[2], args[3]))
+        )
+        p_p, s_p = viterbi_dispatch(*args, time_parallel="pallas")
+        p_r, s_r = viterbi(*args)
+        np.testing.assert_array_equal(np.asarray(p_p), np.asarray(p_r))
+
+    def test_smooth_dispatch_pallas_matches_seq(self, rng):
+        args = _series(rng, 33, 4, ragged=True)
+        out_p = smooth_dispatch(*args, time_parallel="pallas")
+        out_s = smooth_dispatch(*args, time_parallel=False)
+        for a, b in zip(out_p, out_s):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_explicit_pallas_on_ineligible_signature_raises(self, rng):
+        log_pi, log_A, log_obs, mask = _series(rng, 12, 3)
+        # time-varying A: [T-1, K, K] — the blocked kernel cannot run it
+        log_A_tv = jnp.broadcast_to(log_A, (11, 3, 3))
+        with pytest.raises(ValueError, match="pallas"):
+            forward_filter_dispatch(
+                log_pi, log_A_tv, log_obs, mask, time_parallel="pallas"
+            )
+
+    def test_vmapped_dispatch_collapses_to_one_launch(self, rng):
+        """The custom_vmap discipline: a vmapped pallas decode equals
+        per-series calls (flat 128-lane batch under the hood)."""
+        args = _batch(rng, 5, 21, 4, ragged=True)
+        la_v, ll_v = jax.vmap(
+            lambda lp, lA, lo, m: forward_filter_dispatch(
+                lp, lA, lo, m, time_parallel="pallas"
+            )
+        )(*args)
+        la_r, ll_r = jax.vmap(forward_filter)(*args)
+        np.testing.assert_array_equal(np.asarray(la_v), np.asarray(la_r))
+        np.testing.assert_array_equal(np.asarray(ll_v), np.asarray(ll_r))

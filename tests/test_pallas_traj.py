@@ -12,7 +12,7 @@ import pytest
 pytestmark = pytest.mark.slow  # multi-minute suites; fast subset: -m 'not slow'
 
 from __graft_entry__ import _tayal_batch
-from hhmm_tpu.kernels.pallas_traj import make_tayal_trajectory, tayal_trajectory
+from hhmm_tpu.kernels.dispatch import make_tayal_trajectory, tayal_trajectory
 from hhmm_tpu.models import TayalHHMM
 
 

@@ -244,10 +244,10 @@ class TestDispatchDBIntegration:
         self._seed(scratch_db, 3, 999, seq_ms=1.0, assoc_ms=0.5)
         obs_profile.set_db(scratch_db)
         assert kdispatch.use_assoc(3, 999) is True
-        assert kdispatch.resolve_auto(3, 999) == (True, "db")
+        assert kdispatch.resolve_auto(3, 999) == ("assoc", "db")
         # a seq-winning row is also DB-backed, not a table fallthrough
         self._seed(scratch_db, 3, 1000, seq_ms=0.5, assoc_ms=1.0)
-        assert kdispatch.resolve_auto(3, 1000) == (False, "db")
+        assert kdispatch.resolve_auto(3, 1000) == ("seq", "db")
         # neighbouring unmeasured points stay on the (empty) table
         assert kdispatch.resolve_auto(3, 998)[1] in ("table", "default")
         assert kdispatch.use_assoc(3, 998) is False
@@ -258,8 +258,8 @@ class TestDispatchDBIntegration:
             device_kind="TPU vImaginary",
         )
         obs_profile.set_db(scratch_db)
-        use, source = kdispatch.resolve_auto(3, 999)
-        assert use is False and source != "db"
+        branch, source = kdispatch.resolve_auto(3, 999)
+        assert branch == "seq" and source != "db"
 
     def test_explicit_and_plan_override_db(self, scratch_db):
         self._seed(scratch_db, 3, 999, seq_ms=1.0, assoc_ms=0.5)
@@ -267,7 +267,7 @@ class TestDispatchDBIntegration:
         assert kdispatch.use_assoc(3, 999, time_parallel=False) is False
         with kdispatch.plan_time_parallel(False):
             assert kdispatch.use_assoc(3, 999) is False
-            assert kdispatch.resolve_auto(3, 999) == (False, "plan")
+            assert kdispatch.resolve_auto(3, 999) == ("seq", "plan")
         assert kdispatch.use_assoc(3, 999) is True  # scope popped
 
     def test_kernel_needs_its_own_rows(self, scratch_db):
@@ -277,14 +277,14 @@ class TestDispatchDBIntegration:
         both-kernels crossover rule forbids)."""
         self._seed(scratch_db, 3, 999, seq_ms=1.0, assoc_ms=0.5)
         obs_profile.set_db(scratch_db)
-        assert kdispatch.resolve_auto(3, 999, kernel="filter") == (True, "db")
-        assert kdispatch.resolve_auto(3, 999, kernel="ffbs") == (False, "default")
+        assert kdispatch.resolve_auto(3, 999, kernel="filter") == ("assoc", "db")
+        assert kdispatch.resolve_auto(3, 999, kernel="ffbs") == ("seq", "default")
         assert kdispatch.resolve_auto(3, 999, kernel="viterbi") == (
-            False, "default",
+            "seq", "default",
         )
         # with its own rows the kernel is DB-backed like any other
         self._seed(scratch_db, 3, 999, seq_ms=0.5, assoc_ms=1.0, kernel="ffbs")
-        assert kdispatch.resolve_auto(3, 999, kernel="ffbs") == (False, "db")
+        assert kdispatch.resolve_auto(3, 999, kernel="ffbs") == ("seq", "db")
 
     def test_plan_branch_needs_all_decode_families(self, scratch_db):
         """The planner's branch is ONE pin spread over every kernel in
@@ -318,6 +318,120 @@ class TestDispatchDBIntegration:
         db2.save()
         obs_profile.refresh()
         assert kdispatch.use_assoc(3, 999) is False
+
+
+class TestNWayArbitration:
+    """Regression for the two-way-winner-pair assumption: `winner` /
+    `resolve_auto` arbitrate N-way across EVERY measured branch of one
+    kernel's largest comparable batch group — the three-way
+    (seq/assoc/pallas) case a TPU probe run produces."""
+
+    def _put(self, db, branch, ms, K=3, T=999, B=8, kernel="filter", dk=None):
+        db.put_row(
+            kernel=kernel, branch=branch, K=K, T=T, B=B, dtype="float32",
+            timing=_timing(ms * 1e-3),
+            device_kind=dk or kdispatch._device_kind(),
+        )
+
+    def test_three_way_pallas_win_routes_pallas(self, scratch_db):
+        """THE three-way regression: with all three branches measured
+        in one stamp group, the fastest (pallas) wins — the old code
+        could only ever answer seq-or-assoc."""
+        self._put(scratch_db, "seq", 1.0)
+        self._put(scratch_db, "assoc", 0.7)
+        self._put(scratch_db, "pallas", 0.3)
+        obs_profile.set_db(scratch_db)
+        assert kdispatch.resolve_auto(3, 999) == ("pallas", "db")
+        # the legacy two-way surface degrades sanely: pallas is not assoc
+        assert kdispatch.use_assoc(3, 999) is False
+        # restricted arbitration (pallas-ineligible call signature):
+        # the measured seq/assoc race decides, not an unmeasured default
+        assert kdispatch.resolve_auto(
+            3, 999, allowed=("seq", "assoc")
+        ) == ("assoc", "db")
+
+    def test_three_way_middle_branch_can_win(self, scratch_db):
+        self._put(scratch_db, "seq", 1.0)
+        self._put(scratch_db, "assoc", 0.3)
+        self._put(scratch_db, "pallas", 0.7)
+        obs_profile.set_db(scratch_db)
+        assert kdispatch.resolve_auto(3, 999) == ("assoc", "db")
+
+    def test_lone_pallas_row_does_not_route(self, scratch_db):
+        """A branch that raced nothing is not a measurement of a
+        crossover: a pallas-only group must leave dispatch unmeasured
+        (seq default), exactly like the historical lone-assoc rule."""
+        self._put(scratch_db, "pallas", 0.1)
+        obs_profile.set_db(scratch_db)
+        branch, source = kdispatch.resolve_auto(3, 999)
+        assert branch == "seq" and source in ("table", "default")
+
+    def test_largest_batch_group_decides_three_way(self, scratch_db):
+        """B=8 says pallas, B=64 says seq: the LARGEST comparable
+        batch group is the honest dispatch default and wins the
+        arbitration across groups."""
+        self._put(scratch_db, "seq", 1.0, B=8)
+        self._put(scratch_db, "assoc", 0.7, B=8)
+        self._put(scratch_db, "pallas", 0.3, B=8)
+        self._put(scratch_db, "seq", 0.2, B=64)
+        self._put(scratch_db, "assoc", 0.7, B=64)
+        self._put(scratch_db, "pallas", 0.5, B=64)
+        obs_profile.set_db(scratch_db)
+        assert kdispatch.resolve_auto(3, 999) == ("seq", "db")
+
+    def test_incomplete_larger_group_falls_to_complete_smaller(self, scratch_db):
+        """A lone-branch B=64 group cannot arbitrate; the complete
+        B=8 three-way group still routes."""
+        self._put(scratch_db, "pallas", 0.05, B=64)
+        self._put(scratch_db, "seq", 1.0, B=8)
+        self._put(scratch_db, "assoc", 0.4, B=8)
+        self._put(scratch_db, "pallas", 0.2, B=8)
+        obs_profile.set_db(scratch_db)
+        assert kdispatch.resolve_auto(3, 999) == ("pallas", "db")
+
+    def test_exact_tie_prefers_conservative_ladder(self, scratch_db):
+        self._put(scratch_db, "seq", 0.5)
+        self._put(scratch_db, "assoc", 0.5)
+        self._put(scratch_db, "pallas", 0.5)
+        obs_profile.set_db(scratch_db)
+        assert kdispatch.resolve_auto(3, 999) == ("seq", "db")
+
+    def test_resolve_routed_degrades_only_a_pallas_winner(self, scratch_db):
+        """The stamped-branch surface (wf decode cache key): the
+        seq/assoc re-resolution fires ONLY when the honest arbitration
+        picked pallas. Restricting up front would let a smaller/staler
+        seq-assoc group decide a point whose largest-batch winner was
+        seq — the stamp would then disagree with the executed branch."""
+        # largest-batch group: {seq, pallas}, seq wins; smaller stale
+        # group: {seq, assoc}, assoc wins
+        self._put(scratch_db, "seq", 1.0, B=64)
+        self._put(scratch_db, "pallas", 2.0, B=64)
+        self._put(scratch_db, "seq", 1.0, B=32)
+        self._put(scratch_db, "assoc", 0.5, B=32)
+        obs_profile.set_db(scratch_db)
+        # dispatch runs seq (B=64 group, no pallas degrade needed) —
+        # the stamp must say seq too, even for a pallas-ineligible call
+        assert kdispatch.resolve_routed(3, 999, pallas_ok=True) == "seq"
+        assert kdispatch.resolve_routed(3, 999, pallas_ok=False) == "seq"
+        # and when pallas genuinely wins, ineligible calls degrade to
+        # the measured seq/assoc race (here the B=32 pair, where assoc
+        # won — the B=64 group holds no complete seq/assoc race)
+        self._put(scratch_db, "pallas", 0.2, B=64)
+        assert kdispatch.resolve_routed(3, 999, pallas_ok=True) == "pallas"
+        assert kdispatch.resolve_routed(3, 999, pallas_ok=False) == "assoc"
+        with pytest.raises(ValueError, match="pallas"):
+            kdispatch.resolve_routed(3, 999, "pallas", pallas_ok=False)
+
+    def test_use_assoc_accepts_branch_names(self):
+        """The two-way legacy surface under the three-way contract:
+        explicit branch names pass through ('pallas' takes the
+        non-assoc fork — its callers' scan arm is where the fused
+        Pallas kernels live), they never raise."""
+        assert kdispatch.use_assoc(3, 999, "assoc") is True
+        assert kdispatch.use_assoc(3, 999, "seq") is False
+        assert kdispatch.use_assoc(3, 999, "pallas") is False
+        with pytest.raises(ValueError):
+            kdispatch.use_assoc(3, 999, "warp")
 
 
 class TestSampledFlushProfiling:
@@ -565,6 +679,30 @@ class TestBenchDiffKernelCosts:
         assert proc.returncode == 0
         assert "kernel-cost baseline" in proc.stdout
 
+    def test_pallas_rows_gate_under_same_key(self, tmp_path):
+        """branch="pallas" rows ride the existing per-row
+        (kernel/branch/K/T/B/dtype) comparability key: a pallas
+        device-time regression fails the gate like any other branch,
+        and seq rows at the same (K, T, B) stay independent."""
+        pallas = lambda p50: {"kernel": "filter", "branch": "pallas", "K": 4,
+                              "T": 64, "B": 4, "dtype": "float32", "p50_ms": p50}
+        self._write(
+            tmp_path,
+            self._record(1, 1.0, extra_row=pallas(0.4)),
+            self._record(2, 1.0, extra_row=pallas(0.7)),
+        )
+        proc = self._run(tmp_path)
+        assert proc.returncode == 1
+        assert "DEVICE-TIME REGRESSION" in proc.stdout
+        assert "pallas" in proc.stdout
+        # improvement on the pallas row alone passes
+        self._write(
+            tmp_path,
+            self._record(1, 1.0, extra_row=pallas(0.4)),
+            self._record(2, 1.0, extra_row=pallas(0.3)),
+        )
+        assert self._run(tmp_path).returncode == 0
+
 
 class TestObsReportCostPlane:
     MANIFEST = os.path.join(FIXTURES, "obs_report_manifest.json")
@@ -586,9 +724,15 @@ class TestObsReportCostPlane:
         out = proc.stdout
         assert "== kernel costs ==" in out
         assert "filter[seq]" in out and "filter[assoc]" in out
+        assert "filter[pallas]" in out and "viterbi[pallas]" in out
         assert "timing-only" in out
         assert "DB-backed" in out
         assert "unmeasured (scan default)" in out
+        # the three-way dispatch audit: the raced branch enum renders
+        # per audit line, and a measured pallas winner shows as such
+        assert "raced branches: seq/assoc/pallas" in out
+        assert "[raced seq/assoc/pallas]" in out
+        assert "pallas (DB-backed)" in out
 
     def test_storm_and_resilience_from_fixture(self):
         proc = self._run(self.MANIFEST)
@@ -649,8 +793,11 @@ class TestProfileKernelsBench:
         assert db["version"] == 1
         rows = list(db["rows"].values())
         covered = {(r["kernel"], r["branch"]) for r in rows}
-        assert {("filter", "seq"), ("filter", "assoc"),
-                ("ffbs", "seq"), ("ffbs", "assoc")} <= covered
+        # --quick races the FULL branch enum (pallas through the
+        # interpreter, steered to the scratch DB): three-way rows at
+        # the same (K, T, B) points
+        assert {("filter", "seq"), ("filter", "assoc"), ("filter", "pallas"),
+                ("ffbs", "seq"), ("ffbs", "assoc"), ("ffbs", "pallas")} <= covered
         assert len({(r["K"], r["T"]) for r in rows}) >= 3
         for r in rows:  # every row stamped + measured
             assert r["device_kind"] == "cpu"
@@ -660,8 +807,11 @@ class TestProfileKernelsBench:
         assert record["metric"] == "hmm_kernel_profile_throughput"
         kc = record["manifest"]["kernel_costs"]
         assert len(kc["rows"]) == len(rows)
+        assert kc["branches"] == ["seq", "assoc", "pallas"]
         assert kc["dispatch"], kc
         assert all(d["source"] == "db" for d in kc["dispatch"])
+        # the three-way audit: every point records the raced enum
+        assert all(d["raced"] == ["seq", "assoc", "pallas"] for d in kc["dispatch"])
         # CPU truth (PR 3): the sequential scan wins the batched
         # FILTER points decisively (4-10x) — now DB-backed instead of
         # empty-table-defaulted. (ffbs is near-parity at these tiny

@@ -352,6 +352,172 @@ class TestRegistry:
         assert not snap2.healthy and snap2.draws.shape == (8, model.n_free)
 
 
+class TestQuantizedSnapshots:
+    """bf16/f16 draw-bank quantization (`serve/registry.py`): packed at
+    rest AND resident, dequantized to f32 at attach, adoption gated on
+    one-step predictive-loglik parity, and a pager demonstration that
+    the same byte budget holds ≥ 2× the snapshots."""
+
+    def test_quantize_round_trip_error_bounds(self):
+        from hhmm_tpu.serve.registry import dequantize_draws, quantize_draws
+
+        rng = np.random.default_rng(0)
+        draws = (rng.normal(size=(16, 37)) * 3.0).astype(np.float32)
+        # f32 is the identity, bit for bit
+        np.testing.assert_array_equal(quantize_draws(draws, "float32"), draws)
+        # bf16: 8 mantissa bits -> rel error <= 2^-8; stored as uint16
+        packed = quantize_draws(draws, "bfloat16")
+        assert packed.dtype == np.uint16 and packed.nbytes == draws.nbytes // 2
+        back = dequantize_draws(packed, "bfloat16")
+        assert back.dtype == np.float32
+        np.testing.assert_allclose(back, draws, rtol=2.0 ** -8)
+        # f16: 10 mantissa bits at these magnitudes
+        packed16 = quantize_draws(draws, "float16")
+        assert packed16.dtype == np.float16
+        np.testing.assert_allclose(
+            dequantize_draws(packed16, "float16"), draws, rtol=2.0 ** -10
+        )
+        with pytest.raises(ValueError, match="dtype"):
+            quantize_draws(draws, "int8")
+
+    def test_bf16_round_to_nearest_even_exact_values(self):
+        from hhmm_tpu.serve.registry import dequantize_draws, quantize_draws
+
+        # values exactly representable in bf16 survive untouched
+        exact = np.asarray([1.0, -2.5, 0.0, 3.140625], np.float32)
+        np.testing.assert_array_equal(
+            dequantize_draws(quantize_draws(exact, "bfloat16"), "bfloat16"), exact
+        )
+
+    def test_bf16_nonfinite_markers_survive(self):
+        """A diverged draw bank's NaN/inf markers must survive the
+        pack: a low-payload NaN must not round to +inf, and the
+        all-ones -NaN pattern must not wrap the rounding add to +0 —
+        downstream health checks rely on seeing the non-finite
+        values."""
+        from hhmm_tpu.serve.registry import dequantize_draws, quantize_draws
+
+        specials = np.asarray([np.nan, -np.nan, np.inf, -np.inf], np.float32)
+        # hostile bit patterns: NaN payloads < 0x8000 (would round to
+        # ±inf), the all-ones -NaN (wraps a uint32 rounding add), and
+        # f32 max (must round UP to inf, not wrap)
+        hostile = np.asarray(
+            [0x7F800001, 0xFFFFFFFF, 0x7F7FFFFF], np.uint32
+        ).view(np.float32)
+        x = np.concatenate([specials, hostile])
+        back = dequantize_draws(quantize_draws(x, "bfloat16"), "bfloat16")
+        assert np.isnan(back[0]) and np.isnan(back[1])
+        assert back[2] == np.inf and back[3] == -np.inf
+        assert np.isnan(back[4]) and np.isnan(back[5])
+        assert back[6] == np.inf  # rounds past bf16 max to inf
+
+    def test_snapshot_from_fit_dtype_and_registry_round_trip(self, tmp_path):
+        model = MultinomialHMM(K=2, L=3)
+        rng = np.random.default_rng(1)
+        samples = rng.normal(size=(2, 10, model.n_free)).astype(np.float32)
+        snap = snapshot_from_fit(model, samples, n_draws=8, dtype="bfloat16")
+        assert snap.draws_dtype == "bfloat16"
+        assert snap.draws.dtype == np.uint16  # packed residency
+        deq = snap.dequantized_draws()
+        assert deq.dtype == np.float32 and deq.shape == (8, model.n_free)
+        with pytest.raises(ValueError, match="dtype"):
+            snapshot_from_fit(model, samples, n_draws=8, dtype="int4")
+        # the PACKED bank round-trips through the .npz verbatim
+        reg = SnapshotRegistry(str(tmp_path))
+        reg.save("q", snap)
+        back = reg.load("q")
+        assert back.draws_dtype == "bfloat16"
+        np.testing.assert_array_equal(back.draws, snap.draws)
+        np.testing.assert_array_equal(back.dequantized_draws(), deq)
+
+    def test_untagged_legacy_archive_loads_as_f32(self, tmp_path):
+        """Pre-quantization .npz files carry no ``draws_dtype`` entry:
+        they must keep loading as the f32 layout they are."""
+        model = MultinomialHMM(K=2, L=3)
+        reg = SnapshotRegistry(str(tmp_path))
+        reg.save("old", _fake_snapshot(model))
+        path = reg.path("old")
+        with np.load(path, allow_pickle=False) as z:
+            legacy = {k: z[k] for k in z.files if k != "draws_dtype"}
+        np.savez(path[:-4], **legacy)  # np.savez appends .npz
+        back = reg.load("old")
+        assert back is not None and back.draws_dtype == "float32"
+        assert back.draws.dtype == np.float32
+        np.testing.assert_array_equal(back.dequantized_draws(), back.draws)
+
+    def test_one_step_predictive_loglik_parity_gate(self):
+        """THE adoption gate: a bf16-quantized snapshot served through
+        the scheduler produces one-step logliks within quantization
+        tolerance of the f32 snapshot it was packed from."""
+        import dataclasses
+
+        from hhmm_tpu.serve.registry import quantize_draws
+
+        model = TayalHHMM(gate_mode="hard")
+        B, T = 4, 10
+        x, sign = _tayal_stream(B, T, seed=11)
+        snap32 = _fake_snapshot(model, n_draws=6)
+        snap16 = dataclasses.replace(
+            snap32,
+            draws=quantize_draws(snap32.draws, "bfloat16"),
+            draws_dtype="bfloat16",
+        )
+        lls = {}
+        for tag, snap in (("f32", snap32), ("bf16", snap16)):
+            sched = MicroBatchScheduler(model, buckets=(4,))
+            sched.attach_many([(f"s{i}", snap, None) for i in range(B)])
+            out = []
+            for t in range(T):
+                for i in range(B):
+                    sched.submit(
+                        f"s{i}", {"x": int(x[i, t]), "sign": int(sign[i, t])}
+                    )
+                out.extend(r.loglik for r in sched.flush())
+            lls[tag] = np.asarray(out, np.float64)
+        assert np.all(np.isfinite(lls["bf16"]))
+        np.testing.assert_allclose(lls["bf16"], lls["f32"], rtol=0, atol=5e-2)
+
+    def test_pager_2x_residency_under_same_byte_budget(self, tmp_path):
+        """The residency lever, measured: under an IDENTICAL byte
+        budget the bf16 registry keeps ≥ 2× the snapshots resident,
+        and ``serve.pager_resident_bytes`` stays under the budget."""
+        import dataclasses
+
+        from hhmm_tpu.serve import SnapshotPager
+        from hhmm_tpu.serve.registry import quantize_draws
+
+        model = MultinomialHMM(K=2, L=3)
+        n, n_draws = 8, 4
+        budget = 2 * n_draws * model.n_free * 4  # two f32 banks, exactly
+        resident = {}
+        for dtype in ("float32", "bfloat16"):
+            reg = SnapshotRegistry(str(tmp_path / dtype))
+            for i in range(n):
+                snap = _fake_snapshot(model, n_draws=n_draws, seed=i)
+                if dtype != "float32":
+                    snap = dataclasses.replace(
+                        snap,
+                        draws=quantize_draws(snap.draws, dtype),
+                        draws_dtype=dtype,
+                    )
+                reg.save(f"p{i}", snap)
+            pager = SnapshotPager(reg, budget_bytes=budget)
+            sched = MicroBatchScheduler(
+                model, buckets=(4,), registry=reg, pager=pager
+            )
+            for i in range(n):  # touch every series; LRU keeps what fits
+                r = sched.tick({f"p{i}": {"x": i % 3}})[f"p{i}"]
+                assert not r.shed and not r.degraded
+            stats = pager.stats()
+            assert stats["resident_bytes"] <= budget
+            assert pager.peak_resident_bytes() <= budget
+            # the gauge the dashboards read agrees with the accounting
+            assert pager._resident_gauge.value <= budget
+            resident[dtype] = stats["resident"]
+        assert resident["float32"] == 2
+        assert resident["bfloat16"] >= 2 * resident["float32"]
+
+
 def _tayal_stream(n_series, T, seed=0):
     from __graft_entry__ import _tayal_batch
 
