@@ -159,6 +159,7 @@ WORKLOAD_FLAGS = (
     "plan_topologies",
     "serve",
     "serve_storm",
+    "maint",
     "storm_registered",
     "storm_resident",
     "storm_rounds",
@@ -830,6 +831,316 @@ def serve_storm(args, backend, degraded) -> None:
         sys.exit(1)
 
 
+def maint_bench(args, backend, degraded) -> None:
+    """``--maint``: the drift-triggered maintenance closed loop,
+    end-to-end (`hhmm_tpu/maint/`, docs/maintenance.md; ROADMAP item 3).
+
+    Scenario: fit posteriors on each series' history half, promote them
+    into a `SnapshotRegistry` (versioned + serving alias), attach the
+    fleet warm, then stream the second half tick by tick with a
+    `robust.faults.RegimeShiftPlan` active — mid-stream the generator
+    swaps to an emission-shifted regime (the categorical alphabet
+    reversed: in-distribution data simply stops arriving). The inline
+    `MaintenanceLoop` must close the loop unaided: per-series
+    `LoglikCUSUM` alarms → debounced `MaintenancePolicy` triggers →
+    one batched warm refit over the scheduler's history tails →
+    shadow gate on the held-out evaluation tail → atomic promotion
+    (registry alias repoint + in-place scheduler swap).
+
+    Exit is nonzero unless the WHOLE ladder demonstrably ran: a drift
+    alarm triggered at least one warm refit whose candidate won shadow
+    evaluation and was atomically promoted; the promoted snapshot
+    strictly beats the pre-shift (stale) one on held-out one-step
+    predictive loglik over the same never-streamed shifted ticks; zero
+    XLA compiles landed after warmup (the swap replays in
+    already-compiled shapes); and the ``maint`` stanza (refits /
+    promotions / shadow_rejections / refit_seconds) is stamped in the
+    record manifest — the surface `scripts/bench_diff.py` gates
+    ``promotions > 0 → 0`` transitions on and `scripts/obs_report.py`
+    renders as ``== maintenance ==``."""
+    import tempfile
+
+    from __graft_entry__ import _tayal_batch
+    from hhmm_tpu.batch import fit_batched
+    from hhmm_tpu.infer import GibbsConfig
+    from hhmm_tpu.maint import (
+        MaintenanceLoop,
+        MaintenancePolicy,
+        predictive_logliks,
+    )
+    from hhmm_tpu.models import TayalHHMM
+    from hhmm_tpu.robust import faults
+    from hhmm_tpu.serve import (
+        MicroBatchScheduler,
+        ServeMetrics,
+        SnapshotRegistry,
+        snapshot_from_fit,
+    )
+    from hhmm_tpu.serve.online import LoglikCUSUM
+
+    B = args.series
+    n_hist = 64
+    stream = min(args.ticks, 160) if args.quick else args.ticks
+    holdout = 24  # never-streamed shifted ticks for the recovery gate
+    # a SHORT tail on purpose: by the time the CUSUM detects the shift
+    # (~10-20 ticks) plus the debounce, the sliding window is mostly
+    # post-shift data — a long tail would dilute the refit with the
+    # stale regime and the candidate would only half-learn the new one
+    tail_len, eval_ticks = 32, 8
+    shift_at = n_hist + 2 + 16  # global tick the regime flips
+    draws = min(args.serve_draws, 8) if args.quick else args.serve_draws
+    model = TayalHHMM(gate_mode="hard")
+    T_total = n_hist + 2 + stream + holdout
+    # PEAKED emission rows (Dirichlet 0.5): the mid-stream alphabet
+    # reversal is then a hard shift — the stale posterior's predictive
+    # drops decisively and a post-shift refit has a decisive gap to
+    # recover, so the closed-loop gates judge signal, not noise
+    x, sign = _tayal_batch(B, T_total, seed=42, alpha=0.5)
+    x_np, s_np = np.asarray(x), np.asarray(sign)
+    # the shifted regime: reverse the categorical alphabet — the fitted
+    # emission rows see their probability mass mirrored, a hard
+    # distribution shift with the same support (data stays valid)
+    x_alt = (8 - x_np).astype(x_np.dtype)
+    names = [f"m{i:04d}" for i in range(B)]
+
+    # ---- history fit -> promoted serving snapshots ----
+    fit_cfg = GibbsConfig(
+        num_warmup=30 if args.quick else 100,
+        num_samples=max(8 * draws, 64),
+        num_chains=1,
+    )
+    t0 = perf_counter()
+    samples, stats = fit_batched(
+        model,
+        {"x": x[:, :n_hist], "sign": sign[:, :n_hist]},
+        jax.random.PRNGKey(0),
+        fit_cfg,
+        chunk_size=min(args.chunk, B),
+    )
+    fit_s = perf_counter() - t0
+    reg_root = tempfile.mkdtemp(prefix="maint_registry_")
+    import atexit
+    import shutil
+
+    atexit.register(shutil.rmtree, reg_root, ignore_errors=True)
+    registry = SnapshotRegistry(reg_root)
+    healthy = np.asarray(stats["chain_healthy"]).reshape(B, -1)
+    stale_snaps = {}
+    for i, name in enumerate(names):
+        snap = snapshot_from_fit(
+            model,
+            np.asarray(samples[i]),
+            chain_healthy=healthy[i],
+            n_draws=draws,
+            meta={"series": i, "n_hist": n_hist},
+        )
+        registry.promote(name, snap)  # serving alias from the start
+        stale_snaps[name] = snap
+
+    metrics = ServeMetrics()
+    sched = MicroBatchScheduler(
+        model,
+        buckets=(8, 64, max(64, B)),
+        registry=registry,
+        metrics=metrics,
+        history_tail=tail_len,
+    )
+    sched.attach_many(
+        [
+            (
+                name,
+                registry.load_serving(name),
+                {"x": x_np[i, :n_hist], "sign": s_np[i, :n_hist]},
+                f"tenant{i % 4}",
+            )
+            for i, name in enumerate(names)
+        ]
+    )
+
+    refit_cfg = GibbsConfig(
+        num_warmup=20 if args.quick else 50,
+        num_samples=max(6 * draws, 48),
+        num_chains=1,
+    )
+    loop = MaintenanceLoop(
+        sched,
+        registry,
+        model,
+        refit_cfg,
+        jax.random.PRNGKey(7),
+        policy=MaintenancePolicy(
+            min_interval_ticks=40, max_concurrent=max(4, B)
+        ),
+        eval_ticks=eval_ticks,
+        min_fit_ticks=16,
+        # a maintenance alarm should fire within a quick CPU window:
+        # h=5 / 12 calibration ticks trade a few more false alarms for
+        # detection delay — exactly what the shadow gate exists to
+        # absorb (false-alarm candidates lose and are discarded). The
+        # short debounce lets a still-drifted series refit AGAIN with a
+        # now-fully-shifted window: promotions converge on the new
+        # regime over successive maintenance passes
+        detector_factory=lambda sid: LoglikCUSUM(
+            series=sid, threshold=5.0, calibrate=12
+        ),
+    )
+
+    def obs_for(i: int, t: int):
+        xx = x_alt if faults.regime_shift_active(t) else x_np
+        return {"x": int(xx[i, t]), "sign": int(s_np[i, t])}
+
+    def drive(t: int) -> None:
+        for i, name in enumerate(names):
+            sched.submit(name, obs_for(i, t))
+        loop.observe(sched.flush())
+
+    # ---- warmup: tick kernels + the swap-replay signature (a swap
+    # re-attaches through the warm replay machinery; its bucket/T_pad/
+    # dtype signature must land before the measured window) ----
+    t0 = perf_counter()
+    for t in range(n_hist, n_hist + 2):
+        drive(t)
+    warm_swap_reason = sched.swap_snapshot(names[0])
+    warmup_s = perf_counter() - t0
+    compiles_warm = metrics.compile_count
+    metrics.reset_throughput_window()
+
+    # ---- the measured window: regime shift active mid-stream, the
+    # maintenance loop running INLINE with the serve loop ----
+    t0 = perf_counter()
+    with faults.inject(faults.RegimeShiftPlan(at_tick=shift_at)):
+        for t in range(n_hist + 2, n_hist + 2 + stream):
+            drive(t)
+            loop.maybe_maintain()
+    replay_s = perf_counter() - t0
+    compiles_after_warmup = metrics.compile_count - compiles_warm
+    stanza = loop.stanza()
+    summary = metrics.summary()
+
+    # ---- predictive-recovery gate: promoted vs stale on the SAME
+    # held-out shifted ticks (never streamed, never fitted) ----
+    # the UNBOUNDED promotion ledger — the stanza's event window is
+    # capped and rotates, so at full scale it would under-enumerate
+    # (or, all promoted events rotated out, spuriously fail) this gate
+    promoted_series = loop.promoted_series()
+    recovery = None
+    if promoted_series:
+        # PAIRED across every promoted series over the SAME held-out
+        # shifted ticks: each series' promoted and stale posteriors
+        # score identical observations, and the deltas pool across the
+        # fleet — per-window noise on one short tail (±0.3 nats/tick
+        # on this workload) must not decide the closed-loop verdict
+        per_series = []
+        deltas = []
+        for sid in promoted_series:
+            i = names.index(sid)
+            ev = {"x": x_alt[i, -holdout:], "sign": s_np[i, -holdout:]}
+            ll_promoted = float(
+                np.mean(
+                    predictive_logliks(model, registry.load_serving(sid), ev)
+                )
+            )
+            ll_stale = float(
+                np.mean(predictive_logliks(model, stale_snaps[sid], ev))
+            )
+            deltas.append(ll_promoted - ll_stale)
+            per_series.append(
+                {
+                    "series": sid,
+                    "stale_per_tick": round(ll_stale, 4),
+                    "promoted_per_tick": round(ll_promoted, 4),
+                    "delta": round(ll_promoted - ll_stale, 4),
+                }
+            )
+        mean_delta = float(np.mean(deltas))
+        recovery = {
+            "holdout_ticks": holdout,
+            "promoted_series": len(promoted_series),
+            "mean_delta": round(mean_delta, 4),
+            "per_series": per_series,
+        }
+
+    # ---- closed-loop gates ----
+    failures = []
+    if warm_swap_reason is not None:
+        failures.append(f"warmup swap rejected: {warm_swap_reason}")
+    if stanza["triggers"] == 0:
+        failures.append("no drift alarm ever triggered a refit request")
+    if stanza["refits"] == 0:
+        failures.append("no warm refit ran")
+    if stanza["promotions"] == 0:
+        failures.append(
+            "no candidate won shadow evaluation and was promoted"
+        )
+    if recovery is None:
+        failures.append("no promoted series to judge predictive recovery on")
+    elif not mean_delta > 0:  # the RAW mean: a real but tiny recovery
+        # must not round to 0.0 and fail the closed-loop verdict
+        failures.append(
+            "promoted snapshots did not beat the stale ones on held-out "
+            f"shifted ticks (paired mean delta "
+            f"{recovery['mean_delta']} nats/tick over "
+            f"{recovery['promoted_series']} promoted series)"
+        )
+    if compiles_after_warmup != 0:
+        failures.append(
+            f"{compiles_after_warmup} XLA compiles after warmup (the "
+            "promotion swap must land in already-compiled shapes)"
+        )
+
+    n_timed = summary["ticks"]
+    record = stamp_record(
+        {
+            "metric": "tayal_maint_tick_throughput",
+            "value": round(n_timed / replay_s, 1) if replay_s > 0 else None,
+            "unit": "ticks/sec",
+            "series": B,
+            "draws_per_series": draws,
+            "ticks_streamed": stream,
+            "shift_at_tick": shift_at,
+            "fit_s": round(fit_s, 3),
+            "warmup_s": round(warmup_s, 3),
+            "replay_s": round(replay_s, 3),
+            "refit_seconds": stanza["refit_seconds"],
+            "triggers": stanza["triggers"],
+            "refits": stanza["refits"],
+            "promotions": stanza["promotions"],
+            "shadow_rejections": stanza["shadow_rejections"],
+            "predictive_recovery": recovery,
+            "latency_p50_ms": summary["latency_p50_ms"],
+            "latency_p99_ms": summary["latency_p99_ms"],
+            "compile_count": summary["compile_count"],
+            "compiles_after_warmup": compiles_after_warmup,
+            "backend": backend["backend"],
+            "backend_fallback": backend["fallback"],
+            "degraded_cpu_smoke": degraded,
+        },
+        args,
+        model=model,
+    )
+    # the bench_diff-gated surface: maint rides the manifest like the
+    # storm/slo/request stanzas (promotions > 0 -> 0 between comparable
+    # records = MAINTENANCE REGRESSION)
+    record["manifest"]["maint"] = stanza
+    print(json.dumps(record))
+    print(
+        "# maint "
+        + ("CLOSED-LOOP OK" if not failures else "FAILED")
+        + f": triggers={stanza['triggers']} refits={stanza['refits']} "
+        f"promotions={stanza['promotions']} "
+        f"shadow_rejections={stanza['shadow_rejections']} "
+        f"refit_s={stanza['refit_seconds']} "
+        f"recovery={recovery['mean_delta'] if recovery else None} "
+        f"compiles_after_warmup={compiles_after_warmup}",
+        file=sys.stderr,
+    )
+    emit_manifest(args, "maint", record, model=model)
+    if failures:
+        for f in failures:
+            print(f"# maint FAILED: {f}", file=sys.stderr)
+        sys.exit(1)
+
+
 def plan_sweep(args, backend, topologies) -> None:
     """``--plan-sweep``: planned vs naive single-axis layouts over
     synthetic multi-device topologies (virtual CPU devices — the same
@@ -1446,6 +1757,19 @@ def main() -> None:
         "compile lands after warmup (see docs/serving.md)",
     )
     ap.add_argument(
+        "--maint",
+        action="store_true",
+        help="run the drift-triggered maintenance closed-loop demo "
+        "instead of the fit bench: fit + promote serving snapshots, "
+        "stream with a mid-stream regime shift injected "
+        "(robust/faults.py RegimeShiftPlan), and require the inline "
+        "maintenance loop (hhmm_tpu/maint/) to alarm -> warm-refit -> "
+        "win shadow evaluation -> atomically promote, with held-out "
+        "predictive-loglik recovery and zero post-warmup recompiles "
+        "(see docs/maintenance.md); exits nonzero if any rung of the "
+        "ladder fails to engage",
+    )
+    ap.add_argument(
         "--storm-registered",
         type=int,
         default=1000,
@@ -1624,6 +1948,10 @@ def main() -> None:
 
     if args.serve_storm:
         serve_storm(args, backend, degraded)
+        return
+
+    if args.maint:
+        maint_bench(args, backend, degraded)
         return
 
     from __graft_entry__ import _tayal_batch

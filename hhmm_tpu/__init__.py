@@ -26,6 +26,9 @@ Layer map (see SURVEY.md §7):
   compile/memory telemetry, run manifests (`docs/observability.md`).
 - ``serve``    — streaming inference service: online forward-filter core,
   posterior snapshot registry, micro-batching tick scheduler, metrics.
+- ``maint``    — drift-triggered maintenance plane: debounced refit
+  triggers, sliding-window warm refits, champion/challenger shadow
+  evaluation, atomic snapshot promotion (`docs/maintenance.md`).
 - ``apps``     — Hassan (2005) forecasting and Tayal (2009) trading
   pipelines.
 """

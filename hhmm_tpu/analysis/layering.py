@@ -12,14 +12,22 @@ accrete silently.
 Ranks (higher may import lower; equal ranks may NOT import each
 other — siblings stay decoupled)::
 
-    7  viz
-    6  apps
+    8  viz
+    7  apps
+    6  maint
     5  serve
     4  models, batch
     3  infer, plan
     2  kernels
     1  obs
     0  core, hhmm, sim, native, robust, analysis
+
+``maint`` (the drift-triggered maintenance plane, PR 14) sits between
+``serve`` and ``apps``: it consumes the serving plane (scheduler,
+registry, drift detectors) and the batch fit path, and apps/benches
+orchestrate it — serve must never know maintenance exists (the
+measured signals flow up, the promoted snapshots flow down through
+the registry/scheduler contracts).
 
 ``import hhmm_tpu`` (the root package: version metadata only) is
 allowed from anywhere. Function-scoped (lazy) imports are findings
@@ -50,8 +58,9 @@ LAYERS = {
     "models": 4,
     "batch": 4,
     "serve": 5,
-    "apps": 6,
-    "viz": 7,
+    "maint": 6,
+    "apps": 7,
+    "viz": 8,
 }
 
 
@@ -180,7 +189,7 @@ class LayerImportRule(Rule):
     title = "imports follow the layering DAG (no back-edges)"
     doc = (
         "core ← obs ← kernels ← infer/plan ← models/batch ← serve ← "
-        "apps ← viz: imports must point strictly down the ranks; "
+        "maint ← apps ← viz: imports must point strictly down the ranks; "
         "same-rank siblings stay decoupled. A back-edge couples a "
         "substrate to its consumer and breeds import cycles. Deliberate "
         "lazy cycle-breaking imports carry an inline pragma with a "

@@ -5,7 +5,7 @@ forking, and RDS memoization (SURVEY.md §2.9)."""
 
 from hhmm_tpu.batch.pad import pad_ragged, pad_datasets
 from hhmm_tpu.batch.cache import digest_key, ResultCache
-from hhmm_tpu.batch.fit import default_init, fit_batched
+from hhmm_tpu.batch.fit import default_init, fit_batched, init_from_snapshot
 
 __all__ = [
     "pad_ragged",
@@ -14,4 +14,5 @@ __all__ = [
     "ResultCache",
     "default_init",
     "fit_batched",
+    "init_from_snapshot",
 ]

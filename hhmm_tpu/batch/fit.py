@@ -49,7 +49,7 @@ from hhmm_tpu.plan import Plan, WorkloadShape, make_plan, plan_for_mesh
 from hhmm_tpu.robust import faults
 from hhmm_tpu.robust.retry import RetryPolicy, escalate, rejitter
 
-__all__ = ["default_init", "fit_batched"]
+__all__ = ["default_init", "fit_batched", "init_from_snapshot"]
 
 # base backoff between chunk retries on device faults (tests zero this)
 _RETRY_SLEEP_S = 15.0
@@ -175,6 +175,44 @@ def default_init(model, data_b, n_series, n_chains, key):
             _init_one_series(model, per_series, n_chains, jax.random.fold_in(key, i))
         )
     return jnp.stack(init)  # [B, C, dim]
+
+
+def init_from_snapshot(snap, num_chains: int) -> jnp.ndarray:
+    """[num_chains, dim] warm-start chain inits from a serving
+    snapshot's draw bank — the ``init=`` a drift-triggered refit
+    passes so re-estimation starts from the posterior it is refreshing
+    instead of a cold data-driven init (`hhmm_tpu/maint/refit.py`;
+    ROADMAP item 3). Measured on the Hassan toy model a converged warm
+    start reaches ``rhat_max < 1.05`` in at most HALF the cold-start
+    draw budget (pinned in ``tests/test_maint.py``).
+
+    ``snap`` is anything with ``dequantized_draws()`` (a
+    :class:`hhmm_tpu.serve.registry.PosteriorSnapshot` — quantized
+    banks dequantize to the f32 serving numerics first; this module
+    stays below `serve` in the layering DAG, so the contract is the
+    method, not the class) or a raw [D, dim] array. A bank larger than
+    the chain count is thinned evenly-spaced (maximally-separated
+    draws — distinct modes survive into distinct chains); a smaller
+    one tiles."""
+    if hasattr(snap, "dequantized_draws"):
+        draws = np.asarray(snap.dequantized_draws())
+    else:
+        draws = np.asarray(snap)
+    if draws.ndim != 2 or draws.shape[0] == 0:
+        raise ValueError(
+            f"snapshot draws must be a non-empty [D, dim] bank, got "
+            f"shape {draws.shape}"
+        )
+    C = int(num_chains)
+    if C <= 0:
+        raise ValueError(f"num_chains must be positive, got {num_chains}")
+    D = draws.shape[0]
+    if D >= C:
+        sel = np.linspace(0, D - 1, C).astype(int)
+        out = draws[sel]
+    else:
+        out = draws[np.arange(C) % D]
+    return jnp.asarray(out, jnp.float32)
 
 
 def fit_batched(

@@ -1,0 +1,56 @@
+"""Maintenance plane: drift-triggered warm refits, shadow evaluation,
+atomic snapshot promotion — the subsystem that closes the train→serve
+loop (ROADMAP item 3, `docs/maintenance.md`).
+
+The serving plane ages (every posterior is stale the moment it banks)
+and drifts (the paper's workloads are non-stationary by construction);
+`serve/` *measures* both — `LoglikCUSUM` drift alarms and the
+staleness gauge — and this plane *acts* on them:
+
+- `maint/triggers.py` — :class:`MaintenancePolicy`: alarms and
+  staleness breaches, debounced (per-series min interval, concurrency
+  cap, bounded queue) into :class:`RefitRequest`\\ s;
+- `maint/refit.py` — :func:`warm_refit`: one chunked
+  ``batch/fit.py`` fit over the scheduler's bounded history tails,
+  warm-started from the serving snapshots' own draws
+  (:func:`hhmm_tpu.batch.fit.init_from_snapshot`);
+- `maint/shadow.py` — :func:`shadow_evaluate`: champion/challenger on
+  held-out one-step posterior-predictive loglik; ties and losers are
+  discarded, counted;
+- `maint/promote.py` — :func:`promote_snapshot`: versioned registry
+  save + atomic ``serving/<series>`` alias repoint + in-place
+  scheduler swap (warm replay, staleness reset, tenant bindings kept,
+  zero new compiles);
+- `maint/loop.py` — :class:`MaintenanceLoop`: the tick-driven,
+  thread-free driver wiring detection → policy → refit → gate →
+  promote, with ``maint.*`` product counters and the ``maint``
+  manifest stanza.
+
+Layering: ``maint`` sits between ``serve`` and ``apps`` in the
+enforced DAG (`hhmm_tpu/analysis/layering.py`) — it may import
+serve/batch/models and below; apps may orchestrate it.
+"""
+
+from hhmm_tpu.maint.loop import MaintenanceLoop, MaintMetrics
+from hhmm_tpu.maint.promote import PromotionResult, promote_snapshot
+from hhmm_tpu.maint.refit import split_window, warm_refit
+from hhmm_tpu.maint.shadow import (
+    ShadowVerdict,
+    predictive_logliks,
+    shadow_evaluate,
+)
+from hhmm_tpu.maint.triggers import MaintenancePolicy, RefitRequest
+
+__all__ = [
+    "MaintenanceLoop",
+    "MaintMetrics",
+    "MaintenancePolicy",
+    "RefitRequest",
+    "PromotionResult",
+    "promote_snapshot",
+    "ShadowVerdict",
+    "predictive_logliks",
+    "shadow_evaluate",
+    "split_window",
+    "warm_refit",
+]

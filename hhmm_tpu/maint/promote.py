@@ -1,0 +1,80 @@
+"""Atomic promotion: bank the shadow winner, repoint the serving alias,
+swap the live scheduler state — in that order, each step atomic.
+
+The write order IS the correctness argument (mirrors
+`serve/registry.py`'s promotion docstring):
+
+1. ``SnapshotRegistry.promote`` saves the candidate under a fresh
+   versioned name (atomic ``.npz``) and atomically repoints the
+   ``serving/<series>`` alias — from this instant every *reader*
+   (pager page-ins included) resolves to the new posterior, and a
+   crash between steps leaves a fully-consistent registry;
+2. ``MicroBatchScheduler.swap_snapshot`` re-attaches the series in
+   place through the warm ``attach_many`` replay machinery (the
+   scheduler's bounded history tail warm-starts the new filter),
+   resetting the staleness clock and preserving tenant/quota bindings
+   and queued ticks; same bucket/pad shapes as any attach, so a warmed
+   scheduler swaps with zero new XLA compiles.
+
+A rejected swap (degrade-don't-raise) leaves the OLD state serving and
+is reported in the result; the registry alias already points at the
+winner, so the next page-in or explicit swap retry serves it — the
+promotion is durable even when the live swap is not immediate.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+from hhmm_tpu.serve.registry import PosteriorSnapshot, SnapshotRegistry
+
+__all__ = ["PromotionResult", "promote_snapshot"]
+
+
+@dataclass(frozen=True)
+class PromotionResult:
+    """One promotion attempt. ``swapped`` is whether the live scheduler
+    state moved; ``versioned_name`` is where the winner is banked
+    either way (the durable half)."""
+
+    series_id: str
+    versioned_name: str
+    swapped: bool
+    reason: Optional[str] = None  # swap rejection reason, None on success
+
+    def stanza(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "series": self.series_id,
+            "version": self.versioned_name,
+            "swapped": self.swapped,
+        }
+        if self.reason is not None:
+            out["reason"] = self.reason
+        return out
+
+
+def promote_snapshot(
+    scheduler,
+    registry: SnapshotRegistry,
+    series_id: str,
+    snapshot: PosteriorSnapshot,
+    history="auto",
+) -> PromotionResult:
+    """Promote ``snapshot`` to serve ``series_id``: registry first
+    (durable, atomic), live swap second (warm replay of the scheduler's
+    history tail by default). See the module docstring for why this
+    order makes the promotion atomic from every reader's view."""
+    versioned = registry.promote(series_id, snapshot)
+    # the candidate is in hand: swap it directly rather than re-reading
+    # the archive the line above just wrote (the registry stays the
+    # durable source for every OTHER reader — page-ins, restarts)
+    reason = scheduler.swap_snapshot(
+        series_id, history=history, snapshot=snapshot
+    )
+    return PromotionResult(
+        series_id=series_id,
+        versioned_name=versioned,
+        swapped=reason is None,
+        reason=reason,
+    )

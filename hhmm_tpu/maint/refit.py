@@ -1,0 +1,175 @@
+"""Sliding-window warm refit: the scheduler's bounded history tail,
+re-fit through the full `batch/fit.py` machinery, warm-started from the
+serving snapshot's own draws.
+
+Three deliberate reuses rather than a private sampler path:
+
+- **the window** is the scheduler's per-series observation ring
+  (`serve/scheduler.py::history_tail_of` — only *folded* ticks enter
+  it), split into a fit window and a held-out evaluation tail: the
+  shadow gate (`maint/shadow.py`) must judge the candidate on ticks the
+  refit never saw;
+- **the fit** is one chunked :func:`~hhmm_tpu.batch.fit_batched` call
+  over ALL pending requests — ragged windows pad with `batch/pad.py`
+  exactly like any batch fit, the robust escalation ladder and planner
+  placement come along for free, and a fleet-wide drift event costs one
+  dispatch, not one per series;
+- **the warm start** is :func:`~hhmm_tpu.batch.fit.init_from_snapshot`
+  over the serving snapshot's (dequantized) draw bank — re-estimation
+  starts at the posterior it refreshes, which is the whole point of
+  refitting *warm* (measured: half the cold-start draw budget to
+  converge, ``tests/test_maint.py``).
+
+The candidate snapshots inherit the champion's draw count and storage
+dtype by default, so a promotion swaps into the scheduler without
+moving the fixed-``D`` compile contract or the pager's quantized
+residency budget.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+
+from hhmm_tpu.batch.fit import fit_batched, init_from_snapshot
+from hhmm_tpu.batch.pad import pad_ragged
+from hhmm_tpu.maint.triggers import RefitRequest
+from hhmm_tpu.serve.registry import PosteriorSnapshot, snapshot_from_fit
+
+__all__ = ["split_window", "warm_refit"]
+
+
+def split_window(
+    tail: Dict[str, np.ndarray], eval_ticks: int
+) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]:
+    """Split one history tail into ``(fit_window, eval_tail)``: the
+    last ``eval_ticks`` observations are HELD OUT for the shadow gate;
+    everything before them is what the refit may see."""
+    if eval_ticks < 0:
+        raise ValueError(f"eval_ticks must be >= 0, got {eval_ticks}")
+    if eval_ticks == 0:
+        return dict(tail), {}
+    fit = {k: np.asarray(v)[:-eval_ticks] for k, v in tail.items()}
+    ev = {k: np.asarray(v)[-eval_ticks:] for k, v in tail.items()}
+    return fit, ev
+
+
+def warm_refit(
+    model,
+    requests: Sequence[RefitRequest],
+    tails: Dict[str, Optional[Dict[str, np.ndarray]]],
+    champions: Dict[str, Optional[PosteriorSnapshot]],
+    sampler_config,
+    key: jax.Array,
+    *,
+    eval_ticks: int = 16,
+    min_fit_ticks: int = 16,
+    n_draws: Optional[int] = None,
+    snapshot_dtype: Optional[str] = None,
+    plan=None,
+    retry=None,
+) -> Tuple[Dict[str, PosteriorSnapshot], List[Tuple[str, str]]]:
+    """Batch every runnable request into ONE chunked warm fit.
+
+    ``tails``/``champions``: per-series history window and serving
+    snapshot (``None`` entries are skipped with a reason — degrade,
+    don't raise: a maintenance pass must not die because one series
+    paged out between trigger and refit). Returns ``(candidates,
+    skipped)``: per-series candidate snapshots fitted on the tail
+    *minus* the held-out evaluation ticks, and the skip reasons.
+
+    The candidate inherits the champion's draw count (``n_draws=None``)
+    and storage dtype (``snapshot_dtype=None``) so promotion preserves
+    the scheduler's fixed-``D`` compile contract and the pager budget
+    arithmetic; candidate ``meta`` records the trigger (reason/tick)
+    and the window size for the manifest audit trail."""
+    runnable: List[Tuple[RefitRequest, Dict[str, np.ndarray], Any]] = []
+    skipped: List[Tuple[str, str]] = []
+    keyset: Optional[Tuple[str, ...]] = None
+    for req in requests:
+        sid = req.series_id
+        champ = champions.get(sid)
+        if champ is None:
+            skipped.append((sid, "no serving snapshot to warm-start from"))
+            continue
+        tail = tails.get(sid)
+        if not tail:
+            skipped.append((sid, "no history tail recorded"))
+            continue
+        ks = tuple(sorted(tail.keys()))
+        if keyset is None:
+            keyset = ks
+        elif ks != keyset:
+            skipped.append(
+                (sid, f"history keys {list(ks)} do not match the "
+                      f"batch's {list(keyset)}")
+            )
+            continue
+        L = int(np.asarray(tail[ks[0]]).shape[0])
+        if L < min_fit_ticks + eval_ticks:
+            skipped.append(
+                (sid, f"tail too short ({L} < {min_fit_ticks} fit + "
+                      f"{eval_ticks} eval ticks)")
+            )
+            continue
+        fit_win, _ = split_window(tail, eval_ticks)
+        runnable.append((req, fit_win, champ))
+    if not runnable:
+        return {}, skipped
+
+    C = int(sampler_config.num_chains)
+    # ragged fit windows pad exactly like any batch fit (masked steps
+    # contribute nothing to the loglik); equal-length windows get an
+    # all-ones mask — one data shape either way
+    data_b: Dict[str, np.ndarray] = {}
+    mask = None
+    assert keyset is not None
+    for k in keyset:
+        padded, mask = pad_ragged([fw[k] for _, fw, _ in runnable])
+        data_b[k] = padded
+    data_b["mask"] = np.asarray(mask, np.float32)
+    init = np.stack(
+        [np.asarray(init_from_snapshot(champ, C)) for _, _, champ in runnable]
+    )  # [B, C, dim]
+    samples, stats = fit_batched(
+        model,
+        data_b,
+        key,
+        sampler_config,
+        init=init,
+        chunk_size=len(runnable),
+        plan=plan,
+        retry=retry,
+    )
+    ch = stats.get("chain_healthy")
+    healthy = (
+        np.ones((len(runnable), C), bool)
+        if ch is None
+        else np.asarray(ch).reshape(len(runnable), -1)
+    )
+    candidates: Dict[str, PosteriorSnapshot] = {}
+    for i, (req, fit_win, champ) in enumerate(runnable):
+        nd = int(n_draws) if n_draws else int(np.asarray(champ.draws).shape[0])
+        dt = snapshot_dtype if snapshot_dtype else champ.draws_dtype
+        candidates[req.series_id] = snapshot_from_fit(
+            model,
+            np.asarray(samples[i]),
+            chain_healthy=healthy[i],
+            n_draws=nd,
+            dtype=dt,
+            meta={
+                "maint": {
+                    "reason": req.reason,
+                    "trigger_tick": req.tick,
+                    "fit_ticks": int(
+                        np.asarray(fit_win[keyset[0]]).shape[0]
+                    ),
+                    "eval_ticks": int(eval_ticks),
+                    "warm_start": True,
+                }
+            },
+        )
+    return candidates, skipped

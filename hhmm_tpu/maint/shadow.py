@@ -1,0 +1,192 @@
+"""Shadow evaluation: a candidate snapshot must BEAT the serving one on
+held-out data before it may serve.
+
+The gate is the champion/challenger pattern of production model
+serving: the refit (`maint/refit.py`) fitted on the history window
+minus an evaluation tail; here both snapshots filter that held-out
+tail and are scored on **one-step posterior-predictive log-likelihood**
+— for each tick ``t``, ``log p(x_t | x_{<t})`` under the snapshot's
+posterior *mixture* (the running filter evidence of each draw,
+logsumexp-averaged across the bank — exactly the quantity the
+:class:`~hhmm_tpu.serve.online.LoglikCUSUM` watches degrade, so the
+gate judges the candidate on the same axis the alarm fired on).
+
+The comparison is **paired per tick**: both snapshots see identical
+observations, so per-tick deltas cancel the shared noise and a small
+real improvement is detectable over a short tail. Acceptance requires
+the challenger's mean per-tick predictive loglik to exceed the
+champion's by strictly more than ``margin`` (ties lose: promotion
+costs a swap and resets the staleness/drift baselines — never pay that
+for noise). A candidate whose evidence is non-finite never wins; a
+champion whose evidence is non-finite (a dead serving posterior) loses
+to any finite challenger.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from hhmm_tpu.core.lmath import safe_logsumexp
+from hhmm_tpu.serve.online import filter_scan
+
+__all__ = ["ShadowVerdict", "predictive_logliks", "shadow_evaluate"]
+
+# one jitted vmapped filter-evidence function per MODEL INSTANCE: a
+# shadow pass evaluates champion and challenger back to back, and a
+# maintenance loop re-evaluates per pass — rebuilding the jit closure
+# each call would force a fresh XLA trace+compile every time, paid
+# INLINE with the serve loop. Keyed by id() with a weakref identity
+# check (id reuse after GC must never serve another model's program);
+# LRU-bounded — the closure pins the model alive while cached, so the
+# bound is also the lifetime bound. Lock discipline follows
+# `apps/tayal/pipeline.py::_GEN_JIT_CACHE`: the table is lock-guarded,
+# the jit is BUILT outside the lock, and a raced build collapses to
+# the first writer's canonical callable.
+_EVIDENCE_FNS: "OrderedDict[int, tuple]" = OrderedDict()
+_EVIDENCE_CACHE_CAP = 16
+_EVIDENCE_LOCK = threading.Lock()
+
+
+def _evidence_fn(model):
+    key = id(model)
+    with _EVIDENCE_LOCK:
+        ent = _EVIDENCE_FNS.get(key)
+        if ent is not None and ent[0]() is model:
+            _EVIDENCE_FNS.move_to_end(key)
+            return ent[1]
+
+    def one_draw(theta, data):
+        params = model.unpack(theta)[0]
+        log_pi, log_A, log_obs, mask = model.build(params, data)
+        _, lls = filter_scan(log_pi, log_A, log_obs, mask)
+        return lls  # [T] running evidence
+
+    fn = jax.jit(jax.vmap(one_draw, in_axes=(0, None)))
+    with _EVIDENCE_LOCK:
+        ent = _EVIDENCE_FNS.get(key)
+        if ent is not None and ent[0]() is model:
+            return ent[1]  # raced build: first writer wins
+        _EVIDENCE_FNS[key] = (weakref.ref(model), fn)
+        while len(_EVIDENCE_FNS) > _EVIDENCE_CACHE_CAP:
+            _EVIDENCE_FNS.popitem(last=False)
+    return fn
+
+
+@dataclass(frozen=True)
+class ShadowVerdict:
+    """One champion/challenger comparison, JSON-ready via
+    :meth:`stanza`. ``mean_delta`` is the challenger-minus-champion
+    mean per-tick predictive loglik (``inf``/``-inf`` when exactly one
+    side's evidence is non-finite); ``win_rate`` the fraction of ticks
+    the challenger was strictly ahead."""
+
+    series_id: str
+    ticks: int
+    champion_loglik: float
+    challenger_loglik: float
+    mean_delta: float
+    win_rate: float
+    margin: float
+    accepted: bool
+
+    def stanza(self) -> Dict[str, Any]:
+        def _f(v: float):
+            return round(v, 4) if np.isfinite(v) else str(v)
+
+        return {
+            "series": self.series_id,
+            "ticks": self.ticks,
+            "champion_per_tick": _f(self.champion_loglik),
+            "challenger_per_tick": _f(self.challenger_loglik),
+            "mean_delta": _f(self.mean_delta),
+            "win_rate": round(self.win_rate, 4),
+            "margin": self.margin,
+            "accepted": self.accepted,
+        }
+
+
+def predictive_logliks(model, snap, eval_data: Dict[str, Any]) -> np.ndarray:
+    """Per-tick one-step posterior-predictive loglik [T] of ``snap``'s
+    posterior mixture over ``eval_data``.
+
+    Per draw ``d`` the filter's running evidence ``L_d[t] = log p(x_{1:t}
+    | θ_d)`` comes from the same guarded :func:`~hhmm_tpu.serve.online.
+    filter_scan` the serving replay uses; the mixture evidence is
+    ``M[t] = lse_d(L_d[t]) − log D`` and the per-tick predictive is its
+    increment ``M[t] − M[t−1]`` (with ``M[0]`` the first tick's own
+    evidence) — exact under the equal-weight posterior-draw mixture.
+    Draws whose final evidence is non-finite (NaN parameters, dead
+    filters) are excluded from the mixture; with no finite draw at all
+    every tick reads ``-inf`` (an unservable posterior must LOSE the
+    gate, not poison it with NaN)."""
+    draws = (
+        snap.dequantized_draws()
+        if hasattr(snap, "dequantized_draws")
+        else np.asarray(snap)
+    )
+    draws = jnp.asarray(np.asarray(draws, np.float32))
+    data = {k: jnp.asarray(np.asarray(v)) for k, v in eval_data.items()}
+    # cached per model instance: champion+challenger (and every later
+    # pass over the same eval-tail shape) reuse one compiled program
+    lls = np.asarray(_evidence_fn(model)(draws, data))  # [D, T]
+    finite = np.isfinite(lls[:, -1])
+    if not finite.any():
+        return np.full(lls.shape[1], -np.inf)
+    kept = jnp.asarray(np.where(finite[:, None], lls, -np.inf))
+    mix = np.asarray(safe_logsumexp(kept, axis=0)) - np.log(finite.sum())
+    out = np.empty_like(mix)
+    out[0] = mix[0]
+    out[1:] = np.diff(mix)
+    return out
+
+
+def shadow_evaluate(
+    model,
+    champion,
+    challenger,
+    eval_data: Dict[str, Any],
+    *,
+    margin: float = 0.0,
+    series_id: str = "",
+) -> ShadowVerdict:
+    """Judge ``challenger`` against ``champion`` on the held-out tail.
+    See the module docstring for the acceptance rule."""
+    sizes = {int(np.asarray(v).shape[0]) for v in eval_data.values()}
+    if len(sizes) != 1 or 0 in sizes:
+        raise ValueError(
+            f"eval_data must be non-empty per-tick arrays of one length, "
+            f"got lengths {sorted(sizes)}"
+        )
+    T = sizes.pop()
+    d_champ = predictive_logliks(model, champion, eval_data)
+    d_chall = predictive_logliks(model, challenger, eval_data)
+    mean_champ = float(np.mean(d_champ))
+    mean_chall = float(np.mean(d_chall))
+    if not np.isfinite(mean_chall):
+        mean_delta = float("-inf")  # an unservable candidate never wins
+    elif not np.isfinite(mean_champ):
+        mean_delta = float("inf")  # any finite candidate beats a dead champion
+    else:
+        mean_delta = mean_chall - mean_champ
+    win_rate = float(np.mean(d_chall > d_champ))
+    healthy = bool(getattr(challenger, "healthy", True))
+    accepted = bool(healthy and mean_delta > float(margin))
+    return ShadowVerdict(
+        series_id=series_id,
+        ticks=T,
+        champion_loglik=mean_champ,
+        challenger_loglik=mean_chall,
+        mean_delta=mean_delta,
+        win_rate=win_rate,
+        margin=float(margin),
+        accepted=accepted,
+    )

@@ -60,6 +60,8 @@ import jax.numpy as jnp
 __all__ = [
     "FaultPlan",
     "TrafficFaultPlan",
+    "RegimeShiftPlan",
+    "regime_shift_active",
     "SimulatedCrash",
     "SimulatedDeviceLoss",
     "inject",
@@ -179,6 +181,35 @@ class TrafficFaultPlan:
         return 1
 
 
+@dataclass(frozen=True)
+class RegimeShiftPlan:
+    """DATA-plane drift injection (the maintenance bench's fault class,
+    `bench.py --maint`): from stream tick ``at_tick`` on, the traffic
+    generator swaps its observation source to an alternate regime —
+    statistically shifted data, not corrupted execution. Unlike
+    :class:`FaultPlan`/:class:`TrafficFaultPlan` nothing fires inside
+    the serving/fit paths: the generator itself consults
+    :func:`regime_shift_active` per tick (arrivals are the injection
+    surface, exactly like burst load), and everything downstream —
+    CUSUM alarm, debounced trigger, warm refit, shadow gate, promotion
+    — must absorb the shift through the ordinary maintenance ladder.
+    Stacks independently of the other plan types; the innermost
+    ``RegimeShiftPlan`` wins."""
+
+    at_tick: int = 0
+
+    def __post_init__(self):
+        if int(self.at_tick) < 0:
+            raise ValueError(f"at_tick must be >= 0, got {self.at_tick}")
+
+
+def regime_shift_active(tick: int) -> bool:
+    """Whether the innermost :class:`RegimeShiftPlan` (if any) has the
+    shifted regime active at stream tick ``tick``."""
+    entry = _innermost(RegimeShiftPlan)
+    return entry is not None and int(tick) >= entry.plan.at_tick
+
+
 class _ActiveEntry:
     """One injection-stack frame: the plan plus its mutable fault
     counters (chunk crashes for :class:`FaultPlan`, load/dispatch
@@ -208,13 +239,13 @@ def _stack() -> list:
 
 @contextmanager
 def inject(plan):
-    """Activate ``plan`` (a :class:`FaultPlan` or
-    :class:`TrafficFaultPlan`) for the duration of the block on THIS
+    """Activate ``plan`` (a :class:`FaultPlan`, :class:`TrafficFaultPlan`,
+    or :class:`RegimeShiftPlan`) for the duration of the block on THIS
     thread (re-entrant; the innermost plan of each type wins)."""
-    if not isinstance(plan, (FaultPlan, TrafficFaultPlan)):
+    if not isinstance(plan, (FaultPlan, TrafficFaultPlan, RegimeShiftPlan)):
         raise TypeError(
-            f"inject() takes a FaultPlan or TrafficFaultPlan, got "
-            f"{type(plan).__name__}"
+            f"inject() takes a FaultPlan, TrafficFaultPlan, or "
+            f"RegimeShiftPlan, got {type(plan).__name__}"
         )
     stack = _stack()
     stack.append(_ActiveEntry(plan))

@@ -38,6 +38,7 @@ cannot drift from the batch filter.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import NamedTuple, Optional, Tuple
 
@@ -291,18 +292,29 @@ class LoglikCUSUM:
     h=4 because a serving alarm triggers a refit: at k=0.5 the
     in-control ARL is ~340 ticks for h=4 (an alarm storm at tick rate)
     vs ~70k for h=8, while a 2σ sustained drop is still caught in
-    ~h/1.5 ≈ 6 ticks. After an alarm the
-    statistic resets so repeated alarms mean *sustained* drift, not one
-    excursion replaying forever. Host-side, O(1) per tick — lives next
+    ~h/1.5 ≈ 6 ticks. After an alarm the detector :meth:`reset`\\ s —
+    the statistic zeroes AND the baseline re-enters calibration on the
+    *post-shift* distribution — so one sustained shift fires ONCE per
+    re-calibration window instead of every ~h/z ticks forever (the
+    alarm-storm mode the maintenance plane must not see: each alarm is
+    a refit trigger, `hhmm_tpu/maint/triggers.py`). A further shift
+    beyond the new baseline alarms again; the maintenance plane also
+    calls :meth:`reset` explicitly when a promoted refit makes the old
+    baseline moot. Host-side, O(1) per tick — lives next
     to :class:`RegimeDetector` by design; each alarm also increments
     the ``serve.drift_alarms`` counter on the shared metrics plane
-    (`hhmm_tpu/obs/metrics.py` — a no-op while the plane is disabled).
+    (`hhmm_tpu/obs/metrics.py` — a no-op while the plane is disabled),
+    labeled ``series=`` when :attr:`series` is set (bounded via the
+    shared ``obs/request.py`` tenant-label fold — fleet-scale series
+    ids must not grow the registry one instrument per stream; the
+    unlabeled counter stays the product total).
     """
 
     threshold: float = 8.0  # h, in σ units of cumulated drop
     drift: float = 0.5  # k, per-tick allowance in σ units
     calibrate: int = 32  # ticks of baseline estimation before arming
     min_sigma: float = 1e-6
+    series: Optional[str] = None  # metrics label (None = unlabeled only)
     stat: float = field(default=0.0, repr=False)  # S_t
     alarms: int = field(default=0, repr=False)
     _n: int = field(default=0, repr=False)
@@ -310,11 +322,29 @@ class LoglikCUSUM:
     _mean: float = field(default=0.0, repr=False)
     _m2: float = field(default=0.0, repr=False)
 
+    def reset(self) -> None:
+        """Re-arm from scratch: zero the statistic and re-enter
+        baseline calibration. Called automatically after every alarm
+        (the post-alarm distribution IS the new normal until a refit
+        lands) and explicitly by the maintenance plane when a promoted
+        snapshot resets what "in-distribution" means. The cumulative
+        ``alarms`` count survives — it is a health fact, not state."""
+        self.stat = 0.0
+        self._n = 0
+        self._finite = 0
+        self._mean = 0.0
+        self._m2 = 0.0
+
     def update(self, loglik_increment: float) -> Tuple[float, bool]:
         """Absorb one tick's predictive loglik increment; returns
-        ``(cusum_stat, drifted_this_tick)``. Non-finite increments (a
-        quarantined stream's −inf floor) count as a maximal drop — a
-        dead stream IS drifted — without poisoning the baseline."""
+        ``(cusum_stat, drifted_this_tick)``. A ``-inf``/NaN increment
+        (a quarantined stream's −inf floor, a degraded tick) counts as
+        a maximal drop — a dead stream IS drifted — without poisoning
+        the baseline. A ``+inf`` increment is the mirror case — the
+        PREVIOUS tick was the dead one and the stream just RECOVERED —
+        and must count as no drop at all: classifying a recovery as a
+        maximal drop would fire a guaranteed false alarm on the first
+        healthy tick after a transient degraded fold."""
         x = float(loglik_increment)
         self._n += 1
         if np.isfinite(x) and self._n <= self.calibrate:
@@ -332,13 +362,44 @@ class LoglikCUSUM:
         sigma = max(
             np.sqrt(self._m2 / max(self._finite - 1, 1)), self.min_sigma
         )
-        z = (self._mean - x) / sigma if np.isfinite(x) else self.threshold + 1.0
+        if np.isfinite(x):
+            z = (self._mean - x) / sigma
+        elif x == float("inf"):
+            z = 0.0  # recovery from a dead tick: no drop
+        else:  # -inf or NaN: maximal drop
+            z = self.threshold + 1.0
         self.stat = max(0.0, self.stat + z - self.drift)
         if self.stat > self.threshold:
-            self.stat = 0.0
             self.alarms += 1
             from hhmm_tpu.obs import metrics as _obs_metrics
 
             _obs_metrics.counter("serve.drift_alarms").inc()
+            if self.series is not None and _obs_metrics.enabled():
+                from hhmm_tpu.obs import request as _obs_request
+
+                # the label fold mutates the shared seen-set: two
+                # threads' detectors alarming at the cardinality-cap
+                # boundary must not both pass the bound check (the
+                # PR 12 shared-state discipline; the counter inc
+                # itself is registry-locked already)
+                with _DRIFT_LABELS_LOCK:
+                    label = _obs_request.bounded_tenant_label(
+                        self.series, _DRIFT_SERIES_LABELS
+                    )
+                _obs_metrics.counter(
+                    "serve.drift_alarms", series=label
+                ).inc()
+            # debounce: re-baseline on the post-shift distribution so a
+            # SUSTAINED shift is one alarm per calibration window, not
+            # an alarm (= refit trigger) every few ticks
+            self.reset()
             return 0.0, True
         return self.stat, False
+
+
+# series-label values already created on the shared plane by drift
+# alarms (all detector instances pool one bound: the label exists to
+# attribute alarms, not to enumerate a fleet); lock-guarded — the
+# fold's check-then-add must be atomic across threads
+_DRIFT_SERIES_LABELS: set = set()
+_DRIFT_LABELS_LOCK = threading.Lock()

@@ -186,13 +186,23 @@ class SnapshotPager:
             self._hits.inc()
             return entry[0]
         self._misses.inc()
+        # promoted series resolve through the serving alias
+        # (`SnapshotRegistry.promote`): a paged-out series must come
+        # back on its PROMOTED snapshot, not the stale pre-promotion
+        # artifact — eviction would otherwise silently undo a refit
+        target = self.registry.serving_name(name) or name
         # the traffic-fault surface: slow-load latency (an injected
         # SLEEP) and torn-file corruption land here, exactly where cold
         # storage would bite — and exactly why this path must not hold
         # the lock: a 100 ms injected stall inside the critical section
         # would serialize every concurrent hit behind it
-        faults.snapshot_load_fault(self.registry.path(name))
-        return self.registry.load(name)
+        faults.snapshot_load_fault(self.registry.path(target))
+        snap = self.registry.load(target)
+        if snap is None and target != name:
+            # stale alias (torn/corrupt versioned archive): the
+            # plain-name snapshot is still a servable posterior
+            snap = self.registry.load(name)
+        return snap
 
     def touch(self, name: str) -> Optional[PosteriorSnapshot]:
         """Load-or-hit WITH admission (:meth:`load` + :meth:`admit`):

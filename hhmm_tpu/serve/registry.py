@@ -32,6 +32,7 @@ import inspect
 import json
 import os
 import sys
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -42,6 +43,7 @@ from hhmm_tpu.batch.cache import (
     load_npz_tolerant,
     quarantine_corrupt,
 )
+from hhmm_tpu.obs.trace import atomic_write_text
 
 __all__ = [
     "SNAPSHOT_VERSION",
@@ -283,6 +285,12 @@ class SnapshotRegistry:
     def __init__(self, root: str):
         self.root = root
         os.makedirs(root, exist_ok=True)
+        # serializes promote()'s aliases read-modify-write: two
+        # IN-PROCESS promoters of different series must not lose one
+        # repoint (the whole-map rewrite is not commutative). Across
+        # processes the store keeps its existing single-writer-per-root
+        # contract, same as the chunk cache.
+        self._alias_lock = threading.Lock()
 
     def _path(self, name: str) -> str:
         if not name or any(c in name for c in "/\\\0") or name.startswith("."):
@@ -351,6 +359,100 @@ class SnapshotRegistry:
             },
         )
         return path
+
+    # ---- promotion (the maintenance plane's atomic swap target) ----
+    #
+    # A promotion is two atomic writes in a fixed order: (1) the
+    # candidate archive lands under a FRESH versioned name
+    # ("<name>.v<N>", never overwritten), (2) the aliases file — one
+    # JSON map "serving/<name>" -> versioned name, written via the
+    # shared `trace.atomic_write_text` — repoints. A reader resolving
+    # through `load_serving` therefore always loads a COMPLETE archive:
+    # the old one (alias not yet repointed) or the new one (repointed,
+    # and its archive was fully on disk first). Never a miss, never a
+    # torn file — the save+tear race discipline of the snapshot store
+    # extended to the pointer (symlink-free: .npz stores must load on
+    # hosts where symlinks are unavailable or stripped).
+
+    def _aliases_path(self) -> str:
+        return os.path.join(self.root, "aliases.json")
+
+    def _load_aliases(self) -> Dict[str, str]:
+        path = self._aliases_path()
+        try:
+            with open(path, "r") as f:
+                raw = json.load(f)
+            if not isinstance(raw, dict):
+                raise ValueError(f"aliases must be a JSON object, got {type(raw).__name__}")
+            return {str(k): str(v) for k, v in raw.items()}
+        except FileNotFoundError:
+            return {}
+        except Exception as e:
+            # corrupt alias map: quarantine-as-miss (readers fall back
+            # to plain names — the pre-promotion snapshots), a re-save
+            # at the next promote heals it
+            quarantine_corrupt(path, "SnapshotRegistry.aliases", e)
+            return {}
+
+    def promote(self, name: str, snap: PosteriorSnapshot) -> str:
+        """Save ``snap`` under a fresh versioned name and atomically
+        repoint the ``serving/<name>`` alias at it. Returns the
+        versioned name. Old versions stay on disk (the rollback
+        surface, and the other half of the reader race guarantee: a
+        reader mid-``load_serving`` on the old alias still finds its
+        archive). ``load_serving(name)`` serves the promoted snapshot;
+        plain ``load(name)`` keeps reading the un-promoted artifact."""
+        self._path(name)  # validate the base name
+        alias_key = f"serving/{name}"
+        # the version pick + archive write happen OUTSIDE the lock (the
+        # slow .npz save must not serialize concurrent promoters); only
+        # the aliases read-modify-write is the critical section — a
+        # whole-map rewrite racing another series' promote would lose
+        # one repoint and silently revert that series to its stale
+        # plain-name artifact
+        prev = self._load_aliases().get(alias_key)
+        n = 1
+        if prev is not None and prev.startswith(f"{name}.v"):
+            try:
+                n = int(prev[len(name) + 2 :]) + 1
+            except ValueError:
+                n = 1
+        versioned = f"{name}.v{n}"
+        while self.exists(versioned):  # archived versions are immutable
+            n += 1
+            versioned = f"{name}.v{n}"
+        self.save(versioned, snap)
+        with self._alias_lock:
+            # the alias-map I/O is deliberately inside the lock: the
+            # read-modify-write IS the invariant being protected, both
+            # files are tiny, and the archive write above (the slow
+            # I/O) already happened outside
+            aliases = self._load_aliases()  # lint: ok held-lock-escape -- the aliases read-modify-write must be atomic; tiny JSON, slow npz I/O stays outside
+            aliases[alias_key] = versioned
+            atomic_write_text(  # lint: ok held-lock-escape -- same critical section: the repoint must pair with the read above
+                self._aliases_path(),
+                json.dumps(aliases, sort_keys=True, indent=1) + "\n",
+            )
+        return versioned
+
+    def serving_name(self, name: str) -> Optional[str]:
+        """The versioned name the ``serving/<name>`` alias points at,
+        or ``None`` when ``name`` was never promoted."""
+        return self._load_aliases().get(f"serving/{name}")
+
+    def load_serving(self, name: str) -> Optional[PosteriorSnapshot]:
+        """Load the snapshot *serving* under ``name``: the promoted
+        (alias-resolved) version when one exists, else the plain-name
+        artifact — so pre-promotion registries behave exactly as
+        before. A stale alias whose archive is missing/corrupt falls
+        back to the plain name rather than reporting a miss for a
+        series that still has a servable posterior."""
+        target = self.serving_name(name)
+        if target is not None:
+            snap = self.load(target)
+            if snap is not None:
+                return snap
+        return self.load(name)
 
     def load(self, name: str) -> Optional[PosteriorSnapshot]:
         path = self._path(name)

@@ -70,7 +70,7 @@ serve layer).
 
 from __future__ import annotations
 
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -198,6 +198,7 @@ class MicroBatchScheduler:
         pager=None,
         profile_every: int = 0,
         recorder: Optional[obs_request.RequestRecorder] = None,
+        history_tail: int = 0,
     ):
         """``plan``: an optional :class:`hhmm_tpu.plan.Plan` — the
         topology-aware placement decision (`docs/sharding.md`). When
@@ -234,7 +235,16 @@ class MicroBatchScheduler:
         constructs one that follows the tracer flag — untraced
         production serving pays one attribute read + branch per
         lifecycle call; benches pass an explicitly-enabled recorder to
-        decompose untraced steady-state latency."""
+        decompose untraced steady-state latency.
+
+        ``history_tail``: per-series bounded ring of the most recent
+        *folded* observations (ticks that actually advanced the
+        filter — shed ticks never enter it). 0 (the default) disables
+        it at zero cost; the maintenance plane (`hhmm_tpu/maint/`)
+        turns it on so drift-triggered warm refits have a sliding
+        window to fit on (:meth:`history_tail_of`) and
+        :meth:`swap_snapshot` has a replay history to warm-start the
+        promoted posterior from."""
         if buckets is None:
             buckets = plan.buckets if plan is not None else (8, 32, 128)
         if not buckets or any(b <= 0 for b in buckets):
@@ -271,6 +281,15 @@ class MicroBatchScheduler:
             # eviction releases the series end-to-end: draw bank, stream
             # state, staleness entry, queued ticks (shed) — detach()
             pager.set_evict_listener(self.detach)
+        self.history_tail = int(history_tail or 0)
+        if self.history_tail < 0:
+            raise ValueError(
+                f"history_tail must be >= 0, got {history_tail}"
+            )
+        # per-series bounded deque of folded observation dicts (the
+        # maintenance plane's sliding refit window); released by
+        # detach() like every other per-series table
+        self._tail: Dict[str, Any] = {}
         self.n_draws: Optional[int] = None
         self._series: Dict[str, Dict[str, Any]] = {}
         # snapshot-staleness accounting (obs metrics plane): perf_counter
@@ -278,6 +297,9 @@ class MicroBatchScheduler:
         # serving posterior, whose age is the staleness gauge flush()
         # publishes (ROADMAP item 3's cheap staleness signal)
         self._attach_t: Dict[str, float] = {}
+        # monotone per-series count of COMMITTED attaches (filter-state
+        # replacements); see attach_generation()
+        self._attach_gen: Dict[str, int] = {}
         self._oldest_attach_t: Optional[float] = None
         # pending entries: (series_id, obs, t_submit, tenant, trace) —
         # trace is the request-plane TickTrace (None while disabled)
@@ -406,7 +428,13 @@ class MicroBatchScheduler:
             # keep serving the attached healthy posterior
             return snap, True, True
         if self.registry is not None:
-            prev = self.registry.load(series_id)
+            # alias-resolved: the fallback must be the snapshot SERVING
+            # under this name — falling back to the plain-name artifact
+            # would silently revert a promoted series to its stale
+            # pre-promotion posterior (the same invariant as the
+            # pager's cold path; load_serving degrades to the plain
+            # name for never-promoted series)
+            prev = self.registry.load_serving(series_id)
             if prev is not None and prev.healthy:
                 # the fallback draws are healthy: serving is NOT degraded
                 # (only the rejected fit is, counted in the metrics)
@@ -581,6 +609,13 @@ class MicroBatchScheduler:
         now = obs_request.now()
         for series_id in new_recs:
             self._attach_t[series_id] = now
+            # a COMMITTED attach replaces the filter state: its running
+            # evidence restarts, so consumers differencing response
+            # logliks across ticks (the maintenance plane's drift
+            # detectors) must be able to see the discontinuity
+            self._attach_gen[series_id] = (
+                self._attach_gen.get(series_id, 0) + 1
+            )
         for series_id in keeps:
             self._attach_t.setdefault(series_id, now)
         if self._attach_t:
@@ -711,6 +746,7 @@ class MicroBatchScheduler:
         False when the series was not attached."""
         rec = self._series.pop(series_id, None)
         self._pending_count.pop(series_id, None)
+        self._tail.pop(series_id, None)
         if self.pager is not None:
             self.pager.discard(series_id)  # no-op if the pager evicted us
         if rec is None:
@@ -1240,9 +1276,19 @@ class MicroBatchScheduler:
         # device-complete: reuse the post-sync read (no second clock)
         self.recorder.stage(traces, "device", t=done)
         responses = []
-        for i, (series_id, _, t_submit, _, _) in enumerate(group):
+        for i, (series_id, obs_i, t_submit, _, _) in enumerate(group):
             rec = self._series[series_id]
             rec["alpha"], rec["ll"], rec["ok"] = alpha[i], ll[i], okd[i]
+            if self.history_tail:
+                # the maintenance plane's sliding refit window: only
+                # FOLDED observations enter (this loop runs after the
+                # dispatch committed); the deque bound makes it O(1)
+                tail = self._tail.get(series_id)
+                if tail is None:
+                    tail = self._tail[series_id] = deque(
+                        maxlen=self.history_tail
+                    )
+                tail.append(dict(obs_i))
             n_ok = int(np.asarray(okd[i]).sum())
             degraded = bool(rec["degraded_attach"]) or n_ok == 0
             if degraded:
@@ -1260,6 +1306,101 @@ class MicroBatchScheduler:
         # respond: the post-process share ends with the built responses
         self.recorder.complete_group(traces, kernel=kernel, bucket=bn)
         return responses
+
+    # ---- maintenance surface (hhmm_tpu/maint) ----
+
+    def history_tail_of(self, series_id: str) -> Optional[Dict[str, Any]]:
+        """The bounded recent-observation window of one series, as a
+        dict of stacked per-key arrays [L] (the ``attach(history=...)``
+        / ``fit_batched`` data shape) — the sliding window a
+        drift-triggered warm refit fits on. ``None`` while the ring is
+        disabled (``history_tail=0``) or still empty."""
+        tail = self._tail.get(series_id)
+        if not tail:
+            return None
+        keys = sorted(tail[0].keys())
+        return {k: np.asarray([o[k] for o in tail]) for k in keys}
+
+    def attach_generation(self, series_id: str) -> int:
+        """How many times this series' filter state has been replaced
+        by a committed attach (initial attach = 1; swaps and pager
+        page-ins increment it; 0 = never attached). The running-loglik
+        stream is only differencable WITHIN one generation — a
+        response-loglik increment spanning a generation change is a
+        filter restart, not evidence of drift (`hhmm_tpu/maint/loop.py`
+        drops exactly that increment). Deliberately NOT reset on
+        detach: a detach+re-attach is two restarts, and a stale reader
+        comparing across it must still see the number move."""
+        return self._attach_gen.get(series_id, 0)
+
+    def staleness_of(self, series_id: str) -> float:
+        """Seconds since this series' serving posterior was last
+        (re-)attached — the per-series staleness the maintenance
+        trigger policy consumes (the gauge publishes only the fleet
+        max). NaN when the series is not attached."""
+        t = self._attach_t.get(series_id)
+        return float("nan") if t is None else obs_request.now() - t
+
+    def swap_snapshot(
+        self,
+        series_id: str,
+        name: Optional[str] = None,
+        history="auto",
+        snapshot: Optional[PosteriorSnapshot] = None,
+    ) -> Optional[str]:
+        """Atomically swap one attached series onto the snapshot
+        serving under ``name`` in the registry (alias-resolved —
+        ``SnapshotRegistry.load_serving``; default: the series' own
+        name). Returns ``None`` on success, else the rejection reason
+        (degrade-don't-raise: a failed swap leaves the current serving
+        state untouched).
+
+        The swap IS an in-place re-attach through the warm
+        ``attach_many`` replay machinery: ``history`` defaults to the
+        series' own bounded tail (:meth:`history_tail_of`), so the
+        promoted posterior resumes with a warm filter instead of a
+        cold prior. Everything the maintenance contract needs follows
+        from the attach path: the staleness clock resets on commit,
+        the tenant binding survives (bindings only move on an explicit
+        attach tenant), queued ticks stay queued, and the replay lands
+        in the same bucket/``T_pad`` shapes as any attach — a warmed
+        scheduler swaps with ZERO new XLA compiles (asserted in
+        ``tests/test_maint.py`` and gated in ``bench.py --maint``).
+
+        ``snapshot``: the already-in-memory artifact to swap in — the
+        maintenance promotion path just WROTE the candidate, so
+        re-resolving it through the registry (alias read + full
+        archive load, inline with the serve loop) would be a redundant
+        disk round-trip; ``None`` keeps the alias-resolved registry
+        read."""
+        if snapshot is not None:
+            snap = snapshot
+        else:
+            if self.registry is None:
+                return "no registry attached to swap from"
+            nm = series_id if name is None else name
+            snap = self.registry.load_serving(nm)
+            if snap is None:
+                return f"no servable snapshot under {nm!r} to swap in"
+        if isinstance(history, str) and history == "auto":
+            history = self.history_tail_of(series_id)
+        gen0 = self.attach_generation(series_id)
+        rejected = self.attach_many([(series_id, snap, history, None)])
+        if rejected:
+            return rejected[0][1]
+        if self.attach_generation(series_id) == gen0:
+            # attach_many's quarantine KEEP path: an unhealthy snapshot
+            # arriving over a healthy serving state is kept-not-swapped
+            # (rejected list stays empty). A caller told "None" here
+            # would count a promotion, reset drift baselines, and
+            # believe the staleness clock restarted while the OLD
+            # draws keep serving — a silent false success
+            return (
+                f"swap did not commit for {series_id!r}: the candidate "
+                "is quarantined (healthy=False) and the serving state "
+                "is healthy — kept, not swapped"
+            )
+        return None
 
     # ---- introspection ----
 

@@ -50,6 +50,14 @@ Further gate rules:
   followed by a record with ``faults_escaped > 0`` — an injected fault
   leaking out as an exception is a survival regression even if the
   bench somehow exited 0.
+- **Maintenance gates like resilience**: a record whose manifest
+  stanza carries a ``maint`` stanza (`bench.py --maint`,
+  `hhmm_tpu/maint/`) fails the gate when a comparable baseline that
+  PROMOTED (``promotions > 0``) is followed by a record with zero
+  promotions — the drift→refit→shadow→promote ladder going dark on the
+  same workload is a closed-loop regression even if the bench's own
+  gates were loosened. A first record with zero promotions is reported
+  but has no promoting baseline, so it does not gate.
 - **Request-plane health gates inverted too**: a record whose manifest
   stanza carries a ``request`` stanza (`hhmm_tpu/obs/request.py`,
   embedded by ``bench.py --serve`` / ``--serve-storm``) fails the gate
@@ -193,6 +201,7 @@ def diff(
     last_by_key: Dict[Tuple, Dict[str, Any]] = {}
     last_slo_by_key: Dict[Tuple, bool] = {}
     last_escaped_by_key: Dict[Tuple, int] = {}
+    last_promotions_by_key: Dict[Tuple, int] = {}
     last_costs_by_key: Dict[Tuple, Dict[str, float]] = {}
     last_request_by_key: Dict[Tuple, Dict[str, Optional[float]]] = {}
     failures = 0
@@ -308,6 +317,32 @@ def diff(
                 else:
                     row["status"] += "; faults contained"
                 last_escaped_by_key[key] = esc
+            # the maintenance plane rides the same key, gated like the
+            # resilience gate: a comparable record that PROMOTED
+            # (promotions > 0) followed by one that could not close the
+            # loop at all (promotions == 0) is a maintenance regression
+            # — the drift->refit->shadow->promote ladder went dark
+            maint = (rec.get("manifest") or {}).get("maint")
+            if isinstance(maint, dict) and "promotions" in maint:
+                try:
+                    promos = int(maint.get("promotions") or 0)
+                except (TypeError, ValueError):
+                    promos = -1  # malformed: visible, never a baseline
+                prev_promos = last_promotions_by_key.get(key)
+                if prev_promos is not None and prev_promos > 0 and promos == 0:
+                    failures += 1
+                    row["gated"] = True
+                    row["status"] += (
+                        "; MAINTENANCE REGRESSION: 0 promotions "
+                        f"(baseline round promoted {prev_promos})"
+                    )
+                elif promos == 0:
+                    row["status"] += (
+                        "; no promotions (no promoting baseline)"
+                    )
+                else:
+                    row["status"] += f"; maint promotions {promos}"
+                last_promotions_by_key[key] = promos
             # the request plane rides the same key, gated INVERTED
             # (lower is better): fairness-spread growth is tenant
             # starvation creeping in, queue-share growth is latency

@@ -28,6 +28,10 @@ SLO attainment). This script folds all of it into one readable report:
                      observables (p99 spread, queue age, interleaving)
   == storm ==        the `bench.py --serve-storm` verdict: faults
                      injected/escaped + survival gates, fairness arms
+  == maintenance ==  the `hhmm_tpu/maint/` closed loop (`bench.py
+                     --maint`): drift triggers -> warm refits ->
+                     shadow verdicts -> promotions, with the recent
+                     event table and the LOOP CLOSED verdict
   == analysis ==     the `hhmm_tpu.analysis` static-analyzer verdict:
                      per-family + per-rule finding/suppression counts,
                      the lock-order DAG verdict (ACYCLIC/CYCLES), and
@@ -444,6 +448,55 @@ def render_storm(man: Dict[str, Any], out) -> None:
         print("  verdict: SURVIVED", file=out)
 
 
+def render_maint(man: Dict[str, Any], out) -> None:
+    """The ``maint`` stanza (`hhmm_tpu/maint/`, `bench.py --maint`):
+    the drift→refit→shadow→promote ladder's counters and the recent
+    event window — how many alarms became refits, how many candidates
+    won shadow evaluation and were promoted, and what each promotion's
+    paired predictive-loglik verdict was."""
+    maint = man.get("maint") or _record_manifest(man).get("maint")
+    if not isinstance(maint, dict):
+        return  # no maintenance plane in this run: no section
+    _section("maintenance", out)
+    for key, label in (
+        ("triggers", "triggers (alarm/staleness -> refit request)"),
+        ("refits", "warm refits"),
+        ("promotions", "promotions"),
+        ("shadow_rejections", "shadow rejections"),
+        ("skipped_refits", "skipped refits"),
+        ("failed_swaps", "failed swaps"),
+        ("refit_seconds", "refit seconds"),
+        ("dropped_triggers", "dropped triggers"),
+        ("pending", "pending requests"),
+    ):
+        if key in maint:
+            print(f"  {label}: {_fmt(maint.get(key))}", file=out)
+    events = maint.get("events")
+    if isinstance(events, list) and events:
+        rows = []
+        for e in events:
+            if not isinstance(e, dict):
+                continue
+            shadow = e.get("shadow") or {}
+            rows.append(
+                (
+                    _fmt(e.get("tick")),
+                    _fmt(e.get("series")),
+                    _fmt(e.get("outcome")),
+                    _fmt(e.get("trigger") or e.get("reason")),
+                    _fmt(shadow.get("mean_delta")),
+                )
+            )
+        _table(("tick", "series", "outcome", "trigger", "shadow Δ/tick"), rows, out)
+    promos = maint.get("promotions")
+    if isinstance(promos, (int, float)):
+        print(
+            "  verdict: "
+            + ("LOOP CLOSED" if promos > 0 else "NO PROMOTIONS"),
+            file=out,
+        )
+
+
 def render_convergence(metrics: Dict[str, Dict[str, Any]], out) -> None:
     _section("convergence (interim, per fit chunk)", out)
     by_chunk: Dict[str, Dict[str, Any]] = {}
@@ -649,6 +702,7 @@ def render(
     render_serving(metrics, out)
     render_request(man, out)
     render_storm(man, out)
+    render_maint(man, out)
     render_analysis(analysis if analysis is not None else man.get("analysis"), out)
     render_slo(man, out)
 

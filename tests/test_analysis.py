@@ -671,6 +671,36 @@ class TestLayering:
         hits = _fires(rep, "layer-import")
         assert len(hits) == 1 and "back-edge" in hits[0].message
 
+    # paired fixtures for the maint rank (serve < maint < apps): the
+    # maintenance plane may consume serve/batch and below, apps may
+    # orchestrate maint — and neither inversion is silent
+
+    def test_maint_consumes_serve_and_batch_silent(self, tmp_path):
+        src = (
+            "from hhmm_tpu.serve import SnapshotRegistry\n"
+            "from hhmm_tpu.batch import fit_batched\n"
+            "from hhmm_tpu.obs import metrics\n"
+        )
+        rep = _run(tmp_path, {"hhmm_tpu/maint/toy.py": src}, ["layer-import"])
+        assert not _fires(rep, "layer-import"), _ids(rep)
+
+    def test_apps_orchestrates_maint_silent(self, tmp_path):
+        src = "from hhmm_tpu.maint import MaintenanceLoop\n"
+        rep = _run(tmp_path, {"hhmm_tpu/apps/toy.py": src}, ["layer-import"])
+        assert not _fires(rep, "layer-import"), _ids(rep)
+
+    def test_maint_importing_apps_back_edge_fires(self, tmp_path):
+        src = "from hhmm_tpu.apps.tayal import wf\n"
+        rep = _run(tmp_path, {"hhmm_tpu/maint/toy.py": src}, ["layer-import"])
+        hits = _fires(rep, "layer-import")
+        assert len(hits) == 1 and "back-edge" in hits[0].message
+
+    def test_serve_importing_maint_back_edge_fires(self, tmp_path):
+        src = "from hhmm_tpu.maint import promote_snapshot\n"
+        rep = _run(tmp_path, {"hhmm_tpu/serve/toy.py": src}, ["layer-import"])
+        hits = _fires(rep, "layer-import")
+        assert len(hits) == 1 and "back-edge" in hits[0].message
+
 
 # ---------------------------------------------------------------------------
 # rule: pallas-import (kernels/dispatch.py is the only Pallas entry)

@@ -1,0 +1,1235 @@
+"""Maintenance-plane suite (`hhmm_tpu/maint/`, docs/maintenance.md).
+
+Pins the closed train→serve loop's contracts:
+
+- **triggers**: drift alarms / staleness breaches debounce into
+  bounded, per-series-rate-limited refit requests; the CUSUM's
+  post-alarm re-calibration turns a sustained shift into ONE alarm per
+  window (the alarm-storm regression case);
+- **registry promotion**: versioned save + atomic alias repoint —
+  a reader racing a promote loop always sees a complete snapshot,
+  never a miss or a tear (the PR 7 save+tear race, extended to the
+  pointer);
+- **warm starts**: `init_from_snapshot` thins/tiles a snapshot bank
+  into chain inits, and a converged warm start reaches
+  ``rhat_max < 1.05`` in at most HALF the cold-start draws on the
+  Hassan toy model;
+- **shadow gate**: a genuinely better candidate is accepted, a worse
+  one rejected, on held-out one-step posterior-predictive loglik
+  (paired per tick);
+- **promotion mechanics**: `swap_snapshot` resets the staleness
+  clock, keeps tenant bindings across pager evict/re-attach, serves
+  the promoted (alias-resolved) snapshot after a page-in, and stays
+  compile-flat (same bucket/pad shapes as any attach);
+- **the end-to-end gate**: ``bench.py --maint --quick`` (subprocess,
+  slow-marked) injects a mid-stream regime shift and exits 0 only if
+  alarm → warm refit → shadow win → atomic promotion → predictive
+  recovery all engaged with zero post-warmup recompiles.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import jax
+import pytest
+
+from hhmm_tpu.batch import fit_batched, init_from_snapshot
+from hhmm_tpu.infer import GibbsConfig
+from hhmm_tpu.infer.diagnostics import split_rhat_many
+from hhmm_tpu.maint import (
+    MaintenanceLoop,
+    MaintenancePolicy,
+    predictive_logliks,
+    shadow_evaluate,
+    split_window,
+)
+from hhmm_tpu.models import GaussianHMM, MultinomialHMM, NIGPrior
+from hhmm_tpu.obs import metrics as obs_metrics
+from hhmm_tpu.serve import (
+    MicroBatchScheduler,
+    PosteriorSnapshot,
+    ServeMetrics,
+    SnapshotRegistry,
+    model_spec,
+    snapshot_from_fit,
+)
+from hhmm_tpu.serve.online import LoglikCUSUM
+from hhmm_tpu.serve.scheduler import AdmissionPolicy
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fake_snapshot(model, n_draws=6, scale=0.3, seed=0, healthy=True):
+    rng = np.random.default_rng(seed)
+    draws = (rng.normal(size=(n_draws, model.n_free)) * scale).astype(
+        np.float32
+    )
+    return PosteriorSnapshot(
+        spec=model_spec(model), draws=draws, healthy=healthy
+    )
+
+
+def _mhmm_series(rng, T, flip=False):
+    """2-state sticky chain with PEAKED 3-category emissions; ``flip``
+    swaps in a DIFFERENT emission-row set — the synthetic regime
+    shift. (Deliberately NOT a permutation of regime A's rows: a
+    2-state model absorbs any state/category relabeling, so a
+    relabelable "shift" would not be a distribution shift at all.)"""
+    A = np.array([[0.9, 0.1], [0.1, 0.9]])
+    phi = np.array([[0.80, 0.15, 0.05], [0.05, 0.15, 0.80]])
+    if flip:
+        phi = np.array([[0.10, 0.10, 0.80], [0.45, 0.45, 0.10]])
+    z, xs = 0, []
+    for _ in range(T):
+        xs.append(rng.choice(3, p=phi[z]))
+        z = rng.choice(2, p=A[z])
+    return np.asarray(xs, np.int64)
+
+
+def _fit_snapshot(model, x, key, n_draws=6, warmup=20, samples=48):
+    samples_, stats = fit_batched(
+        model,
+        {"x": np.asarray(x)[None]},
+        key,
+        GibbsConfig(num_warmup=warmup, num_samples=samples, num_chains=1),
+        chunk_size=1,
+    )
+    healthy = np.asarray(stats["chain_healthy"]).reshape(1, -1)
+    return snapshot_from_fit(
+        model, np.asarray(samples_[0]), chain_healthy=healthy[0],
+        n_draws=n_draws,
+    )
+
+
+# ---------------------------------------------------------------------------
+# CUSUM: post-alarm reset + per-series label (the alarm-storm satellite)
+
+
+class TestCUSUMAlarmStorm:
+    def test_sustained_shift_fires_once_not_every_tick(self):
+        """The alarm-storm regression: a sustained level shift must
+        fire ONE alarm (then re-baseline on the post-shift
+        distribution), not re-alarm every ~h/z ticks forever — each
+        alarm is a refit trigger, and a storm of them would pile
+        duplicate maintenance work."""
+        rng = np.random.default_rng(0)
+        det = LoglikCUSUM(threshold=8.0, drift=0.5, calibrate=16)
+        for _ in range(64):  # in-control
+            det.update(float(rng.normal()))
+        assert det.alarms == 0
+        for _ in range(400):  # sustained -8 sigma shift
+            det.update(float(-8.0 + rng.normal()))
+        assert det.alarms == 1
+
+    def test_reset_rearms_through_calibration(self):
+        det = LoglikCUSUM(threshold=2.0, calibrate=4)
+        for v in (0.0, 0.1, -0.1, 0.05):
+            det.update(v)
+        det.stat = 1.5
+        det.reset()
+        assert det.stat == 0.0
+        # re-entered calibration: the next `calibrate` ticks never alarm
+        for _ in range(4):
+            stat, alarmed = det.update(-100.0)
+            assert stat == 0.0 and not alarmed
+
+    def test_alarm_counts_survive_reset(self):
+        rng = np.random.default_rng(1)
+        det = LoglikCUSUM(threshold=4.0, calibrate=8)
+        for _ in range(16):
+            det.update(float(rng.normal()))
+        for _ in range(50):
+            det.update(-50.0)
+        n = det.alarms
+        assert n >= 1
+        det.reset()
+        assert det.alarms == n  # cumulative health fact, not state
+
+    def test_recovery_increment_is_not_a_drop(self):
+        """A +inf increment means the PREVIOUS tick was the dead one
+        and the stream just recovered — classifying it as a maximal
+        drop would fire a guaranteed false alarm on the first healthy
+        tick after a transient degraded fold."""
+        rng = np.random.default_rng(0)
+        det = LoglikCUSUM(threshold=4.0, calibrate=8)
+        for _ in range(8):
+            det.update(float(rng.normal()))
+        stat_before = det.stat
+        stat, alarmed = det.update(float("inf"))  # recovery: no drop
+        assert not alarmed and det.alarms == 0
+        assert stat <= stat_before  # decayed (z=0 − drift), not spiked
+        # the mirror cases still count as maximal drops
+        _, a1 = det.update(float("-inf"))
+        det2 = LoglikCUSUM(threshold=4.0, calibrate=2)
+        det2.update(0.0)
+        det2.update(0.1)
+        _, a2 = det2.update(float("nan"))
+        assert det.stat > 0 or a1  # -inf folded as a drop
+        assert det2.stat > 0 or a2  # NaN folded as a drop
+
+    def test_series_label_lands_on_metrics_plane(self):
+        det = LoglikCUSUM(threshold=1.0, drift=0.0, calibrate=2,
+                          series="maint-test-series")
+        obs_metrics.enable()
+        try:
+            det.update(0.0)
+            det.update(0.01)
+            det.update(-500.0)  # armed now: maximal drop -> alarm
+            assert det.alarms == 1
+            keys = list(obs_metrics.snapshot())
+            assert any(
+                k.startswith("serve.drift_alarms{")
+                and "maint-test-series" in k
+                for k in keys
+            ), keys
+        finally:
+            obs_metrics.use_env()
+            obs_metrics.reset()
+
+
+# ---------------------------------------------------------------------------
+# trigger policy: debounce, caps, staleness
+
+
+class TestMaintenancePolicy:
+    def test_min_interval_debounce(self):
+        pol = MaintenancePolicy(min_interval_ticks=100, max_concurrent=4)
+        assert pol.note_alarm("a", tick=10)
+        assert pol.due(10)[0].series_id == "a"
+        pol.finish("a")
+        # within the interval: debounced (clock runs from the START)
+        assert not pol.note_alarm("a", tick=60)
+        assert pol.pending_count == 0
+        assert pol.note_alarm("a", tick=111)
+
+    def test_pending_and_inflight_dedupe(self):
+        pol = MaintenancePolicy(max_concurrent=4)
+        assert pol.note_alarm("a", 1)
+        assert not pol.note_alarm("a", 2)  # already pending
+        (req,) = pol.due(3)
+        assert req.reason == "drift-alarm"
+        assert not pol.note_alarm("a", 4)  # in flight
+        pol.finish("a")
+
+    def test_max_concurrent_caps_the_batch(self):
+        pol = MaintenancePolicy(min_interval_ticks=0, max_concurrent=2)
+        for s in "abcde":
+            assert pol.note_alarm(s, 1)
+        first = pol.due(2)
+        assert [r.series_id for r in first] == ["a", "b"]
+        assert pol.due(2) == []  # both slots taken
+        pol.finish("a")
+        assert [r.series_id for r in pol.due(3)] == ["c"]
+
+    def test_max_pending_bound_drops_and_counts(self):
+        pol = MaintenancePolicy(max_pending=2, max_concurrent=1)
+        assert pol.note_alarm("a", 1) and pol.note_alarm("b", 1)
+        assert not pol.note_alarm("c", 1)
+        assert pol.dropped == 1 and pol.pending_count == 2
+
+    def test_staleness_trigger(self):
+        pol = MaintenancePolicy(max_staleness_s=10.0)
+        assert not pol.note_staleness("a", 5.0, 1)  # unbreached
+        assert not pol.note_staleness("a", float("nan"), 1)  # never NaN
+        assert pol.note_staleness("a", 11.0, 1)
+        assert pol.due(1)[0].reason == "staleness"
+        # disabled bound never triggers
+        off = MaintenancePolicy(max_staleness_s=None)
+        assert not off.note_staleness("a", 1e9, 1)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            MaintenancePolicy(max_concurrent=0)
+        with pytest.raises(ValueError):
+            MaintenancePolicy(min_interval_ticks=-1)
+        with pytest.raises(ValueError):
+            MaintenancePolicy(max_pending=0)
+
+    def test_debounce_clock_lru_bounded(self, monkeypatch):
+        import hhmm_tpu.maint.triggers as triggers
+
+        monkeypatch.setattr(triggers, "LAST_STARTED_CAP", 2)
+        pol = MaintenancePolicy(min_interval_ticks=0, max_concurrent=8)
+        for s in "abc":
+            pol.note_alarm(s, 1)
+        pol.due(1)
+        assert len(pol._last_started) == 2  # coldest clock evicted
+
+
+# ---------------------------------------------------------------------------
+# registry promotion: versioned names, alias atomicity, reader race
+
+
+class TestRegistryPromotion:
+    def test_versioned_names_and_alias_resolution(self, tmp_path):
+        model = MultinomialHMM(K=2, L=3)
+        reg = SnapshotRegistry(str(tmp_path))
+        s1 = _fake_snapshot(model, seed=1)
+        s2 = _fake_snapshot(model, seed=2)
+        assert reg.serving_name("s") is None
+        assert reg.promote("s", s1) == "s.v1"
+        assert reg.serving_name("s") == "s.v1"
+        assert reg.promote("s", s2) == "s.v2"
+        # alias resolves to the newest; old versions stay on disk
+        assert np.array_equal(reg.load_serving("s").draws, s2.draws)
+        assert reg.exists("s.v1") and reg.exists("s.v2")
+        # plain load is untouched by promotion
+        assert reg.load("s") is None
+
+    def test_load_serving_falls_back_to_plain_name(self, tmp_path):
+        model = MultinomialHMM(K=2, L=3)
+        reg = SnapshotRegistry(str(tmp_path))
+        plain = _fake_snapshot(model, seed=3)
+        reg.save("s", plain)
+        # never promoted: the plain artifact serves
+        assert np.array_equal(reg.load_serving("s").draws, plain.draws)
+        # promoted, then the versioned archive is torn: fall back
+        promoted = _fake_snapshot(model, seed=4)
+        v = reg.promote("s", promoted)
+        with open(reg.path(v), "r+b") as f:
+            f.truncate(16)
+        got = reg.load_serving("s")
+        assert got is not None
+        assert np.array_equal(got.draws, plain.draws)
+
+    def test_corrupt_alias_file_is_a_miss_not_an_exception(self, tmp_path):
+        model = MultinomialHMM(K=2, L=3)
+        reg = SnapshotRegistry(str(tmp_path))
+        plain = _fake_snapshot(model, seed=5)
+        reg.save("s", plain)
+        reg.promote("s", _fake_snapshot(model, seed=6))
+        with open(os.path.join(str(tmp_path), "aliases.json"), "w") as f:
+            f.write("{torn")
+        got = reg.load_serving("s")  # quarantined aside, plain serves
+        assert got is not None and np.array_equal(got.draws, plain.draws)
+
+    def test_concurrent_promoters_lose_no_repoint(self, tmp_path):
+        """Two promoters of DIFFERENT series racing the whole-map
+        aliases rewrite must not lose either repoint — a lost one
+        silently reverts that series to its stale plain-name
+        artifact."""
+        model = MultinomialHMM(K=2, L=3)
+        reg = SnapshotRegistry(str(tmp_path))
+        N = 20
+
+        def promoter(name):
+            for i in range(N):
+                reg.promote(name, _fake_snapshot(model, seed=i))
+
+        threads = [
+            threading.Thread(target=promoter, args=(nm,))
+            for nm in ("a", "b")
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert reg.serving_name("a") == f"a.v{N}"
+        assert reg.serving_name("b") == f"b.v{N}"
+
+    def test_concurrent_reader_never_sees_a_miss_or_tear(self, tmp_path):
+        """The PR 7 save+tear race applied to promotion: a reader
+        racing a promote loop always loads a COMPLETE snapshot — old
+        or new — never None, never an exception, never a half-written
+        alias resolution."""
+        model = MultinomialHMM(K=2, L=3)
+        reg = SnapshotRegistry(str(tmp_path))
+        reg.promote("s", _fake_snapshot(model, seed=0))
+        stop = threading.Event()
+        errors: list = []
+
+        def writer():
+            try:
+                for i in range(1, 40):
+                    reg.promote("s", _fake_snapshot(model, seed=i))
+            finally:
+                stop.set()
+
+        def reader():
+            while not stop.is_set():
+                try:
+                    snap = reg.load_serving("s")
+                except Exception as e:  # pragma: no cover
+                    errors.append(repr(e))
+                    return
+                if snap is None:
+                    errors.append("miss during promote race")
+                    return
+                if snap.draws.shape != (6, model.n_free):
+                    errors.append(f"torn draws {snap.draws.shape}")
+                    return
+
+        t_w = threading.Thread(target=writer)
+        t_r = threading.Thread(target=reader)
+        t_r.start()
+        t_w.start()
+        t_w.join()
+        t_r.join()
+        assert not errors, errors
+        assert reg.serving_name("s") == "s.v40"
+
+
+# ---------------------------------------------------------------------------
+# warm starts: init_from_snapshot
+
+
+class TestInitFromSnapshot:
+    def test_thins_evenly_and_tiles(self):
+        bank = np.arange(16, dtype=np.float32).reshape(8, 2)
+        snap = PosteriorSnapshot(spec={}, draws=bank)
+        thin = np.asarray(init_from_snapshot(snap, 4))
+        assert thin.shape == (4, 2)
+        np.testing.assert_array_equal(thin, bank[[0, 2, 4, 7]])
+        tile = np.asarray(init_from_snapshot(snap, 11))
+        assert tile.shape == (11, 2)
+        np.testing.assert_array_equal(tile[8], bank[0])
+        # raw arrays are accepted (the layering-friendly duck type)
+        raw = np.asarray(init_from_snapshot(bank, 2))
+        np.testing.assert_array_equal(raw, bank[[0, 7]])
+
+    def test_quantized_bank_dequantizes(self):
+        model = MultinomialHMM(K=2, L=3)
+        rng = np.random.default_rng(0)
+        samples = rng.normal(size=(1, 32, model.n_free)).astype(np.float32)
+        snap = snapshot_from_fit(model, samples, n_draws=8, dtype="bfloat16")
+        init = np.asarray(init_from_snapshot(snap, 4))
+        assert init.dtype == np.float32 and init.shape == (4, model.n_free)
+        assert np.isfinite(init).all()
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            init_from_snapshot(np.zeros((0, 3), np.float32), 2)
+        with pytest.raises(ValueError):
+            init_from_snapshot(np.zeros((4,), np.float32), 2)
+        with pytest.raises(ValueError):
+            init_from_snapshot(np.zeros((4, 3), np.float32), 0)
+
+    @pytest.mark.slow  # 3 sampler fits (~17 s); the shape/dtype
+    # contracts above stay tier-1, the statistical property runs in
+    # the full suite (tier-1 duration-ledger discipline)
+    def test_warm_start_halves_convergence_draws_hassan_toy(self):
+        """The satellite's measured claim: on the Hassan toy model
+        (GaussianHMM) a warm start from a converged snapshot reaches
+        ``rhat_max < 1.05`` within HALF the draw budget, while a
+        dispersed cold start is still far from converged at the FULL
+        budget."""
+        rng = np.random.default_rng(0)
+        T = 128
+        z = (rng.random(T) < 0.5).astype(int)
+        for t in range(1, T):
+            z[t] = z[t - 1] if rng.random() < 0.85 else 1 - z[t - 1]
+        x = np.where(z == 1, 3.0, -3.0) + rng.normal(size=T) * 0.5
+        model = GaussianHMM(
+            K=2, nig_prior=NIGPrior(m0=0.0, kappa0=0.2, a0=2.5, b0=1.5)
+        )
+        data = {"x": x[None].astype(np.float32)}
+        C, S = 4, 64
+        cfg = GibbsConfig(num_warmup=1, num_samples=S, num_chains=C)
+
+        def rhat_at(samples, k):
+            arr = np.asarray(samples)[0][:, :k, :]
+            return float(np.max(split_rhat_many(np.moveaxis(arr, -1, 0))))
+
+        cold_init = (rng.normal(size=(1, C, model.n_free)) * 3.0).astype(
+            np.float32
+        )
+        qs_cold, _ = fit_batched(
+            model, data, jax.random.PRNGKey(1), cfg, init=cold_init,
+            chunk_size=1,
+        )
+        long_cfg = GibbsConfig(num_warmup=50, num_samples=100, num_chains=2)
+        qs_l, st_l = fit_batched(
+            model, data, jax.random.PRNGKey(2), long_cfg, chunk_size=1
+        )
+        snap = snapshot_from_fit(
+            model,
+            np.asarray(qs_l[0]),
+            chain_healthy=np.asarray(st_l["chain_healthy"]).reshape(1, -1)[0],
+            n_draws=16,
+        )
+        warm_init = np.asarray(init_from_snapshot(snap, C))[None]
+        qs_warm, _ = fit_batched(
+            model, data, jax.random.PRNGKey(1), cfg, init=warm_init,
+            chunk_size=1,
+        )
+        assert rhat_at(qs_warm, S // 2) < 1.05  # half budget converged
+        assert rhat_at(qs_cold, S) > 1.05  # full budget still is not
+
+
+# ---------------------------------------------------------------------------
+# shadow gate
+
+
+class TestShadowGate:
+    @pytest.fixture(scope="class")
+    def regime_fits(self):
+        """Snapshots fitted on regime A and regime B (the synthetic
+        regime-shift fixture), plus a held-out regime-B tail."""
+        model = MultinomialHMM(K=2, L=3)
+        rng = np.random.default_rng(0)
+        x_a = _mhmm_series(rng, 112, flip=False)
+        x_b = _mhmm_series(rng, 144, flip=True)
+        snap_a = _fit_snapshot(
+            model, x_a, jax.random.PRNGKey(1), warmup=10, samples=28
+        )
+        snap_b = _fit_snapshot(
+            model, x_b[:112], jax.random.PRNGKey(2), warmup=10, samples=28
+        )
+        eval_b = {"x": x_b[112:]}  # held out from BOTH fits
+        return model, snap_a, snap_b, eval_b
+
+    def test_better_candidate_accepted_worse_rejected(self, regime_fits):
+        model, snap_a, snap_b, eval_b = regime_fits
+        win = shadow_evaluate(
+            model, snap_a, snap_b, eval_b, series_id="s"
+        )
+        assert win.accepted and win.mean_delta > 0
+        lose = shadow_evaluate(model, snap_b, snap_a, eval_b)
+        assert not lose.accepted and lose.mean_delta < 0
+        # paired per-tick: the two directions are exact mirrors
+        np.testing.assert_allclose(
+            win.mean_delta, -lose.mean_delta, rtol=1e-6
+        )
+        json.dumps(win.stanza())  # manifest-ready
+
+    @pytest.mark.slow  # gate refinements of the accepted/rejected
+    # contract above (each shadow_evaluate pays two fresh jits on this
+    # single-core host); the core accept/reject pair stays tier-1
+    def test_margin_blocks_marginal_wins(self, regime_fits):
+        model, snap_a, snap_b, eval_b = regime_fits
+        win = shadow_evaluate(model, snap_a, snap_b, eval_b)
+        barred = shadow_evaluate(
+            model, snap_a, snap_b, eval_b, margin=win.mean_delta + 1.0
+        )
+        assert not barred.accepted
+
+    @pytest.mark.slow  # see test_margin_blocks_marginal_wins
+    def test_tie_loses(self, regime_fits):
+        model, snap_a, _, eval_b = regime_fits
+        tie = shadow_evaluate(model, snap_a, snap_a, eval_b)
+        assert tie.mean_delta == 0.0 and not tie.accepted
+
+    @pytest.mark.slow  # three evaluations x two jits (~4.5 s); the
+    # -inf mechanics stay tier-1 in the predictive_logliks test below
+    def test_dead_candidate_never_wins_dead_champion_always_loses(self):
+        """NaN parameters poison a GAUSSIAN bank's evidence (discrete
+        models floor bad simplex params through safe_log — same
+        realistic-trigger choice as the serve suite): such a bank must
+        read as -inf per tick and lose to anything finite."""
+        model = GaussianHMM(K=2)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=16).astype(np.float32)
+        ok_draws = np.stack(
+            [
+                np.asarray(
+                    model.init_unconstrained(jax.random.PRNGKey(i), {"x": x})
+                )
+                for i in range(4)
+            ]
+        )
+        alive = PosteriorSnapshot(spec=model_spec(model), draws=ok_draws)
+        dead = PosteriorSnapshot(
+            spec=model_spec(model),
+            draws=np.full((4, model.n_free), np.nan, np.float32),
+        )
+        ev = {"x": x}
+        v = shadow_evaluate(model, alive, dead, ev)
+        assert not v.accepted and v.mean_delta == float("-inf")
+        v2 = shadow_evaluate(model, dead, alive, ev)
+        assert v2.accepted and v2.mean_delta == float("inf")
+        # an unhealthy (quarantined) candidate never wins either
+        sick = PosteriorSnapshot(
+            spec=model_spec(model), draws=ok_draws, healthy=False
+        )
+        assert not shadow_evaluate(model, alive, sick, ev).accepted
+
+    def test_predictive_logliks_dead_bank_is_neg_inf(self):
+        model = GaussianHMM(K=2)
+        dead = np.full((4, model.n_free), np.nan, np.float32)
+        lls = predictive_logliks(
+            model, dead, {"x": np.zeros(8, np.float32)}
+        )
+        assert np.all(np.isneginf(lls))
+
+    def test_split_window(self):
+        tail = {"x": np.arange(10)}
+        fit, ev = split_window(tail, 3)
+        np.testing.assert_array_equal(fit["x"], np.arange(7))
+        np.testing.assert_array_equal(ev["x"], np.arange(7, 10))
+        with pytest.raises(ValueError):
+            split_window(tail, -1)
+
+    def test_eval_data_validation(self, regime_fits):
+        model, snap_a, snap_b, _ = regime_fits
+        with pytest.raises(ValueError):
+            shadow_evaluate(model, snap_a, snap_b, {"x": np.zeros((0,))})
+
+
+# ---------------------------------------------------------------------------
+# scheduler maintenance surface: history tail, staleness, swap
+
+
+class TestSchedulerMaintSurface:
+    def test_history_tail_bounded_and_ordered(self):
+        model = MultinomialHMM(K=2, L=3)
+        snap = _fake_snapshot(model, n_draws=3)
+        sched = MicroBatchScheduler(model, buckets=(4,), history_tail=4)
+        sched.attach("s", snap)
+        assert sched.history_tail_of("s") is None  # empty ring
+        for t in range(7):
+            sched.tick({"s": {"x": t % 3}})
+        tail = sched.history_tail_of("s")
+        np.testing.assert_array_equal(
+            tail["x"], np.asarray([t % 3 for t in range(3, 7)])
+        )
+        # disabled ring reports None (no tick needed — and none taken:
+        # a compile here would be pure tier-1 budget waste)
+        off = MicroBatchScheduler(model, buckets=(4,))
+        off.attach("s", snap)
+        assert off.history_tail_of("s") is None
+
+    def test_shed_ticks_never_enter_the_tail(self):
+        model = MultinomialHMM(K=2, L=3)
+        snap = _fake_snapshot(model, n_draws=3)
+        sched = MicroBatchScheduler(
+            model,
+            buckets=(4,),
+            history_tail=8,
+            admission=AdmissionPolicy(max_queue_depth=1),
+        )
+        sched.attach("s", snap)
+        sched.submit("s", {"x": 0})
+        sched.submit("s", {"x": 1})  # depth 1: sheds the OLDEST (x=0)
+        sched.flush()
+        tail = sched.history_tail_of("s")
+        np.testing.assert_array_equal(tail["x"], np.asarray([1]))
+
+    def test_detach_releases_the_tail(self):
+        model = MultinomialHMM(K=2, L=3)
+        snap = _fake_snapshot(model, n_draws=3)
+        sched = MicroBatchScheduler(model, buckets=(4,), history_tail=4)
+        sched.attach("s", snap)
+        sched.tick({"s": {"x": 1}})
+        assert sched.history_tail_of("s") is not None
+        assert sched.detach("s")
+        assert sched.history_tail_of("s") is None
+
+    def test_swap_resets_staleness_and_serves_promoted_draws(self, tmp_path):
+        model = MultinomialHMM(K=2, L=3)
+        reg = SnapshotRegistry(str(tmp_path))
+        old = _fake_snapshot(model, seed=1)
+        new = _fake_snapshot(model, seed=2)
+        reg.promote("s", old)
+        sched = MicroBatchScheduler(
+            model, buckets=(4,), registry=reg, history_tail=8
+        )
+        sched.attach("s", reg.load_serving("s"))
+        for t in range(3):
+            sched.tick({"s": {"x": t % 3}})
+        s_before = sched.staleness_of("s")
+        assert s_before > 0
+        reg.promote("s", new)
+        assert sched.swap_snapshot("s") is None
+        assert sched.staleness_of("s") < s_before  # clock reset
+        np.testing.assert_array_equal(
+            np.asarray(sched._series["s"]["draws"]), new.draws
+        )
+        # the swap replayed the tail: the filter is warm, not cold
+        r = sched.tick({"s": {"x": 1}})["s"]
+        assert not r.shed and np.isfinite(r.probs).all()
+
+    def test_swap_reports_kept_unhealthy_candidate(self, tmp_path):
+        """attach_many's quarantine KEEP path (unhealthy candidate
+        over a healthy serving state) must surface as a swap FAILURE —
+        a silent None would let a caller count a promotion and reset
+        drift baselines while the old draws keep serving."""
+        model = MultinomialHMM(K=2, L=3)
+        good = _fake_snapshot(model, seed=1)
+        bad = _fake_snapshot(model, seed=2, healthy=False)
+        sched = MicroBatchScheduler(model, buckets=(4,), history_tail=4)
+        sched.attach("s", good)
+        sched.tick({"s": {"x": 1}})
+        reason = sched.swap_snapshot("s", snapshot=bad)
+        assert reason is not None and "did not commit" in reason
+        np.testing.assert_array_equal(  # old posterior still serving
+            np.asarray(sched._series["s"]["draws"]), good.draws
+        )
+
+    def test_swap_degrades_not_raises(self, tmp_path):
+        model = MultinomialHMM(K=2, L=3)
+        sched = MicroBatchScheduler(model, buckets=(4,), history_tail=4)
+        assert "no registry" in sched.swap_snapshot("s")
+        reg = SnapshotRegistry(str(tmp_path))
+        sched2 = MicroBatchScheduler(
+            model, buckets=(4,), registry=reg, history_tail=4
+        )
+        assert "no servable snapshot" in sched2.swap_snapshot("ghost")
+
+    def test_swap_is_compile_flat(self, tmp_path):
+        """The promotion swap replays in the SAME bucket/T_pad/dtype
+        signature as any attach — a warmed scheduler swaps with zero
+        new XLA compiles (the bench.py --maint gate, unit-sized)."""
+        model = MultinomialHMM(K=2, L=3)
+        reg = SnapshotRegistry(str(tmp_path))
+        rng = np.random.default_rng(0)
+        metrics = ServeMetrics()
+        sched = MicroBatchScheduler(
+            model, buckets=(4,), registry=reg, metrics=metrics,
+            history_tail=8,
+        )
+        hist = np.asarray(rng.integers(0, 3, size=8))
+        items = []
+        for i in range(4):
+            nm = f"s{i}"
+            reg.promote(nm, _fake_snapshot(model, seed=i))
+            items.append((nm, reg.load_serving(nm), {"x": hist}))
+        assert sched.attach_many(items) == []
+        for t in range(2):  # update kernel compiles
+            sched.tick({f"s{i}": {"x": int(t % 3)} for i in range(4)})
+        warm = metrics.compile_count
+        assert warm > 0
+        for i in range(4):  # promote + swap the whole fleet, twice
+            reg.promote(f"s{i}", _fake_snapshot(model, seed=10 + i))
+            assert sched.swap_snapshot(f"s{i}") is None
+        sched.tick({f"s{i}": {"x": 2} for i in range(4)})
+        for i in range(2):
+            reg.promote(f"s{i}", _fake_snapshot(model, seed=20 + i))
+            assert sched.swap_snapshot(f"s{i}") is None
+        sched.tick({f"s{i}": {"x": 0} for i in range(4)})
+        assert metrics.compile_count == warm  # flat across every swap
+
+    def test_quarantine_fallback_resolves_serving_alias(self, tmp_path):
+        """The scheduler's last-healthy-snapshot fallback (an unhealthy
+        fit arriving at attach) must resolve the SERVING alias — the
+        plain-name artifact is the stale pre-promotion posterior, and
+        falling back to it would silently undo a refit."""
+        model = MultinomialHMM(K=2, L=3)
+        reg = SnapshotRegistry(str(tmp_path))
+        stale = _fake_snapshot(model, seed=1)
+        promoted = _fake_snapshot(model, seed=2)
+        reg.save("s", stale)  # the pre-promotion plain-name artifact
+        reg.promote("s", promoted)
+        bad = _fake_snapshot(model, seed=3, healthy=False)
+        sched = MicroBatchScheduler(model, buckets=(4,), registry=reg)
+        sched.attach("s", bad)  # fresh scheduler: registry fallback
+        r = sched.tick({"s": {"x": 1}})["s"]
+        assert not r.degraded  # served from a healthy fallback...
+        np.testing.assert_array_equal(  # ...the PROMOTED one
+            np.asarray(sched._series["s"]["draws"]), promoted.draws
+        )
+
+    def test_tenant_binding_survives_promotion_evict_and_page_in(
+        self, tmp_path
+    ):
+        """Promotion must preserve the request-plane quota key, and a
+        promoted series that pages out must come back (a) under its
+        tenant and (b) on the PROMOTED snapshot — eviction must not
+        silently undo a refit or launder a tenant's quota."""
+        from hhmm_tpu.serve import SnapshotPager
+
+        model = MultinomialHMM(K=2, L=3)
+        reg = SnapshotRegistry(str(tmp_path))
+        old = _fake_snapshot(model, seed=1)
+        new = _fake_snapshot(model, seed=2)
+        reg.promote("s", old)
+        pager = SnapshotPager(reg, budget_bytes=1 << 20)
+        sched = MicroBatchScheduler(
+            model, buckets=(4,), registry=reg, pager=pager, history_tail=8
+        )
+        sched.attach("s", reg.load_serving("s"), tenant="tenantA")
+        sched.tick({"s": {"x": 1}})
+        reg.promote("s", new)
+        assert sched.swap_snapshot("s") is None
+        assert sched._tenant_of.get("s") == "tenantA"  # binding kept
+        # evict -> transparent page-in on the next submit
+        assert pager.evict("s")
+        assert "s" not in sched._series
+        r = sched.tick({"s": {"x": 2}})["s"]
+        assert not r.shed
+        assert sched._tenant_of.get("s") == "tenantA"
+        np.testing.assert_array_equal(
+            np.asarray(sched._series["s"]["draws"]), new.draws
+        )
+
+
+# ---------------------------------------------------------------------------
+# the loop driver (staleness-triggered, deterministic)
+
+
+class TestMaintenanceLoop:
+    def test_constructor_needs_history_tail(self, tmp_path):
+        model = MultinomialHMM(K=2, L=3)
+        reg = SnapshotRegistry(str(tmp_path))
+        sched = MicroBatchScheduler(model, buckets=(4,), registry=reg)
+        with pytest.raises(ValueError):
+            MaintenanceLoop(
+                sched, reg, model,
+                GibbsConfig(num_warmup=5, num_samples=8, num_chains=1),
+                jax.random.PRNGKey(0),
+            )
+
+    def test_staleness_triggered_refit_promotes_over_junk_champion(
+        self, tmp_path
+    ):
+        """End-to-end through the driver, deterministically: a random
+        (junk) champion serves peaked multinomial data; the staleness
+        trigger forces a refit; the candidate — fitted on the actual
+        stream — must win shadow and be promoted, with counters,
+        events, and the manifest stanza all moving."""
+        from hhmm_tpu.obs import manifest as obs_manifest
+
+        model = MultinomialHMM(K=2, L=3)
+        reg = SnapshotRegistry(str(tmp_path))
+        rng = np.random.default_rng(0)
+        x = _mhmm_series(rng, 48)
+        champion = _fake_snapshot(model, n_draws=6, scale=1.2, seed=9)
+        reg.save("s", champion)
+        sched = MicroBatchScheduler(
+            model, buckets=(4,), registry=reg, history_tail=24
+        )
+        sched.attach("s", reg.load_serving("s"))
+        loop = MaintenanceLoop(
+            sched,
+            reg,
+            model,
+            GibbsConfig(num_warmup=8, num_samples=16, num_chains=1),
+            jax.random.PRNGKey(3),
+            policy=MaintenancePolicy(
+                min_interval_ticks=10_000,  # exactly one refit per series
+                max_concurrent=2,
+                max_staleness_s=0.0,  # any age triggers
+            ),
+            eval_ticks=8,
+            min_fit_ticks=16,
+            staleness_sweep_every=1,
+        )
+        summaries = []
+        for t in range(26):
+            sched.submit("s", {"x": int(x[t])})
+            loop.observe(sched.flush())
+            s = loop.maybe_maintain()
+            if s is not None:
+                summaries.append(s)
+        # early triggers skip (tail still filling — and a skip must
+        # not burn the debounce budget); the first full-tail
+        # opportunity refits and promotes, exactly once
+        assert loop.metrics.skipped_refits >= 1
+        assert loop.metrics.refits == 1
+        assert loop.metrics.promotions == 1
+        assert any(s["promoted"] == ["s"] for s in summaries)
+        assert loop.promoted_series() == ["s"]  # the unbounded ledger
+        st = loop.stanza()
+        assert st["promotions"] == 1 and st["events"]
+        assert any(e["outcome"] == "promoted" for e in st["events"])
+        json.dumps(st)  # manifest-ready
+        assert obs_manifest.noted_stanza("maint") == st
+        # the registry serves the promoted candidate now
+        assert reg.serving_name("s") == "s.v1"
+        meta = reg.load_serving("s").meta
+        assert meta["maint"]["reason"] == "staleness"
+
+    def test_exception_in_refit_releases_inflight_slots(self, tmp_path):
+        """A refit that dies (retry ladder exhausted, disk full) must
+        hand back the drained requests' concurrency slots — a leaked
+        slot shrinks the maintenance budget forever, and after
+        max_concurrent leaks the plane goes permanently dark."""
+        import hhmm_tpu.maint.loop as maint_loop
+
+        model = MultinomialHMM(K=2, L=3)
+        reg = SnapshotRegistry(str(tmp_path))
+        reg.save("s", _fake_snapshot(model))
+        sched = MicroBatchScheduler(
+            model, buckets=(4,), registry=reg, history_tail=8
+        )
+        sched.attach("s", reg.load_serving("s"))
+        pol = MaintenancePolicy(max_concurrent=2)
+        loop = MaintenanceLoop(
+            sched, reg, model,
+            GibbsConfig(num_warmup=5, num_samples=8, num_chains=1),
+            jax.random.PRNGKey(0),
+            policy=pol,
+        )
+        pol.note_alarm("s", 1)
+        orig = maint_loop.warm_refit
+
+        def boom(*a, **kw):
+            raise RuntimeError("refit died")
+
+        maint_loop.warm_refit = boom
+        try:
+            with pytest.raises(RuntimeError):
+                loop.maybe_maintain()
+        finally:
+            maint_loop.warm_refit = orig
+        assert pol.inflight_count == 0  # slots came back
+
+    def test_cross_attach_generation_increment_dropped(self):
+        """A response-loglik increment spanning an attach-generation
+        change (swap, evict→page-in) is a filter-evidence restart and
+        must NOT reach the drift detector."""
+        from types import SimpleNamespace
+
+        from hhmm_tpu.serve.scheduler import TickResponse
+
+        class RecDet:
+            def __init__(self):
+                self.increments = []
+
+            def update(self, inc):
+                self.increments.append(inc)
+                return 0.0, False
+
+            def reset(self):
+                pass
+
+        gen = {"v": 1}
+        sched = SimpleNamespace(
+            history_tail=8,
+            attach_generation=lambda sid: gen["v"],
+            series_ids=lambda: [],
+            staleness_of=lambda sid: 0.0,
+        )
+        det = RecDet()
+        model = MultinomialHMM(K=2, L=3)
+        loop = MaintenanceLoop(
+            sched, None, model,
+            GibbsConfig(num_warmup=5, num_samples=8, num_chains=1),
+            jax.random.PRNGKey(0),
+            detector_factory=lambda sid: det,
+        )
+
+        def resp(ll):
+            return TickResponse(
+                series_id="s", probs=np.ones(2) / 2, loglik=ll,
+                healthy_draws=2, degraded=False, latency_s=0.0,
+            )
+
+        loop.observe([resp(-100.0)])
+        loop.observe([resp(-101.0)])  # in-gen: increment -1 folds
+        gen["v"] = 2  # swap / page-in: evidence restarted
+        loop.observe([resp(-3.0)])  # spanning "+98" must be DROPPED
+        loop.observe([resp(-4.5)])  # in-gen again: -1.5 folds
+        assert det.increments == [-1.0, -1.5]
+
+    def test_stream_state_lru_bounded(self, monkeypatch):
+        """The loop's per-series detector table must not grow without
+        bound under churning ephemeral series ids (the scheduler's
+        TENANT_BINDINGS_CAP discipline)."""
+        from types import SimpleNamespace
+
+        import hhmm_tpu.maint.loop as maint_loop
+        from hhmm_tpu.serve.scheduler import TickResponse
+
+        monkeypatch.setattr(maint_loop, "SERIES_STATE_CAP", 2)
+        sched = SimpleNamespace(
+            history_tail=8,
+            attach_generation=lambda sid: 1,
+            series_ids=lambda: [],
+            staleness_of=lambda sid: 0.0,
+        )
+        model = MultinomialHMM(K=2, L=3)
+        loop = MaintenanceLoop(
+            sched, None, model,
+            GibbsConfig(num_warmup=5, num_samples=8, num_chains=1),
+            jax.random.PRNGKey(0),
+        )
+        for sid in ("a", "b", "c"):
+            loop.observe([
+                TickResponse(
+                    series_id=sid, probs=np.ones(2) / 2, loglik=-1.0,
+                    healthy_draws=2, degraded=False, latency_s=0.0,
+                )
+            ])
+        assert len(loop._streams) == 2
+        assert "a" not in loop._streams  # coldest stream evicted
+
+    def test_swap_accepts_in_memory_snapshot(self):
+        """The promotion path swaps the candidate it just wrote
+        without a registry round-trip (snapshot=); a registry is not
+        even required on that path."""
+        model = MultinomialHMM(K=2, L=3)
+        snap = _fake_snapshot(model, seed=1)
+        new = _fake_snapshot(model, seed=2)
+        sched = MicroBatchScheduler(model, buckets=(4,), history_tail=4)
+        sched.attach("s", snap)
+        sched.tick({"s": {"x": 1}})
+        assert sched.swap_snapshot("s", snapshot=new) is None
+        np.testing.assert_array_equal(
+            np.asarray(sched._series["s"]["draws"]), new.draws
+        )
+
+    def test_dropped_alarm_stays_owed_until_enqueued(self):
+        """An alarm the policy cannot take (queue full) consumed the
+        detector — it re-baselined on the post-shift data and will not
+        re-alarm for the same shift — so the trigger must stay OWED
+        and land once the queue drains, or the series serves stale
+        forever."""
+        from types import SimpleNamespace
+
+        from hhmm_tpu.serve.scheduler import TickResponse
+
+        class OneShotDet:
+            def __init__(self):
+                self.fired = False
+
+            def update(self, inc):
+                if not self.fired:  # alarms ONCE, then re-baselined
+                    self.fired = True
+                    return 0.0, True
+                return 0.0, False
+
+            def reset(self):
+                pass
+
+        sched = SimpleNamespace(
+            history_tail=8,
+            attach_generation=lambda sid: 1,
+            series_ids=lambda: [],
+            staleness_of=lambda sid: 0.0,
+        )
+        model = MultinomialHMM(K=2, L=3)
+        pol = MaintenancePolicy(
+            min_interval_ticks=0, max_concurrent=8, max_pending=1
+        )
+        loop = MaintenanceLoop(
+            sched, None, model,
+            GibbsConfig(num_warmup=5, num_samples=8, num_chains=1),
+            jax.random.PRNGKey(0),
+            policy=pol,
+            detector_factory=lambda sid: OneShotDet(),
+        )
+
+        def resp(sid):
+            return TickResponse(
+                series_id=sid, probs=np.ones(2) / 2, loglik=-1.0,
+                healthy_draws=2, degraded=False, latency_s=0.0,
+            )
+
+        both = [resp("a"), resp("b")]
+        loop.observe(both)  # first increments need two observes
+        n = loop.observe(both)  # both alarm; queue cap 1: one drops
+        assert n == 1 and pol.dropped == 1
+        pol.due(2)  # drain the queue
+        # the dropped series' detector will never alarm again — the
+        # OWED retry must land it now that there is room
+        n2 = loop.observe(both)
+        assert n2 == 1
+        assert pol.pending_count + pol.inflight_count >= 1
+
+    def test_dead_feed_skip_charges_debounce(self, tmp_path):
+        """A skipped refit for a series with NO recent traffic (feed
+        stopped — its tail can never fill) must keep the full debounce:
+        retrying every staleness sweep would crowd genuine alarms out
+        of the bounded pending queue. (An ACTIVE series' skip still
+        releases the clock — the tail is filling; the loop e2e test
+        pins that side.)"""
+        from types import SimpleNamespace
+
+        reg = SnapshotRegistry(str(tmp_path))
+        sched = SimpleNamespace(
+            history_tail=8,
+            attach_generation=lambda sid: 1,
+            series_ids=lambda: ["quiet"],
+            staleness_of=lambda sid: 100.0,
+            history_tail_of=lambda sid: None,
+        )
+        pol = MaintenancePolicy(
+            min_interval_ticks=500, max_staleness_s=10.0
+        )
+        model = MultinomialHMM(K=2, L=3)
+        loop = MaintenanceLoop(
+            sched, reg, model,
+            GibbsConfig(num_warmup=5, num_samples=8, num_chains=1),
+            jax.random.PRNGKey(0),
+            policy=pol,
+            staleness_sweep_every=1,
+        )
+        assert loop.observe([]) == 1  # staleness trigger
+        summary = loop.maybe_maintain()
+        assert summary is not None and summary["skipped"] == ["quiet"]
+        # debounce charged: the next sweeps do NOT re-enqueue
+        for _ in range(5):
+            assert loop.observe([]) == 0
+        assert loop.metrics.skipped_refits == 1
+
+    def test_staleness_sweep_reaches_no_traffic_series(self):
+        """A series receiving no traffic (feed stopped, ticks shed)
+        must still trigger its staleness refit: the sweep walks every
+        ATTACHED series, it does not piggyback on responses."""
+        from types import SimpleNamespace
+
+        sched = SimpleNamespace(
+            history_tail=8,
+            attach_generation=lambda sid: 1,
+            series_ids=lambda: ["quiet"],
+            staleness_of=lambda sid: 100.0,
+        )
+        pol = MaintenancePolicy(max_staleness_s=10.0)
+        model = MultinomialHMM(K=2, L=3)
+        loop = MaintenanceLoop(
+            sched, None, model,
+            GibbsConfig(num_warmup=5, num_samples=8, num_chains=1),
+            jax.random.PRNGKey(0),
+            policy=pol,
+            staleness_sweep_every=1,
+        )
+        assert loop.observe([]) == 1  # no responses, still triggered
+        assert pol.due(1)[0].series_id == "quiet"
+
+    def test_too_short_tail_skips_not_raises(self, tmp_path):
+        model = MultinomialHMM(K=2, L=3)
+        reg = SnapshotRegistry(str(tmp_path))
+        reg.save("s", _fake_snapshot(model))
+        sched = MicroBatchScheduler(
+            model, buckets=(4,), registry=reg, history_tail=24
+        )
+        sched.attach("s", reg.load_serving("s"))
+        loop = MaintenanceLoop(
+            sched, reg, model,
+            GibbsConfig(num_warmup=5, num_samples=8, num_chains=1),
+            jax.random.PRNGKey(0),
+            policy=MaintenancePolicy(max_staleness_s=0.0),
+            eval_ticks=8, min_fit_ticks=16, staleness_sweep_every=1,
+        )
+        sched.submit("s", {"x": 1})
+        loop.observe(sched.flush())
+        summary = loop.maybe_maintain()
+        assert summary is not None and summary["skipped"] == ["s"]
+        assert loop.metrics.skipped_refits == 1
+        assert loop.metrics.refits == 0
+
+
+# ---------------------------------------------------------------------------
+# bench_diff: the maintenance gate
+
+
+def _write_maint_rounds(d, promotions):
+    for n, promos in enumerate(promotions, start=1):
+        rec = {
+            "metric": "fixture_maint_throughput",
+            "value": 100.0,
+            "unit": "ticks/sec",
+            "backend": "cpu",
+            "manifest": {
+                "workload_digest": "wmaint",
+                "device_kind": "cpu",
+                "versions": {"jax": "0.0-test"},
+                "trace_enabled": False,
+            },
+        }
+        if promos is not None:
+            rec["manifest"]["maint"] = {
+                "triggers": 4, "refits": 3, "promotions": promos,
+                "shadow_rejections": 1, "refit_seconds": 2.5,
+            }
+        (d / f"BENCH_r{n:02d}.json").write_text(
+            json.dumps({"n": n, "rc": 0, "parsed": rec})
+        )
+
+
+class TestBenchDiffMaintGate:
+    def _run(self, d):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "bench_diff.py"),
+             "--dir", str(d)],
+            capture_output=True,
+            text=True,
+        )
+
+    def test_promoting_baseline_then_zero_fails(self, tmp_path):
+        _write_maint_rounds(tmp_path, [3, 0])
+        proc = self._run(tmp_path)
+        assert proc.returncode == 1, proc.stdout
+        assert "MAINTENANCE REGRESSION" in proc.stdout
+
+    def test_promotions_sustained_passes(self, tmp_path):
+        _write_maint_rounds(tmp_path, [3, 2])
+        proc = self._run(tmp_path)
+        assert proc.returncode == 0, proc.stdout
+        assert "maint promotions 2" in proc.stdout
+
+    def test_zero_with_no_promoting_baseline_reports_not_gates(
+        self, tmp_path
+    ):
+        _write_maint_rounds(tmp_path, [0, 0])
+        proc = self._run(tmp_path)
+        assert proc.returncode == 0, proc.stdout
+        assert "no promotions (no promoting baseline)" in proc.stdout
+
+    def test_recovery_after_regression_rebaselines(self, tmp_path):
+        # 3 -> 0 fails once; 0 -> 2 -> 0 then fails again (2 was a
+        # promoting baseline)
+        _write_maint_rounds(tmp_path, [3, 0, 2, 0])
+        proc = self._run(tmp_path)
+        assert proc.returncode == 1
+        assert proc.stdout.count("MAINTENANCE REGRESSION") == 2
+
+
+# ---------------------------------------------------------------------------
+# obs_report: the maintenance section
+
+
+class TestObsReportMaint:
+    def test_fixture_renders_maintenance_section(self):
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO, "scripts", "obs_report.py"),
+                os.path.join(REPO, "tests", "fixtures",
+                             "obs_report_manifest.json"),
+            ],
+            capture_output=True,
+            text=True,
+        )
+        assert proc.returncode == 0, proc.stderr
+        out = proc.stdout
+        assert "== maintenance ==" in out
+        assert "promotions: 2" in out
+        assert "shadow-rejected" in out and "promoted" in out
+        assert "verdict: LOOP CLOSED" in out
+
+    def test_no_stanza_no_section(self, tmp_path):
+        man = {"version": 1, "hostname": "x"}
+        p = tmp_path / "man.json"
+        p.write_text(json.dumps(man))
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "obs_report.py"), str(p)],
+            capture_output=True,
+            text=True,
+        )
+        assert proc.returncode == 0
+        assert "== maintenance ==" not in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end closed-loop gate (subprocess, slow)
+
+
+@pytest.mark.slow
+class TestMaintBenchQuick:
+    def test_maint_quick_closes_the_loop(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--maint", "--quick", "--cpu"],
+            capture_output=True,
+            text=True,
+            env=env,
+            timeout=560,
+            cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        rec = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert rec["metric"] == "tayal_maint_tick_throughput"
+        maint = rec["manifest"]["maint"]
+        assert maint["promotions"] >= 1
+        assert maint["refits"] >= 1
+        assert maint["triggers"] >= 1
+        assert rec["compiles_after_warmup"] == 0
+        assert rec["predictive_recovery"]["mean_delta"] > 0
+        assert "CLOSED-LOOP OK" in proc.stderr

@@ -38,8 +38,17 @@ def _jittered_params(model, rng):
 
 
 class TestRouteIdentity:
+    # jangmin2004 is the biggest tree and the one multi-second variant
+    # on the single-core tier-1 host (.tier1_durations.json: 7.9 s vs
+    # 1.6 s each for the other trees) — slow-marked; the identity
+    # contract stays tier-1 on hier2x2 and fine1998
     @pytest.mark.parametrize(
-        "mk", [hier2x2_tree, fine1998_tree, jangmin2004_tree]
+        "mk",
+        [
+            hier2x2_tree,
+            fine1998_tree,
+            pytest.param(jangmin2004_tree, marks=pytest.mark.slow),
+        ],
     )
     def test_routes_sum_to_flat(self, mk):
         model = TreeHMM(mk(), order_mu="none")
