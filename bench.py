@@ -501,15 +501,25 @@ def serve_storm(args, backend, degraded) -> None:
     along for the resilience gate.
 
     Fairness arms (`hhmm_tpu/obs/request.py`): the storm's series split
-    into two tenants (``hot``/``quiet``). A short BALANCED probe (even
-    traffic, no faults) measures the baseline per-tenant p99 spread;
-    the storm window itself runs SKEWED — every hot-tenant series
-    submits multiple waves per round while quiet submits one — so the
-    hot tenant's later waves starve behind its own backlog. The
-    fairness gate requires the skewed window's spread STRICTLY above
-    the balanced probe's (the spread metric must actually detect
-    starvation), and the ``request`` stanza rides the manifest for the
-    `scripts/bench_diff.py` fairness-spread/queue-share growth gate."""
+    into two tenants (``hot``/``quiet``) and the storm scheduler runs
+    the tenant-fair DRR flush order (docs/serving.md "Tenant-fair
+    flush order"). The fairness GATE runs on a dedicated three-arm
+    probe replaying identical skewed traffic under ``fifo`` (the
+    pre-DRR baseline) and ``drr``, plus a balanced-traffic ``drr`` arm:
+    the skewed shape floods the hot tenant over its per-tenant quota
+    (its stale waves shed, so hot churns fresh) while quiet's single
+    tick lands last — under FIFO quiet strands to the NEXT flush every
+    round; under DRR its share entitles it to the current one. The gate
+    requires the DRR arm's p99 spread STRICTLY below the FIFO arm's,
+    with the balanced arm flat (below the FIFO starvation signature),
+    and the ``request`` stanza rides the manifest for the
+    `scripts/bench_diff.py` fairness-spread/queue-share growth gate.
+
+    Warm page-in probe: one series streams through an evict →
+    warm-page-in cycle (the retained history tail replays through the
+    attach machinery) next to a never-evicted control; the gate
+    requires the replayed stream's filtered state and running loglik to
+    match the control's (docs/serving.md "Warm page-ins")."""
     import tempfile
 
     from __graft_entry__ import _tayal_batch
@@ -705,6 +715,135 @@ def serve_storm(args, backend, degraded) -> None:
     request_stanza = recorder.stanza()
     spread_skewed = request_stanza["fairness"]["p99_spread_ms"]
 
+    # ---- fairness duel (no faults): identical compact skewed replay
+    # under the FIFO baseline vs DRR, plus a balanced DRR arm. Fresh
+    # scheduler/metrics per arm (same snapshot seed) so arms cannot
+    # contaminate each other or the storm's compile accounting. The
+    # skewed shape is the per-tenant-quota starvation signature: hot
+    # floods 3 waves over 8 series with a tenant quota of 8 (stale
+    # waves shed — hot churns FRESH and its served latency stays low),
+    # quiet's single tick lands last, ONE flush per round, leftovers
+    # deliberately never drained (a final drain would hand stragglers
+    # artificial worst-case latencies in both arms).
+    def fairness_arm(order: str, skew: bool):
+        arm_rec = RequestRecorder(enabled=True, window_s=600.0)
+        arm_sched = MicroBatchScheduler(
+            model,
+            buckets=(8,),
+            metrics=ServeMetrics(),
+            recorder=arm_rec,
+            admission=AdmissionPolicy(
+                max_ticks_per_flush=8,
+                max_pending_per_series=8,  # the per-TENANT quota
+                flush_order=order,
+            ),
+        )
+        arm_rng = np.random.default_rng(7)
+        arm_snap = PosteriorSnapshot(
+            spec=spec,
+            draws=(arm_rng.normal(size=(draws, model.n_free)) * 0.3).astype(
+                np.float32
+            ),
+        )
+        def arm_tenant(i: int) -> str:
+            if skew:
+                return "hot"  # all 8 flood series; q0 is quiet
+            return "hot" if i % 2 == 0 else "quiet"
+        arm_sched.attach_many(
+            [(f"h{i}", arm_snap, None, arm_tenant(i)) for i in range(8)]
+            + ([("q0", arm_snap, None, "quiet")] if skew else [])
+        )
+        arm_rounds = 4 if args.quick else 8
+        for w in range(2):  # warm init + update at the single bucket
+            for i in range(8):
+                arm_sched.submit(f"h{i}", obs_for(i, w), tenant=arm_tenant(i))
+            if skew:
+                arm_sched.submit("q0", obs_for(8, w), tenant="quiet")
+            for _ in range(64):
+                if not arm_sched.flush():
+                    break
+        arm_rec.reset_window()
+        for r in range(arm_rounds):
+            if skew:
+                for j in range(3):
+                    for i in range(8):
+                        arm_sched.submit(
+                            f"h{i}", obs_for(i, 4 * r + j), tenant="hot"
+                        )
+                arm_sched.submit("q0", obs_for(8, r), tenant="quiet")
+            else:
+                for i in range(8):
+                    arm_sched.submit(f"h{i}", obs_for(i, r), tenant=arm_tenant(i))
+            arm_sched.flush()
+        return arm_rec.p99_spread_ms()
+
+    t0 = perf_counter()
+    try:
+        fifo_spread = fairness_arm("fifo", skew=True)
+        drr_spread = fairness_arm("drr", skew=True)
+        probe_balanced_spread = fairness_arm("drr", skew=False)
+    except Exception as e:
+        escaped += 1
+        fifo_spread = drr_spread = probe_balanced_spread = None
+        print(f"# serve-storm: fairness-probe escape: {e}", file=sys.stderr)
+
+    # ---- warm page-in parity probe (no faults): stream one series
+    # through evict → warm page-in next to a never-evicted control; the
+    # replayed tail must reproduce the control's filter state
+    parity_ticks = 6 if args.quick else 12
+    par_shed = 0
+    par_ll_delta = par_probs_delta = float("inf")
+    par_metrics = ServeMetrics()
+    try:
+        registry.save(
+            "parity",
+            PosteriorSnapshot(
+                spec=spec,
+                draws=(
+                    np.random.default_rng(11).normal(
+                        size=(draws, model.n_free)
+                    )
+                    * 0.3
+                ).astype(np.float32),
+            ),
+        )
+        par_pager = SnapshotPager(registry, budget_bytes=10**9)
+        par_paged = MicroBatchScheduler(
+            model,
+            buckets=(8,),
+            registry=registry,
+            pager=par_pager,
+            metrics=par_metrics,
+            history_tail=16,
+        )
+        par_ctl = MicroBatchScheduler(
+            model, buckets=(8,), metrics=ServeMetrics(), history_tail=16
+        )
+        par_ctl.attach("parity", registry.load("parity"))
+        par_ll_delta = par_probs_delta = 0.0
+        for t in range(parity_ticks):
+            rp = par_paged.tick({"parity": obs_for(3, t)})["parity"]
+            rc = par_ctl.tick({"parity": obs_for(3, t)})["parity"]
+            par_shed += int(rp.shed) + int(rc.shed)
+            if not (rp.shed or rc.shed):
+                par_ll_delta = max(par_ll_delta, abs(rp.loglik - rc.loglik))
+                par_probs_delta = max(
+                    par_probs_delta,
+                    float(np.max(np.abs(rp.probs - rc.probs))),
+                )
+            if t == parity_ticks // 2 - 1:
+                par_pager.evict("parity")  # the tail survives (WARM)
+    except Exception as e:
+        escaped += 1
+        print(f"# serve-storm: parity-probe escape: {e}", file=sys.stderr)
+    parity_ok = (
+        par_shed == 0
+        and par_ll_delta <= 1e-6
+        and par_probs_delta <= 1e-6
+        and par_metrics.warm_page_ins >= 1
+    )
+    probes_s = perf_counter() - t0
+
     summary = metrics.summary()
     pstats = pager.stats()
     slo = evaluate_slo(
@@ -741,15 +880,31 @@ def serve_storm(args, backend, degraded) -> None:
         )
     if summary["device_loss_events"] == 0:
         failures.append("device-loss fault was never absorbed (not injected?)")
-    # the fairness gate: the skewed two-tenant window's p99 spread must
-    # sit STRICTLY above the balanced probe's — a spread metric that
-    # cannot see deliberate starvation is not a starvation detector
-    if spread_skewed is None or (
-        spread_balanced is not None and spread_skewed <= spread_balanced
+    # the fairness gate (replaces the PR 10 skewed>balanced detector
+    # gate — the detector's job is done once the scheduler FIXES the
+    # starvation): on identical skewed traffic DRR's spread must sit
+    # STRICTLY below the FIFO baseline's, and the balanced arm must be
+    # flat — spread well under the FIFO starvation signature — so the
+    # win comes from scheduling the skew, not from reshaping balanced
+    # traffic
+    if fifo_spread is None or drr_spread is None or drr_spread >= fifo_spread:
+        failures.append(
+            "DRR did not beat FIFO on the skewed fairness probe "
+            f"(fifo={fifo_spread} ms, drr={drr_spread} ms)"
+        )
+    if probe_balanced_spread is None or (
+        fifo_spread is not None and probe_balanced_spread >= fifo_spread
     ):
         failures.append(
-            "fairness spread did not detect the skewed-tenant storm "
-            f"(skewed={spread_skewed} ms, balanced={spread_balanced} ms)"
+            "balanced fairness arm is not flat "
+            f"(balanced={probe_balanced_spread} ms, fifo={fifo_spread} ms)"
+        )
+    if not parity_ok:
+        failures.append(
+            "warm page-in did not reproduce the never-evicted stream "
+            f"(sheds={par_shed}, loglik_delta={par_ll_delta}, "
+            f"probs_delta={par_probs_delta}, "
+            f"warm_page_ins={par_metrics.warm_page_ins})"
         )
 
     storm_stanza = {
@@ -757,6 +912,17 @@ def serve_storm(args, backend, degraded) -> None:
         "fairness": {
             "balanced_p99_spread_ms": spread_balanced,
             "skewed_p99_spread_ms": spread_skewed,
+            "fifo_p99_spread_ms": fifo_spread,
+            "drr_p99_spread_ms": drr_spread,
+            "probe_balanced_p99_spread_ms": probe_balanced_spread,
+            "flush_order": policy.flush_order,
+        },
+        "warm_page_in": {
+            "parity": parity_ok,
+            "ticks": parity_ticks,
+            "loglik_delta": par_ll_delta,
+            "probs_delta": par_probs_delta,
+            "warm_page_ins": par_metrics.warm_page_ins,
         },
         "faults_injected": {
             "burst": {"factor": plan.burst_factor, "every": plan.burst_every},
@@ -799,6 +965,10 @@ def serve_storm(args, backend, degraded) -> None:
             "faults_escaped": escaped,
             "fairness_p99_spread_ms": spread_skewed,
             "fairness_p99_spread_balanced_ms": spread_balanced,
+            "fairness_fifo_p99_spread_ms": fifo_spread,
+            "fairness_drr_p99_spread_ms": drr_spread,
+            "warm_page_in_parity": parity_ok,
+            "probes_s": round(probes_s, 3),
             "queue_share": request_stanza["overall"]["queue_share"],
             "slo_attained": slo["attained"],
             "backend": backend["backend"],
@@ -821,6 +991,8 @@ def serve_storm(args, backend, degraded) -> None:
         f"device_loss={summary['device_loss_events']} escaped={escaped} "
         f"compiles_after_warmup={compiles_after_warmup} "
         f"spread={spread_skewed}ms(balanced {spread_balanced}ms) "
+        f"probe fifo={fifo_spread}ms drr={drr_spread}ms "
+        f"warm_page_in={'OK' if parity_ok else 'MISMATCH'} "
         + ("SLO ATTAINED" if slo["attained"] else "SLO UNMET"),
         file=sys.stderr,
     )

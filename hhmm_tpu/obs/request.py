@@ -288,6 +288,15 @@ class RequestRecorder:
         # re-timed call repeats an already-dispatched signature, so
         # this refinement can never add an XLA compile)
         self._profiled_device_ms: Dict[str, float] = {}
+        # flush-plan attribution (note_flush_plan): the scheduler's
+        # per-flush order decision, so spread is attributable to
+        # SCHEDULING (who waited by policy) vs device time. Cumulative
+        # served/stranded per folded tenant + last-seen share/credit;
+        # cardinality rides the same _fold bound as the stats table.
+        self._sched_order: Optional[str] = None
+        self._sched_credit_cap = 0.0
+        self._sched_tenants: Dict[str, Dict[str, Any]] = {}
+        self._sched_last_order: List[str] = []
 
     # ---- enablement (the obs/trace.py discipline) ----
 
@@ -467,6 +476,52 @@ class RequestRecorder:
                 float(p50_s) * 1e3, 4
             )
 
+    def note_flush_plan(
+        self,
+        order: str,
+        entries: Sequence[Dict[str, Any]],
+        credit_cap: float = 0.0,
+    ) -> None:
+        """The scheduler's per-flush order decision (tenant-fair DRR or
+        the FIFO baseline): one entry per tenant touched by the flush,
+        with its configured ``share``, ticks ``served``, ticks
+        ``stranded`` (still queued), and post-flush carry-over
+        ``credit``. Folding spread into *scheduling* (who waited by
+        policy) is what separates a fairness regression from a slow
+        device. Labels ride the same cardinality fold as the stats
+        table; served/stranded accumulate over the window, share and
+        credit keep the last-seen value (credit also tracks its peak,
+        the credit-cap property test's observable)."""
+        if not self.enabled():
+            return
+        with self._lock:
+            self._sched_order = str(order)
+            self._sched_credit_cap = float(credit_cap)
+            self._sched_last_order = []
+            for e in entries:
+                label = self._fold(str(e.get("tenant")))
+                self._sched_last_order.append(label)
+                row = self._sched_tenants.get(label)
+                if row is None:
+                    if len(self._sched_tenants) >= self._max_tenants:
+                        label = OVERFLOW_TENANT
+                        row = self._sched_tenants.get(label)
+                    if row is None:
+                        row = self._sched_tenants[label] = {
+                            "share": 1.0,
+                            "served": 0,
+                            "stranded": 0,
+                            "credit": 0.0,
+                            "credit_max": 0.0,
+                        }
+                row["share"] = float(e.get("share", 1.0))
+                row["served"] += int(e.get("served", 0))
+                row["stranded"] += int(e.get("stranded", 0))
+                c = float(e.get("credit", 0.0))
+                row["credit"] = c
+                if c > row["credit_max"]:
+                    row["credit_max"] = c
+
     # ---- reading ----
 
     def p99_spread_ms(self) -> Optional[float]:
@@ -515,6 +570,10 @@ class RequestRecorder:
             self._flushes = 0
             self._flush_tenant_total = 0
             self._max_queue_age_peak = 0.0
+            self._sched_order = None
+            self._sched_credit_cap = 0.0
+            self._sched_tenants = {}
+            self._sched_last_order = []
 
     def stanza(self, top: Optional[int] = 16) -> Dict[str, Any]:
         """JSON-ready request-plane stanza for the run manifest /
@@ -532,6 +591,17 @@ class RequestRecorder:
             tenant_total = self._flush_tenant_total
             peak_age = self._max_queue_age_peak
             profiled = dict(self._profiled_device_ms)
+            sched = None
+            if self._sched_order is not None:
+                sched = {
+                    "order": self._sched_order,
+                    "credit_cap": self._sched_credit_cap,
+                    "tenants": {
+                        t: dict(row)
+                        for t, row in self._sched_tenants.items()
+                    },
+                    "last_flush_order": list(self._sched_last_order),
+                }
             tenants: Dict[str, Any] = {}
             shown = items if top is None else items[:top]
             for name, st in shown:
@@ -577,4 +647,5 @@ class RequestRecorder:
                 "flushes": flushes,
             },
             "profiled_device_ms": profiled,
+            "scheduler": sched,
         }

@@ -144,6 +144,7 @@ class Plan:
         depth_factor: int = 8,
         flush_factor: int = 4,
         per_series: int = 2,
+        credit_factor: int = 1,
     ) -> Dict[str, int]:
         """Shed-aware admission caps derived from the planner-owned
         serve bucket ladder (the scheduler's
@@ -151,12 +152,18 @@ class Plan:
         policy type, the planner owns the numbers): queue depth and
         per-flush dispatch budget are multiples of the largest bucket,
         so a capacity-bounded flush always drains in already-compiled
-        bucket shapes and shedding never forces a novel jit signature."""
+        bucket shapes and shedding never forces a novel jit signature.
+        ``credit_cap_ticks`` bounds the deficit-round-robin carry-over
+        credit a tenant can bank between flushes (``credit_factor``
+        largest-buckets' worth): a starved tenant can reclaim at most
+        one extra bucket-ladder rung per flush, so its recovery burst
+        also drains in already-compiled shapes."""
         top = int(self.buckets[-1])
         return {
             "max_queue_depth": max(1, int(depth_factor)) * top,
             "max_ticks_per_flush": max(1, int(flush_factor)) * top,
             "max_pending_per_series": max(1, int(per_series)),
+            "credit_cap_ticks": max(1, int(credit_factor)) * top,
         }
 
     # ---- placement objects (the ONLY construction site outside

@@ -23,6 +23,13 @@ This module supplies the policy half of the fault-tolerance subsystem
   coherent.
 - **Backoff** (:meth:`RetryPolicy.backoff`) between device-level
   retries of UNAVAILABLE faults.
+- **Bounded I/O retry** (:class:`BackoffPolicy` + :func:`retry_call`):
+  jittered exponential backoff for *transient* storage faults — the
+  serve pager's snapshot-load path (`serve/pager.py`) wraps its
+  registry reads here so a torn or slow read gets a bounded second
+  chance before degrading to shed. Jitter is deterministic (a pure
+  function of (seed, salt, attempt)), so a replayed storm injects the
+  identical delay schedule.
 - **Backend degradation** (:func:`ensure_backend`): probe backend init
   and fall back to CPU with a clear log line instead of crashing with
   rc=1 — the `BENCH_r05.json` failure mode.
@@ -32,13 +39,22 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import random
 import sys
+import time
 from dataclasses import dataclass
-from typing import Any, Dict
+from typing import Any, Callable, Dict, Optional
 
 import jax
 
-__all__ = ["RetryPolicy", "escalate", "rejitter", "ensure_backend"]
+__all__ = [
+    "RetryPolicy",
+    "BackoffPolicy",
+    "retry_call",
+    "escalate",
+    "rejitter",
+    "ensure_backend",
+]
 
 
 @dataclass(frozen=True)
@@ -66,6 +82,94 @@ class RetryPolicy:
         linear-in-attempt multiples of the base (matches the historical
         ``_RETRY_SLEEP_S * (attempt + 1)`` schedule)."""
         return self.backoff_base_s * (attempt + 1)
+
+
+@dataclass(frozen=True)
+class BackoffPolicy:
+    """Bounded retry-with-backoff for transient I/O faults (the serve
+    pager's snapshot-load path). ``attempts`` counts TOTAL calls
+    (attempt 0 is the original); delays grow exponentially
+    (``base_s * factor**attempt``, clamped at ``max_s``) with a
+    deterministic jitter shaving up to ``jitter`` of each delay —
+    decorrelating a thundering herd of concurrent page-ins without
+    breaking replay determinism (the jitter is a pure function of
+    ``(seed, salt, attempt)``, the `rejitter` discipline applied to
+    wall-clock)."""
+
+    attempts: int = 3
+    base_s: float = 0.005
+    factor: float = 2.0
+    max_s: float = 0.25
+    jitter: float = 0.5
+    seed: int = 0x5EED
+
+    def __post_init__(self):
+        if int(self.attempts) < 1:
+            raise ValueError(f"attempts must be >= 1, got {self.attempts}")
+        if self.base_s < 0 or self.max_s < 0:
+            raise ValueError("base_s and max_s must be >= 0")
+        if self.factor < 1.0:
+            raise ValueError(f"factor must be >= 1, got {self.factor}")
+        if not (0.0 <= self.jitter <= 1.0):
+            raise ValueError(f"jitter must be in [0, 1], got {self.jitter}")
+
+    def delay(self, attempt: int, salt: int = 0) -> float:
+        """Seconds to sleep after failed attempt ``attempt`` (0-based)."""
+        raw = min(float(self.max_s), float(self.base_s) * self.factor ** attempt)
+        if self.jitter <= 0.0 or raw <= 0.0:
+            return raw
+        # mix the deterministic seed ingredients into one int (tuple
+        # seeding is deprecated); constants are odd 64-bit mixers
+        mixed = (
+            int(self.seed) * 0x9E3779B97F4A7C15
+            + int(salt) * 0xC2B2AE3D27D4EB4F
+            + int(attempt)
+        ) & 0xFFFFFFFFFFFFFFFF
+        u = random.Random(mixed).random()
+        return raw * (1.0 - self.jitter * u)
+
+
+def retry_call(
+    fn: Callable[[], Any],
+    policy: BackoffPolicy = BackoffPolicy(),
+    *,
+    failed: Optional[Callable[[Any], bool]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    on_retry: Optional[Callable[[int, Optional[Exception]], None]] = None,
+    salt: int = 0,
+) -> Any:
+    """Call ``fn`` up to ``policy.attempts`` times with backoff between
+    attempts; return the first non-failed result, else the last result.
+
+    ``failed(result)`` marks a returned value as retryable (default:
+    ``result is None`` — the registry's corrupt-file-is-a-miss
+    convention). An exception is retried while attempts remain and
+    re-raised from the final attempt. ``on_retry(attempt, exc)`` fires
+    before each backoff sleep (the pager counts
+    ``serve.pager_load_retries`` there); ``sleep`` is injectable so
+    tests drive the heal (e.g. a concurrent re-save) without real
+    wall-clock. ``salt`` decorrelates jitter across call sites."""
+    if failed is None:
+        failed = lambda r: r is None  # noqa: E731 — the registry miss convention
+    last: Any = None
+    for attempt in range(int(policy.attempts)):
+        err: Optional[Exception] = None
+        try:
+            last = fn()
+        except Exception as e:
+            if attempt + 1 >= policy.attempts:
+                raise
+            err, last = e, None
+        if err is None and not failed(last):
+            return last
+        if attempt + 1 >= policy.attempts:
+            break
+        if on_retry is not None:
+            on_retry(attempt, err)
+        d = policy.delay(attempt, salt)
+        if d > 0:
+            sleep(d)
+    return last
 
 
 def rejitter(key: jax.Array, attempt: int) -> jax.Array:

@@ -76,6 +76,14 @@ class ServeMetrics:
         self._rejected_attaches = obs_metrics.Counter()
         self._dispatch_errors = obs_metrics.Counter()
         self._device_loss_events = obs_metrics.Counter()
+        # warm page-in plane (docs/serving.md "Warm page-ins"): tails
+        # retained across pager eviction are host memory under an
+        # explicit byte budget — count the pressure evictions, gauge
+        # the resident bytes, and count re-attaches that replayed a
+        # retained tail instead of cold filtering
+        self._tail_evictions = obs_metrics.Counter()
+        self._warm_page_ins = obs_metrics.Counter()
+        self._tail_bytes = obs_metrics.Gauge()
         # sampled flush profiling (obs/profile.py device_time through
         # the scheduler's profile_every knob): how many flushes were
         # re-timed; the per-(kernel, bucket) device-time gauges go to
@@ -107,6 +115,9 @@ class ServeMetrics:
             ("serve.device_loss_events", self._device_loss_events),
             ("serve.snapshot_staleness_seconds", self._staleness),
             ("serve.profiled_flushes", self._profiled_flushes),
+            ("serve.tail_evictions", self._tail_evictions),
+            ("serve.warm_page_ins", self._warm_page_ins),
+            ("serve.tail_resident_bytes", self._tail_bytes),
         ):
             obs_metrics.attach(name, inst)
         # tenant label values this instance has already created on the
@@ -267,6 +278,34 @@ class ServeMetrics:
         """A dispatch failure classified as device loss (simulated or
         real UNAVAILABLE) was absorbed by the flush path."""
         self._device_loss_events.inc()
+
+    def note_tail_eviction(self, n: int = 1) -> None:
+        """``n`` retained history tails were dropped by host-byte
+        pressure (``tail_budget_bytes``) — those series page back in
+        COLD next time. NOT in ``summary()`` (schema frozen)."""
+        self._tail_evictions.inc(n)
+
+    def note_tail_bytes(self, nbytes: int) -> None:
+        """Current host bytes held by retained history tails."""
+        self._tail_bytes.set(float(nbytes))
+
+    def note_warm_page_in(self) -> None:
+        """A pager page-in replayed the series' retained history tail
+        through the attach machinery instead of cold filtering."""
+        self._warm_page_ins.inc()
+
+    @property
+    def tail_evictions(self) -> int:
+        return int(self._tail_evictions.get())
+
+    @property
+    def warm_page_ins(self) -> int:
+        return int(self._warm_page_ins.get())
+
+    @property
+    def tail_resident_bytes(self) -> int:
+        v = self._tail_bytes.get()
+        return 0 if v != v else int(v)  # NaN-safe: gauge unset = 0
 
     @property
     def profiled_flushes(self) -> int:

@@ -20,8 +20,16 @@ a *budgeted cache*:
   ``detach``), releasing the series' device-side draw bank, stream
   state, and staleness entry in the same motion. Reload is transparent:
   the next touch pages the snapshot back in and the series re-attaches
-  cold (fresh filter — the ladder's "page" rung trades filter warmth
-  for memory; see docs/serving.md "Overload & failure modes").
+  — WARM when the scheduler retained its history tail (the tail replays
+  through the attach machinery; see docs/serving.md "Warm page-ins"),
+  cold otherwise.
+- **load retry** (:class:`hhmm_tpu.robust.retry.BackoffPolicy` through
+  :func:`~hhmm_tpu.robust.retry.retry_call`): a transient storage fault
+  — a torn read healed by the concurrent writer's re-save, a slow NFS
+  hiccup — gets bounded jittered-backoff retries before the miss
+  degrades to shed (``serve.pager_load_retries`` counts the second
+  chances). A persistent fault still degrades: the retry budget is
+  bounded, and shed-don't-raise (invariant 8) holds either way.
 
 Budget signal (:func:`resolve_budget_bytes`): where the backend exposes
 ``Device.memory_stats()`` (TPU), the budget is a fraction of the
@@ -49,6 +57,7 @@ import numpy as np
 from hhmm_tpu.obs import metrics as obs_metrics
 from hhmm_tpu.obs import telemetry
 from hhmm_tpu.robust import faults
+from hhmm_tpu.robust.retry import BackoffPolicy, retry_call
 from hhmm_tpu.serve.registry import PosteriorSnapshot, SnapshotRegistry
 
 __all__ = ["SnapshotPager", "resolve_budget_bytes", "snapshot_nbytes"]
@@ -121,13 +130,26 @@ class SnapshotPager:
         *,
         budget_fraction: float = DEFAULT_BUDGET_FRACTION,
         fallback_budget_bytes: int = DEFAULT_FALLBACK_BUDGET,
+        load_retry: Optional[BackoffPolicy] = None,
+        retry_sleep: Optional[Callable[[float], None]] = None,
     ):
+        """``load_retry``: backoff policy for transient load faults
+        (``None`` = the :class:`BackoffPolicy` defaults, 3 attempts);
+        ``retry_sleep``: injectable sleep for the backoff (tests drive
+        the heal — e.g. a concurrent re-save — without wall-clock)."""
         self.registry = registry
+        self._budget_explicit = budget_bytes is not None
+        self._budget_fraction = float(budget_fraction)
+        self._fallback_budget_bytes = int(fallback_budget_bytes)
         self.budget_bytes, self.budget_source = resolve_budget_bytes(
             budget_bytes,
             fraction=budget_fraction,
             fallback_bytes=fallback_budget_bytes,
         )
+        self.load_retry = (
+            load_retry if load_retry is not None else BackoffPolicy()
+        )
+        self._retry_sleep = retry_sleep
         # guards every table below; see the class docstring for what
         # deliberately happens OUTSIDE it
         self._lock = threading.Lock()
@@ -147,6 +169,7 @@ class SnapshotPager:
         self._hits = obs_metrics.Counter()
         self._misses = obs_metrics.Counter()
         self._budget_overruns = obs_metrics.Counter()
+        self._load_retries = obs_metrics.Counter()
         self._resident_gauge = obs_metrics.Gauge()
         for name, inst in (
             ("serve.pager_loads", self._loads),
@@ -155,6 +178,7 @@ class SnapshotPager:
             ("serve.pager_hits", self._hits),
             ("serve.pager_misses", self._misses),
             ("serve.pager_budget_overruns", self._budget_overruns),
+            ("serve.pager_load_retries", self._load_retries),
             ("serve.pager_resident_bytes", self._resident_gauge),
         ):
             obs_metrics.attach(name, inst)
@@ -167,6 +191,24 @@ class SnapshotPager:
         no-op, not a recursion). The scheduler installs its ``detach``
         here."""
         self._on_evict = fn
+
+    def refresh_budget(self) -> Tuple[int, str]:
+        """Re-resolve a NON-explicit budget from the live device
+        ``bytes_limit`` watermarks (`obs/telemetry.sample_memory`) — a
+        long-running server whose backend came up after the pager (or
+        whose per-device limit changed across a device loss) re-derives
+        the budget instead of serving forever on a stale read. An
+        explicitly-sized budget is the operator's call and is never
+        overridden. Shrinks residency immediately when the new budget
+        is tighter. Returns ``(budget_bytes, source)``."""
+        if not self._budget_explicit:
+            self.budget_bytes, self.budget_source = resolve_budget_bytes(
+                None,
+                fraction=self._budget_fraction,
+                fallback_bytes=self._fallback_budget_bytes,
+            )
+            self.shrink_to_budget()
+        return self.budget_bytes, self.budget_source
 
     # ---- the load path ----
 
@@ -186,23 +228,43 @@ class SnapshotPager:
             self._hits.inc()
             return entry[0]
         self._misses.inc()
-        # promoted series resolve through the serving alias
-        # (`SnapshotRegistry.promote`): a paged-out series must come
-        # back on its PROMOTED snapshot, not the stale pre-promotion
-        # artifact — eviction would otherwise silently undo a refit
-        target = self.registry.serving_name(name) or name
-        # the traffic-fault surface: slow-load latency (an injected
-        # SLEEP) and torn-file corruption land here, exactly where cold
-        # storage would bite — and exactly why this path must not hold
-        # the lock: a 100 ms injected stall inside the critical section
-        # would serialize every concurrent hit behind it
-        faults.snapshot_load_fault(self.registry.path(target))
-        snap = self.registry.load(target)
-        if snap is None and target != name:
-            # stale alias (torn/corrupt versioned archive): the
-            # plain-name snapshot is still a servable posterior
-            snap = self.registry.load(name)
-        return snap
+
+        def _load_once() -> Optional[PosteriorSnapshot]:
+            # promoted series resolve through the serving alias
+            # (`SnapshotRegistry.promote`): a paged-out series must
+            # come back on its PROMOTED snapshot, not the stale
+            # pre-promotion artifact — eviction would otherwise
+            # silently undo a refit
+            target = self.registry.serving_name(name) or name
+            # the traffic-fault surface: slow-load latency (an injected
+            # SLEEP) and torn-file corruption land here, exactly where
+            # cold storage would bite — and exactly why this path must
+            # not hold the lock: a 100 ms injected stall inside the
+            # critical section would serialize every concurrent hit
+            # behind it
+            faults.snapshot_load_fault(self.registry.path(target))
+            snap = self.registry.load(target)
+            if snap is None and target != name:
+                # stale alias (torn/corrupt versioned archive): the
+                # plain-name snapshot is still a servable posterior
+                snap = self.registry.load(name)
+            return snap
+
+        # bounded second chances for TRANSIENT faults (robust/retry.py):
+        # a torn read quarantines the file, so the retry only heals if a
+        # concurrent writer re-saves during the backoff — exactly the
+        # window the jittered sleep buys. A persistent fault exhausts
+        # the budget and the miss degrades to shed (invariant 8);
+        # default failed-predicate: result is None (the registry's
+        # corrupt-file-is-a-miss convention).
+        kw = {} if self._retry_sleep is None else {"sleep": self._retry_sleep}
+        return retry_call(
+            _load_once,
+            self.load_retry,
+            on_retry=lambda attempt, err: self._load_retries.inc(),
+            salt=hash(name) & 0x7FFFFFFF,
+            **kw,
+        )
 
     def touch(self, name: str) -> Optional[PosteriorSnapshot]:
         """Load-or-hit WITH admission (:meth:`load` + :meth:`admit`):
@@ -375,4 +437,5 @@ class SnapshotPager:
             "hits": int(self._hits.get()),
             "misses": int(self._misses.get()),
             "budget_overruns": int(self._budget_overruns.get()),
+            "load_retries": int(self._load_retries.get()),
         }

@@ -72,7 +72,7 @@ from __future__ import annotations
 
 from collections import OrderedDict, deque
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -106,6 +106,27 @@ __all__ = ["TickResponse", "AdmissionPolicy", "MicroBatchScheduler"]
 # re-bind on its next explicit attach, or serve under the default
 # tenant = series)
 TENANT_BINDINGS_CAP = 65536
+
+# deficit-round-robin credit table bound: carry-over credit is only
+# meaningful for tenants with live demand, so the coldest entry past
+# the cap is dropped (it re-earns credit the next time it is stranded)
+CREDIT_TABLE_CAP = 4096
+
+# host-byte cap on retained history tails when the constructor does not
+# pass one: tails survive pager eviction (warm page-ins), so without a
+# cap a fleet of evicted series would grow host memory without bound
+DEFAULT_TAIL_BUDGET_BYTES = 32 << 20  # 32 MiB
+
+
+def _obs_nbytes(obs: Dict[str, Any]) -> int:
+    """Host-byte estimate of one retained tail observation: value
+    payloads plus a flat per-entry overhead for the dict/key objects
+    (an accounting convention, asserted in the pager churn test — the
+    cap needs a consistent measure, not a perfect one)."""
+    n = 64
+    for v in obs.values():
+        n += int(np.asarray(v).nbytes) + 16
+    return n
 
 
 @dataclass(frozen=True)
@@ -144,12 +165,34 @@ class AdmissionPolicy:
       the shed is counted under a ``serve.shed_ticks{tenant=}`` label.
     - ``max_ticks_per_flush``: dispatch budget per flush; the remainder
       stays queued (the queue bound above keeps the backlog finite).
+
+    Flush-order fairness (the overload ladder's fairness rung,
+    docs/serving.md): when the budget cannot drain every pending tick,
+    ``flush_order`` picks WHICH ticks wait —
+
+    - ``"drr"`` (default): weighted deficit round-robin across tenants.
+      Each flush's budget splits by ``tenant_shares`` (weight per
+      tenant; unlisted tenants weigh 1.0), stranded or pressure-shed
+      tenants bank the unused entitlement as carry-over credit for the
+      next flush, and ``credit_cap_ticks`` caps the bank so an idle
+      tenant cannot hoard unbounded burst rights (``None`` falls back
+      to ``max_ticks_per_flush``, then the largest bucket). Unused
+      entitlement is redistributed (work-conserving): the budget always
+      fills while eligible ticks remain. Per-series submission order is
+      preserved — a tick never overtakes an earlier queued tick of its
+      own series, so the filter folds observations in order.
+    - ``"fifo"``: the legacy arrival-order drain (the storm bench's
+      baseline arm; also the proof surface that DRR shrinks the
+      per-tenant p99 spread on identical traffic).
     """
 
     max_series: Optional[int] = None
     max_queue_depth: Optional[int] = None
     max_pending_per_series: Optional[int] = None
     max_ticks_per_flush: Optional[int] = None
+    tenant_shares: Optional[Mapping[str, float]] = None
+    credit_cap_ticks: Optional[int] = None
+    flush_order: str = "drr"
 
     def __post_init__(self):
         for f in (
@@ -157,18 +200,39 @@ class AdmissionPolicy:
             "max_queue_depth",
             "max_pending_per_series",
             "max_ticks_per_flush",
+            "credit_cap_ticks",
         ):
             v = getattr(self, f)
             if v is not None and int(v) <= 0:
                 raise ValueError(f"{f} must be positive or None, got {v}")
+        if self.flush_order not in ("fifo", "drr"):
+            raise ValueError(
+                f"flush_order must be 'fifo' or 'drr', got {self.flush_order!r}"
+            )
+        if self.tenant_shares is not None:
+            for t, w in self.tenant_shares.items():
+                if not (float(w) > 0):
+                    raise ValueError(
+                        f"tenant_shares[{t!r}] must be positive, got {w}"
+                    )
 
     @classmethod
     def from_plan(cls, plan, *, max_series: Optional[int] = None, **kw):
-        """Planner-derived caps: the queue/flush budgets come from the
-        planner-owned bucket ladder (:meth:`hhmm_tpu.plan.Plan.
-        admission_caps`), so a capacity-bounded flush always drains in
-        already-compiled bucket shapes."""
-        return cls(max_series=max_series, **plan.admission_caps(**kw))
+        """Planner-derived caps: the queue/flush budgets AND the DRR
+        credit cap come from the planner-owned bucket ladder
+        (:meth:`hhmm_tpu.plan.Plan.admission_caps`), so a
+        capacity-bounded flush — and a starved tenant's credit-funded
+        recovery burst — always drains in already-compiled bucket
+        shapes. ``tenant_shares``/``flush_order`` pass through as
+        keyword args (weights are deployment policy, not topology)."""
+        shares = kw.pop("tenant_shares", None)
+        order = kw.pop("flush_order", "drr")
+        return cls(
+            max_series=max_series,
+            tenant_shares=shares,
+            flush_order=order,
+            **plan.admission_caps(**kw),
+        )
 
 
 def _looks_like_device_loss(e: Exception) -> bool:
@@ -199,6 +263,7 @@ class MicroBatchScheduler:
         profile_every: int = 0,
         recorder: Optional[obs_request.RequestRecorder] = None,
         history_tail: int = 0,
+        tail_budget_bytes: Optional[int] = None,
     ):
         """``plan``: an optional :class:`hhmm_tpu.plan.Plan` — the
         topology-aware placement decision (`docs/sharding.md`). When
@@ -244,7 +309,13 @@ class MicroBatchScheduler:
         turns it on so drift-triggered warm refits have a sliding
         window to fit on (:meth:`history_tail_of`) and
         :meth:`swap_snapshot` has a replay history to warm-start the
-        promoted posterior from."""
+        promoted posterior from. The tail SURVIVES :meth:`detach` (so
+        a pager-evicted series pages back in WARM: ``submit`` replays
+        the retained tail through ``attach_many`` instead of cold
+        filtering) and is released only by :meth:`unregister` or
+        host-byte pressure: ``tail_budget_bytes`` (default 32 MiB)
+        caps total host bytes across all retained tails, evicting the
+        least-recently-folded series' tail first."""
         if buckets is None:
             buckets = plan.buckets if plan is not None else (8, 32, 128)
         if not buckets or any(b <= 0 for b in buckets):
@@ -286,10 +357,26 @@ class MicroBatchScheduler:
             raise ValueError(
                 f"history_tail must be >= 0, got {history_tail}"
             )
-        # per-series bounded deque of folded observation dicts (the
-        # maintenance plane's sliding refit window); released by
-        # detach() like every other per-series table
-        self._tail: Dict[str, Any] = {}
+        if tail_budget_bytes is None:
+            tail_budget_bytes = DEFAULT_TAIL_BUDGET_BYTES
+        if int(tail_budget_bytes) <= 0:
+            raise ValueError(
+                f"tail_budget_bytes must be positive, got {tail_budget_bytes}"
+            )
+        self.tail_budget_bytes = int(tail_budget_bytes)
+        # per-series bounded deque of (folded observation dict, nbytes)
+        # — the maintenance plane's sliding refit window AND the warm
+        # page-in replay source. Ordered LRU-by-fold so byte pressure
+        # evicts the stalest tail; SURVIVES detach() (pager eviction
+        # must not cost re-attach accuracy) and is released only by
+        # unregister() or the byte cap.
+        self._tail: "OrderedDict[str, Any]" = OrderedDict()
+        self._tail_bytes = 0
+        self._tail_evictions = 0
+        # DRR carry-over credit per tenant (flush_order="drr"): unused
+        # entitlement banked by stranded/shed tenants, capped by the
+        # policy's credit_cap_ticks; bounded LRU table
+        self._credit: "OrderedDict[str, float]" = OrderedDict()
         self.n_draws: Optional[int] = None
         self._series: Dict[str, Dict[str, Any]] = {}
         # snapshot-staleness accounting (obs metrics plane): perf_counter
@@ -738,15 +825,21 @@ class MicroBatchScheduler:
     # ---- detach / paging ----
 
     def detach(self, series_id: str) -> bool:
-        """Release EVERYTHING one series holds: its record (draw bank +
-        stream state), its staleness attach-time entry, its cached lane
-        stacks, its queued ticks (shed, counted), and its pager
-        residency. The pager's eviction path lands here; without it,
-        attached series grew without bound (ROADMAP item 4). Returns
-        False when the series was not attached."""
+        """Release the series' DEVICE-side holdings: its record (draw
+        bank + stream state), its staleness attach-time entry, its
+        cached lane stacks, its queued ticks (shed, counted), and its
+        pager residency. The pager's eviction path lands here; without
+        it, attached series grew without bound (ROADMAP item 4).
+
+        The history tail deliberately SURVIVES detach (like the tenant
+        binding): it is the warm page-in replay source — a pager-
+        evicted series re-attaches by replaying its retained tail
+        instead of cold filtering, so eviction stops costing posterior
+        accuracy. The tail is host memory under ``tail_budget_bytes``
+        and is released only by :meth:`unregister` or byte pressure.
+        Returns False when the series was not attached."""
         rec = self._series.pop(series_id, None)
         self._pending_count.pop(series_id, None)
-        self._tail.pop(series_id, None)
         if self.pager is not None:
             self.pager.discard(series_id)  # no-op if the pager evicted us
         if rec is None:
@@ -778,6 +871,62 @@ class MicroBatchScheduler:
                     keep.append(p)
             self._pending = keep
         return True
+
+    def unregister(self, series_id: str) -> bool:
+        """Full goodbye: :meth:`detach` plus everything detach
+        deliberately retains — the history tail (the warm page-in
+        replay source), the tenant binding, and the attach-generation
+        counter. Use when a series is leaving the fleet for good;
+        plain eviction should use :meth:`detach` (via the pager) so
+        the series can page back in warm. Returns True if anything
+        was released."""
+        released = self.detach(series_id)
+        released = self._drop_tail(series_id) or released
+        self.metrics.note_tail_bytes(self._tail_bytes)
+        released = (self._tenant_of.pop(series_id, None) is not None) or released
+        released = (self._attach_gen.pop(series_id, None) is not None) or released
+        return released
+
+    def _drop_tail(self, series_id: str) -> bool:
+        tail = self._tail.pop(series_id, None)
+        if tail is None:
+            return False
+        self._tail_bytes -= sum(nb for _, nb in tail)
+        return True
+
+    def _tail_append(self, series_id: str, obs_i: Dict[str, Any]) -> None:
+        """Fold one observation into the series' bounded tail ring,
+        with host-byte accounting: per-series the ring is capped at
+        ``history_tail`` entries; across series total bytes are capped
+        at ``tail_budget_bytes``, evicting the least-recently-folded
+        series' whole tail first (never the one being appended)."""
+        tail = self._tail.get(series_id)
+        if tail is None:
+            tail = self._tail[series_id] = deque(maxlen=self.history_tail)
+        entry = dict(obs_i)
+        nb = _obs_nbytes(entry)
+        if tail.maxlen is not None and len(tail) == tail.maxlen and tail:
+            self._tail_bytes -= tail[0][1]
+        tail.append((entry, nb))
+        self._tail_bytes += nb
+        self._tail.move_to_end(series_id)
+        while self._tail_bytes > self.tail_budget_bytes and len(self._tail) > 1:
+            victim = next(iter(self._tail))
+            if victim == series_id:
+                break
+            self._drop_tail(victim)
+            self._tail_evictions += 1
+            self.metrics.note_tail_eviction()
+        self.metrics.note_tail_bytes(self._tail_bytes)
+
+    def tail_stats(self) -> Dict[str, int]:
+        """Host-byte accounting for the retained history tails."""
+        return {
+            "series": len(self._tail),
+            "bytes": int(self._tail_bytes),
+            "budget_bytes": int(self.tail_budget_bytes),
+            "evictions": int(self._tail_evictions),
+        }
 
     # ---- ticking ----
 
@@ -846,6 +995,9 @@ class MicroBatchScheduler:
                 del self._pending[i]
                 self._dec_pending(p[0])
                 self._dec_tenant(p[3])
+                # a pressure-shed tick earns the tenant DRR catch-up
+                # credit: its loss was capacity's fault, not its own
+                self._credit_accrue(p[3], 1.0)
                 self._shed_now(
                     p[0],
                     p[2],
@@ -854,6 +1006,161 @@ class MicroBatchScheduler:
                     trace=p[4],
                 )
                 return
+
+    # ---- tenant-fair flush order (weighted deficit round-robin) ----
+
+    def _credit_cap(self, pol: AdmissionPolicy) -> float:
+        """Carry-over ceiling in ticks: the policy's explicit cap, else
+        the flush budget, else the largest bucket — a banked burst is
+        never bigger than one already-compiled flush shape."""
+        cap = pol.credit_cap_ticks
+        if cap is None:
+            cap = pol.max_ticks_per_flush
+        if cap is None:
+            cap = self.buckets[-1]
+        return float(cap)
+
+    def _credit_accrue(self, tenant: str, amount: float = 1.0) -> None:
+        pol = self.admission
+        if pol is None or pol.flush_order != "drr":
+            return
+        cap = self._credit_cap(pol)
+        self._credit[tenant] = min(cap, self._credit.get(tenant, 0.0) + amount)
+        self._credit.move_to_end(tenant)
+        while len(self._credit) > CREDIT_TABLE_CAP:
+            self._credit.popitem(last=False)
+
+    def _drr_drain(
+        self, budget: int, pol: AdmissionPolicy
+    ) -> List[Tuple[str, Dict[str, Any], float, str, Any]]:
+        """Select ``budget`` pending ticks by weighted deficit
+        round-robin across tenants (docs/serving.md, fairness rung).
+
+        Entitlement per tenant = budget * share/total_share + banked
+        carry-over credit (capped). Phase 1 serves each tenant up to
+        its entitlement; phase 2 is work-conserving — leftover budget
+        drains earliest-pending ticks regardless of entitlement, so
+        the flush always fills while eligible ticks remain. Per-series
+        FIFO is preserved: a tick is selectable only while it is its
+        series' earliest still-pending tick (the globally-earliest
+        unselected tick is always eligible, so selection never
+        livelocks). The drained list keeps ARRIVAL order — downstream
+        wave-splitting and fold semantics are unchanged; only WHICH
+        ticks wait differs from FIFO."""
+        pend = self._pending
+        shares = pol.tenant_shares or {}
+        by_tenant: "OrderedDict[str, deque]" = OrderedDict()
+        series_next: Dict[str, deque] = {}
+        for i, p in enumerate(pend):
+            by_tenant.setdefault(p[3], deque()).append(i)
+            series_next.setdefault(p[0], deque()).append(i)
+        total_w = sum(
+            max(1e-9, float(shares.get(t, 1.0))) for t in by_tenant
+        )
+        cap = self._credit_cap(pol)
+        ent: Dict[str, float] = {}
+        for t in by_tenant:
+            w = max(1e-9, float(shares.get(t, 1.0)))
+            ent[t] = budget * w / total_w + min(
+                cap, self._credit.get(t, 0.0)
+            )
+        selected = [False] * len(pend)
+        served: Dict[str, int] = {}
+        n_taken = 0
+
+        def take_one(t: str) -> bool:
+            # first tick (in arrival order — queues stay sorted) not
+            # blocked by per-series FIFO, i.e. not behind an unselected
+            # earlier tick of its own series
+            q = by_tenant[t]
+            for i in q:
+                if series_next[pend[i][0]][0] == i:
+                    q.remove(i)
+                    series_next[pend[i][0]].popleft()
+                    selected[i] = True
+                    ent[t] -= 1.0
+                    served[t] = served.get(t, 0) + 1
+                    return True
+            return False
+
+        # phase 1: entitled service, round-robin across tenants
+        progress = True
+        while n_taken < budget and progress:
+            progress = False
+            for t in list(by_tenant):
+                if n_taken >= budget:
+                    break
+                if ent[t] >= 1.0 and by_tenant[t] and take_one(t):
+                    n_taken += 1
+                    progress = True
+        # phase 2: work-conserving — leftover budget drains
+        # earliest-pending eligible ticks, ignoring entitlement. The
+        # globally-earliest unselected tick is always its series' head
+        # (everything before it is selected), so this never stalls
+        # while ticks remain.
+        while n_taken < budget:
+            best: Optional[str] = None
+            for t in by_tenant:
+                q = by_tenant[t]
+                if q and (best is None or q[0] < by_tenant[best][0]):
+                    best = t
+            if best is None or not take_one(best):
+                break
+            n_taken += 1
+        drained = [p for i, p in enumerate(pend) if selected[i]]
+        self._pending = [p for i, p in enumerate(pend) if not selected[i]]
+        # credit: stranded tenants bank their unused entitlement (capped),
+        # fully-served tenants start the next flush with a clean slate
+        stranded = {t: len(q) for t, q in by_tenant.items() if q}
+        for t in by_tenant:
+            if t in stranded:
+                self._credit[t] = min(cap, max(0.0, ent[t]))
+                self._credit.move_to_end(t)
+            else:
+                self._credit.pop(t, None)
+        while len(self._credit) > CREDIT_TABLE_CAP:
+            self._credit.popitem(last=False)
+        if self.recorder.enabled():
+            served_ord: "OrderedDict[str, int]" = OrderedDict()
+            for t in by_tenant:
+                if served.get(t):
+                    served_ord[t] = served[t]
+            self._record_flush_plan(pol, "drr", served_ord, stranded)
+        return drained
+
+    def _record_flush_plan(
+        self,
+        pol: Optional[AdmissionPolicy],
+        order: str,
+        served: Mapping[str, int],
+        stranded: Mapping[str, int],
+    ) -> None:
+        """Hand the flush's scheduling decision to the request plane so
+        per-tenant spread is attributable to SCHEDULING (who waited by
+        policy) rather than device time."""
+        if not self.recorder.enabled():
+            return
+        shares = (pol.tenant_shares if pol is not None else None) or {}
+        entries = []
+        for t in served:
+            entries.append({
+                "tenant": t,
+                "share": float(shares.get(t, 1.0)),
+                "served": int(served[t]),
+                "stranded": int(stranded.get(t, 0)),
+                "credit": float(self._credit.get(t, 0.0)),
+            })
+        for t in stranded:
+            if t not in served:
+                entries.append({
+                    "tenant": t,
+                    "share": float(shares.get(t, 1.0)),
+                    "served": 0,
+                    "stranded": int(stranded[t]),
+                    "credit": float(self._credit.get(t, 0.0)),
+                })
+        cap = self._credit_cap(pol) if pol is not None else 0.0
+        self.recorder.note_flush_plan(order, entries, credit_cap=cap)
 
     def _dec_pending(self, series_id: str) -> None:
         n = self._pending_count.get(series_id, 0) - 1
@@ -885,7 +1192,9 @@ class MicroBatchScheduler:
         unknown series sheds the tick (counted, delivered as a
         ``shed=True`` response at the next flush) instead of raising —
         unless a pager is attached and the series is registered, in
-        which case it is transparently paged in and attached cold.
+        which case it is transparently paged in — WARM (replaying the
+        retained history tail through the attach machinery) when the
+        series was evicted with a tail on hand, cold otherwise.
         Admission pressure (queue depth / per-tenant quota) sheds
         oldest-first, never raises."""
         now = obs_request.now()
@@ -930,7 +1239,13 @@ class MicroBatchScheduler:
                     tenant=tenant, trace=trace,
                 )
                 return
-            rej = self.attach_many([(series_id, snap, None)])
+            # WARM page-in: when the series left behind a retained
+            # history tail (detach keeps it), replay it through the
+            # attach warm-replay machinery — the re-attached filter
+            # state matches the never-evicted stream over the tail
+            # horizon instead of restarting cold from the snapshot
+            hist = self.history_tail_of(series_id)
+            rej = self.attach_many([(series_id, snap, hist)])
             if rej:
                 self._shed_now(
                     series_id,
@@ -940,6 +1255,8 @@ class MicroBatchScheduler:
                     trace=trace,
                 )
                 return
+            if hist is not None:
+                self.metrics.note_warm_page_in()
         pol = self.admission
         if pol is not None:
             q = pol.max_pending_per_series
@@ -1017,10 +1334,29 @@ class MicroBatchScheduler:
             if pol is None or pol.max_ticks_per_flush is None
             else int(pol.max_ticks_per_flush)
         )
-        pending, self._pending = (
-            self._pending[:budget],
-            self._pending[budget:],
-        )
+        drr = pol is not None and pol.flush_order == "drr"
+        if drr and budget < len(self._pending):
+            pending = self._drr_drain(budget, pol)
+        else:
+            pending, self._pending = (
+                self._pending[:budget],
+                self._pending[budget:],
+            )
+            if drr:
+                # full drain: every tenant was served in full this
+                # flush, so banked catch-up credit is spent/voided
+                for p in pending:
+                    self._credit.pop(p[3], None)
+            if self.recorder.enabled():
+                served: "OrderedDict[str, int]" = OrderedDict()
+                for p in pending:
+                    served[p[3]] = served.get(p[3], 0) + 1
+                stranded: Dict[str, int] = {}
+                for p in self._pending:
+                    stranded[p[3]] = stranded.get(p[3], 0) + 1
+                self._record_flush_plan(
+                    pol, "drr" if drr else "fifo", served, stranded
+                )
         for p in pending:
             self._dec_pending(p[0])
             self._dec_tenant(p[3])
@@ -1280,15 +1616,10 @@ class MicroBatchScheduler:
             rec = self._series[series_id]
             rec["alpha"], rec["ll"], rec["ok"] = alpha[i], ll[i], okd[i]
             if self.history_tail:
-                # the maintenance plane's sliding refit window: only
-                # FOLDED observations enter (this loop runs after the
-                # dispatch committed); the deque bound makes it O(1)
-                tail = self._tail.get(series_id)
-                if tail is None:
-                    tail = self._tail[series_id] = deque(
-                        maxlen=self.history_tail
-                    )
-                tail.append(dict(obs_i))
+                # the maintenance plane's sliding refit window AND the
+                # warm page-in replay source: only FOLDED observations
+                # enter (this loop runs after the dispatch committed)
+                self._tail_append(series_id, obs_i)
             n_ok = int(np.asarray(okd[i]).sum())
             degraded = bool(rec["degraded_attach"]) or n_ok == 0
             if degraded:
@@ -1318,8 +1649,8 @@ class MicroBatchScheduler:
         tail = self._tail.get(series_id)
         if not tail:
             return None
-        keys = sorted(tail[0].keys())
-        return {k: np.asarray([o[k] for o in tail]) for k in keys}
+        keys = sorted(tail[0][0].keys())
+        return {k: np.asarray([o[k] for o, _ in tail]) for k in keys}
 
     def attach_generation(self, series_id: str) -> int:
         """How many times this series' filter state has been replaced

@@ -50,6 +50,15 @@ Further gate rules:
   followed by a record with ``faults_escaped > 0`` — an injected fault
   leaking out as an exception is a survival regression even if the
   bench somehow exited 0.
+- **Scheduler fairness gates within the record**: a ``storm`` stanza
+  carrying the FIFO-vs-DRR duel fields
+  (``fairness.fifo_p99_spread_ms`` / ``fairness.drr_p99_spread_ms``)
+  fails the gate unless DRR's skewed-probe spread sits STRICTLY below
+  the FIFO baseline's — the duel ships its own baseline arm, so no
+  prior record is needed. Warm page-in parity
+  (``warm_page_in.parity``) gates like the SLO: a comparable baseline
+  that reproduced the never-evicted stream followed by a record that
+  does not is a replay-correctness regression.
 - **Maintenance gates like resilience**: a record whose manifest
   stanza carries a ``maint`` stanza (`bench.py --maint`,
   `hhmm_tpu/maint/`) fails the gate when a comparable baseline that
@@ -201,6 +210,7 @@ def diff(
     last_by_key: Dict[Tuple, Dict[str, Any]] = {}
     last_slo_by_key: Dict[Tuple, bool] = {}
     last_escaped_by_key: Dict[Tuple, int] = {}
+    last_parity_by_key: Dict[Tuple, bool] = {}
     last_promotions_by_key: Dict[Tuple, int] = {}
     last_costs_by_key: Dict[Tuple, Dict[str, float]] = {}
     last_request_by_key: Dict[Tuple, Dict[str, Optional[float]]] = {}
@@ -317,6 +327,58 @@ def diff(
                 else:
                     row["status"] += "; faults contained"
                 last_escaped_by_key[key] = esc
+            if isinstance(storm, dict):
+                # the scheduler-fairness duel rides the storm stanza:
+                # a record carrying the FIFO-vs-DRR probe fields must
+                # show DRR strictly below the FIFO baseline — equality
+                # means the fair order bought nothing, inversion means
+                # it made starvation WORSE (gated within the record:
+                # the duel ships its own baseline arm)
+                duel = storm.get("fairness")
+                if isinstance(duel, dict) and "drr_p99_spread_ms" in duel:
+                    fifo_ms = duel.get("fifo_p99_spread_ms")
+                    drr_ms = duel.get("drr_p99_spread_ms")
+                    if (
+                        not isinstance(fifo_ms, (int, float))
+                        or not isinstance(drr_ms, (int, float))
+                        or drr_ms >= fifo_ms
+                    ):
+                        failures += 1
+                        row["gated"] = True
+                        row["status"] += (
+                            "; FAIRNESS REGRESSION: DRR spread not "
+                            f"strictly below FIFO (fifo={fifo_ms} ms, "
+                            f"drr={drr_ms} ms)"
+                        )
+                    else:
+                        row["status"] += (
+                            f"; fair order holds (fifo={fifo_ms:g} ms "
+                            f"-> drr={drr_ms:g} ms)"
+                        )
+                # warm page-in parity is gated like the SLO: a record
+                # whose comparable baseline reproduced the
+                # never-evicted stream, then stopped, silently serves
+                # wrong posteriors after every eviction
+                wpi = storm.get("warm_page_in")
+                if isinstance(wpi, dict) and "parity" in wpi:
+                    parity = bool(wpi.get("parity"))
+                    prev_parity = last_parity_by_key.get(key)
+                    if prev_parity and not parity:
+                        failures += 1
+                        row["gated"] = True
+                        row["status"] += (
+                            "; WARM PAGE-IN REGRESSION: replay parity "
+                            "lost (baseline matched the never-evicted "
+                            "stream)"
+                        )
+                    elif not parity:
+                        row["status"] += (
+                            "; warm page-in parity unmet (no matching "
+                            "baseline)"
+                        )
+                    else:
+                        row["status"] += "; warm page-in parity"
+                    last_parity_by_key[key] = parity
             # the maintenance plane rides the same key, gated like the
             # resilience gate: a comparable record that PROMOTED
             # (promotions > 0) followed by one that could not close the

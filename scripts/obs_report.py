@@ -24,10 +24,13 @@ SLO attainment). This script folds all of it into one readable report:
                      overload/resilience counters (shed/pager/device loss)
   == request timeline == the `obs/request.py` plane: per-tenant tick
                      latency decomposed into queue/device/other shares,
-                     windowed p50/p99, sheds, and the fairness
-                     observables (p99 spread, queue age, interleaving)
+                     windowed p50/p99, sheds, the fairness
+                     observables (p99 spread, queue age, interleaving),
+                     and the scheduler's flush-order attribution table
+                     (per-tenant share/served/stranded/credit)
   == storm ==        the `bench.py --serve-storm` verdict: faults
                      injected/escaped + survival gates, fairness arms
+                     incl. the FIFO-vs-DRR duel, warm page-in parity
   == maintenance ==  the `hhmm_tpu/maint/` closed loop (`bench.py
                      --maint`): drift triggers -> warm refits ->
                      shadow verdicts -> promotions, with the recent
@@ -352,6 +355,33 @@ def render_request(man: Dict[str, Any], out) -> None:
     profiled = req.get("profiled_device_ms") or {}
     for k, v in sorted(profiled.items()):
         print(f"  warm device re-time {k}: {_fmt(v)} ms", file=out)
+    sched = req.get("scheduler")
+    if isinstance(sched, dict):
+        print(
+            f"  flush order: {_fmt(sched.get('order'))} "
+            f"(credit cap {_fmt(sched.get('credit_cap'))} ticks, last "
+            f"flush {'>'.join(sched.get('last_flush_order') or []) or '-'})",
+            file=out,
+        )
+        rows = []
+        for tenant, t in sorted((sched.get("tenants") or {}).items()):
+            if not isinstance(t, dict):
+                continue
+            rows.append(
+                (
+                    tenant,
+                    _fmt(t.get("share")),
+                    _fmt(t.get("served")),
+                    _fmt(t.get("stranded")),
+                    _fmt(t.get("credit")),
+                    _fmt(t.get("credit_max")),
+                )
+            )
+        _table(
+            ("tenant", "share", "served", "stranded", "credit", "credit_max"),
+            rows,
+            out,
+        )
 
 
 def render_kernel_costs(man: Dict[str, Any], out) -> None:
@@ -433,6 +463,25 @@ def render_storm(man: Dict[str, Any], out) -> None:
             "  fairness arms: skewed p99 spread "
             f"{_fmt(fair.get('skewed_p99_spread_ms'))} ms vs balanced "
             f"{_fmt(fair.get('balanced_p99_spread_ms'))} ms",
+            file=out,
+        )
+        if "drr_p99_spread_ms" in fair:
+            print(
+                "  fairness duel: fifo "
+                f"{_fmt(fair.get('fifo_p99_spread_ms'))} ms -> drr "
+                f"{_fmt(fair.get('drr_p99_spread_ms'))} ms (balanced arm "
+                f"{_fmt(fair.get('probe_balanced_p99_spread_ms'))} ms, "
+                f"storm order {_fmt(fair.get('flush_order'))})",
+                file=out,
+            )
+    wpi = storm.get("warm_page_in")
+    if isinstance(wpi, dict):
+        print(
+            "  warm page-in: "
+            + ("parity" if wpi.get("parity") else "MISMATCH")
+            + f" over {_fmt(wpi.get('ticks'))} ticks (loglik delta "
+            f"{_fmt(wpi.get('loglik_delta'))}, page-ins "
+            f"{_fmt(wpi.get('warm_page_ins'))})",
             file=out,
         )
     inj = storm.get("faults_injected") or {}

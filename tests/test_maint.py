@@ -608,7 +608,11 @@ class TestSchedulerMaintSurface:
         tail = sched.history_tail_of("s")
         np.testing.assert_array_equal(tail["x"], np.asarray([1]))
 
-    def test_detach_releases_the_tail(self):
+    def test_detach_keeps_the_tail_unregister_releases_it(self):
+        # the warm page-in contract (docs/serving.md): detach — the
+        # pager's eviction path — RETAINS the tail so the series can
+        # page back in warm; only the full goodbye (unregister) or
+        # host-byte pressure releases it
         model = MultinomialHMM(K=2, L=3)
         snap = _fake_snapshot(model, n_draws=3)
         sched = MicroBatchScheduler(model, buckets=(4,), history_tail=4)
@@ -616,7 +620,17 @@ class TestSchedulerMaintSurface:
         sched.tick({"s": {"x": 1}})
         assert sched.history_tail_of("s") is not None
         assert sched.detach("s")
+        tail = sched.history_tail_of("s")
+        np.testing.assert_array_equal(tail["x"], np.asarray([1]))
+        assert sched.tail_stats()["bytes"] > 0
+        assert sched.unregister("s")
         assert sched.history_tail_of("s") is None
+        assert sched.tail_stats() == {
+            "series": 0,
+            "bytes": 0,
+            "budget_bytes": sched.tail_budget_bytes,
+            "evictions": 0,
+        }
 
     def test_swap_resets_staleness_and_serves_promoted_draws(self, tmp_path):
         model = MultinomialHMM(K=2, L=3)

@@ -891,7 +891,8 @@ def _run_bench_diff(*argv):
 
 
 def _write_fixture_rounds(
-    d, values, stamped=True, traced=None, slo=None, escaped=None, request=None
+    d, values, stamped=True, traced=None, slo=None, escaped=None, request=None,
+    duel=None, parity=None,
 ):
     for n, v in enumerate(values, start=1):
         rec = {
@@ -918,6 +919,16 @@ def _write_fixture_rounds(
             if escaped is not None and escaped[n - 1] is not None:
                 rec["manifest"]["storm"] = {
                     "faults_escaped": int(escaped[n - 1])
+                }
+            if duel is not None and duel[n - 1] is not None:
+                fifo_ms, drr_ms = duel[n - 1]
+                rec["manifest"].setdefault("storm", {})["fairness"] = {
+                    "fifo_p99_spread_ms": fifo_ms,
+                    "drr_p99_spread_ms": drr_ms,
+                }
+            if parity is not None and parity[n - 1] is not None:
+                rec["manifest"].setdefault("storm", {})["warm_page_in"] = {
+                    "parity": bool(parity[n - 1])
                 }
             if slo is not None and slo[n - 1] is not None:
                 attained = bool(slo[n - 1])
@@ -1056,6 +1067,65 @@ class TestBenchDiffResilience:
         proc = _run_bench_diff("--dir", str(tmp_path))
         assert proc.returncode == 0, proc.stdout
         assert "no clean baseline" in proc.stdout
+
+
+class TestBenchDiffFairnessDuel:
+    """The storm stanza's FIFO-vs-DRR fairness duel gates WITHIN the
+    record (the duel ships its own baseline arm); warm page-in parity
+    gates on a true -> false transition like the SLO."""
+
+    def test_duel_holds_passes(self, tmp_path):
+        _write_fixture_rounds(
+            tmp_path, [100.0, 99.0], duel=[(8.0, 0.5), (8.0, 0.4)]
+        )
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 0, proc.stdout
+        assert "fair order holds" in proc.stdout
+
+    def test_duel_equality_fails_even_on_first_record(self, tmp_path):
+        # strictly below: equal spread means DRR bought nothing, and
+        # no prior record is needed to see it
+        _write_fixture_rounds(tmp_path, [100.0], duel=[(5.0, 5.0)])
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 1, proc.stdout
+        assert "FAIRNESS REGRESSION" in proc.stdout
+
+    def test_duel_inversion_fails(self, tmp_path):
+        _write_fixture_rounds(
+            tmp_path, [100.0, 100.0], duel=[(8.0, 0.5), (5.0, 6.0)]
+        )
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 1, proc.stdout
+        assert "FAIRNESS REGRESSION" in proc.stdout
+
+    def test_duel_unmeasured_arm_fails(self, tmp_path):
+        _write_fixture_rounds(tmp_path, [100.0], duel=[(None, 0.5)])
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 1, proc.stdout
+
+    def test_parity_lost_after_baseline_fails(self, tmp_path):
+        _write_fixture_rounds(
+            tmp_path, [100.0, 100.0], parity=[True, False]
+        )
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 1, proc.stdout
+        assert "WARM PAGE-IN REGRESSION" in proc.stdout
+
+    def test_parity_never_met_reported_not_gated(self, tmp_path):
+        _write_fixture_rounds(
+            tmp_path, [100.0, 99.0], parity=[False, False]
+        )
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 0, proc.stdout
+        assert "parity unmet" in proc.stdout
+
+    def test_parity_held_passes(self, tmp_path):
+        _write_fixture_rounds(
+            tmp_path, [100.0, 99.0], parity=[True, True]
+        )
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 0, proc.stdout
+        assert "warm page-in parity" in proc.stdout
 
 
 class TestBenchDiffRequestPlane:

@@ -243,6 +243,57 @@ class TestRecorder:
         assert len(st["tenants"]) == 3
         assert st["tenants_omitted"] == 5
 
+    def test_flush_plan_attribution(self):
+        """`note_flush_plan` folds the scheduler's per-flush DRR
+        decisions into the stanza: served/stranded accumulate across
+        flushes, share/credit are last-seen, credit_max is the window
+        peak — so a spread regression is attributable to the ORDER the
+        scheduler chose, not just the traffic."""
+        clock = _Clock()
+        rec = RequestRecorder(enabled=True, clock=clock)
+        assert rec.stanza()["scheduler"] is None  # nothing recorded yet
+        rec.note_flush_plan(
+            "drr",
+            [
+                {"tenant": "hot", "share": 3.0, "served": 6,
+                 "stranded": 2, "credit": 2.0},
+                {"tenant": "quiet", "share": 1.0, "served": 2,
+                 "stranded": 0, "credit": 0.0},
+            ],
+            credit_cap=4.0,
+        )
+        rec.note_flush_plan(
+            "drr",
+            [
+                {"tenant": "hot", "share": 3.0, "served": 5,
+                 "stranded": 0, "credit": 1.0},
+            ],
+            credit_cap=4.0,
+        )
+        plan = rec.stanza()["scheduler"]
+        assert plan["order"] == "drr" and plan["credit_cap"] == 4.0
+        assert plan["last_flush_order"] == ["hot"]
+        hot, quiet = plan["tenants"]["hot"], plan["tenants"]["quiet"]
+        assert (hot["served"], hot["stranded"]) == (11, 2)  # accumulated
+        assert (hot["credit"], hot["credit_max"]) == (1.0, 2.0)
+        assert (quiet["served"], quiet["share"]) == (2, 1.0)
+        rec.reset_window()
+        assert rec.stanza()["scheduler"] is None  # window semantics
+
+    def test_flush_plan_tenant_rows_bounded(self):
+        clock = _Clock()
+        rec = RequestRecorder(enabled=True, max_tenants=2, clock=clock)
+        rec.note_flush_plan(
+            "drr",
+            [{"tenant": f"t{i}", "share": 1.0, "served": 1,
+              "stranded": 0, "credit": 0.0} for i in range(8)],
+        )
+        plan = rec.stanza()["scheduler"]
+        assert len(plan["tenants"]) <= 3  # 2 exact + the overflow fold
+        assert obs_request.OVERFLOW_TENANT in plan["tenants"]
+        total = sum(r["served"] for r in plan["tenants"].values())
+        assert total == 8  # folded, never dropped
+
 
 class TestSchedulerIntegration:
     def _sched(self, **kw):

@@ -480,6 +480,75 @@ class TestRetryPolicy:
         assert out["devices"] >= 1
 
 
+class TestBoundedRetry:
+    """`robust/retry.py` BackoffPolicy + retry_call: the bounded
+    second-chance ladder the pager's load path leans on."""
+
+    def test_delay_schedule_deterministic_and_capped(self):
+        from hhmm_tpu.robust.retry import BackoffPolicy
+
+        p = BackoffPolicy(base_s=0.01, factor=2.0, max_s=0.02, jitter=0.0)
+        assert [p.delay(a) for a in range(3)] == [0.01, 0.02, 0.02]
+        j = BackoffPolicy(jitter=0.5)
+        # deterministic for the same (seed, salt, attempt); jitter only
+        # ever SHORTENS the raw delay (thundering-herd de-sync)
+        assert j.delay(1, salt=7) == j.delay(1, salt=7)
+        assert j.delay(1, salt=7) != j.delay(1, salt=8)
+        raw = BackoffPolicy(jitter=0.0).delay(1)
+        assert 0.5 * raw <= j.delay(1, salt=7) <= raw
+
+    def test_retry_call_transient_heals(self):
+        from hhmm_tpu.robust.retry import BackoffPolicy, retry_call
+
+        calls, slept, noted = [], [], []
+        def flaky():
+            calls.append(1)
+            return "ok" if len(calls) >= 3 else None
+        out = retry_call(
+            flaky,
+            BackoffPolicy(attempts=3),
+            sleep=slept.append,
+            on_retry=lambda a, e: noted.append((a, e)),
+        )
+        assert out == "ok" and len(calls) == 3
+        assert len(slept) == 2 and all(d > 0 for d in slept)
+        assert [a for a, _ in noted] == [0, 1]
+
+    def test_retry_call_budget_is_bounded(self):
+        from hhmm_tpu.robust.retry import BackoffPolicy, retry_call
+
+        calls = []
+        out = retry_call(
+            lambda: calls.append(1),  # always None: persistent failure
+            BackoffPolicy(attempts=3),
+            sleep=lambda d: None,
+        )
+        assert out is None and len(calls) == 3  # attempts = TOTAL calls
+
+    def test_retry_call_exception_reraised_on_final_attempt(self):
+        from hhmm_tpu.robust.retry import BackoffPolicy, retry_call
+
+        calls = []
+        def boom():
+            calls.append(1)
+            raise OSError("disk on fire")
+        with pytest.raises(OSError):
+            retry_call(boom, BackoffPolicy(attempts=2), sleep=lambda d: None)
+        assert len(calls) == 2
+
+    def test_retry_call_custom_failed_predicate(self):
+        from hhmm_tpu.robust.retry import BackoffPolicy, retry_call
+
+        seq = iter([-1, -1, 5])
+        out = retry_call(
+            lambda: next(seq),
+            BackoffPolicy(attempts=3),
+            failed=lambda r: r < 0,
+            sleep=lambda d: None,
+        )
+        assert out == 5
+
+
 class TestCacheRobust:
     def test_torn_file_is_miss_then_recomputable(self, tmp_path):
         cache = ResultCache(str(tmp_path))
