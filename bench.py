@@ -1313,6 +1313,383 @@ def maint_bench(args, backend, degraded) -> None:
         sys.exit(1)
 
 
+def adapt_bench(args, backend, degraded) -> None:
+    """``--adapt``: the tick-cadence adaptation closed loop
+    (`hhmm_tpu/adapt/`, docs/maintenance.md's three-rung ladder).
+
+    Three arms stream the SAME regime-shifted trace from the same
+    fitted snapshots (separate registries/schedulers per arm, so no
+    state bleeds):
+
+    - **W (adaptive)**: per-tick draw reweighting + ESS/alarm-triggered
+      Liu–West rejuvenation (`AdaptationLadder`), with the maintenance
+      loop wired through the ladder (``adapt=ladder``) so only
+      persistent alarms escalate to warm refits;
+    - **U (uniform-stale)**: no adaptation, no maintenance — the
+      equal-weight mixture of the pre-shift posterior, the degradation
+      the paper's non-stationary workloads inflict by default;
+    - **M (refit-only baseline)**: PR 14's plain maintenance loop
+      (alarm → debounced warm refit), no cheap rungs.
+
+    Exit is nonzero unless the ladder demonstrably adapts: the
+    weighted/rejuvenated arm's one-step predictive loglik strictly
+    beats the uniform-stale arm on the post-shift ticks (paired
+    per-series AND pooled — the --maint recovery-gate discipline);
+    at least one ESS-floor or alarm rejuvenation ran and restored ESS
+    above the planner-derived floor; zero XLA compiles landed after
+    warmup across reweighting, rejuvenation, and any promotion swap;
+    and the adaptive arm performed strictly FEWER warm refits than the
+    refit-only baseline on the same trace (the ladder's whole point:
+    the cheap rungs absorb what the expensive one used to pay for).
+    The ``adapt`` stanza (+ bench-computed tracking verdict) is
+    stamped in the record manifest — the surface `scripts/bench_diff.py`
+    gates (tracking-advantage true→false, ESS-floor breaches) and
+    `scripts/obs_report.py` renders as ``== adaptation ==``."""
+    import atexit
+    import shutil
+    import tempfile
+
+    from __graft_entry__ import _tayal_batch
+    from hhmm_tpu.adapt import (
+        AdaptationLadder,
+        uniform_log_weights,
+        uniform_mixture_loglik,
+        weighted_mixture_loglik,
+    )
+    from hhmm_tpu.batch import fit_batched
+    from hhmm_tpu.infer import GibbsConfig
+    from hhmm_tpu.maint import MaintenanceLoop, MaintenancePolicy
+    from hhmm_tpu.models import TayalHHMM
+    from hhmm_tpu.robust import faults
+    from hhmm_tpu.serve import (
+        MicroBatchScheduler,
+        ServeMetrics,
+        SnapshotRegistry,
+        snapshot_from_fit,
+    )
+    from hhmm_tpu.serve.online import LoglikCUSUM
+
+    B = args.series
+    n_hist = 64
+    stream = min(args.ticks, 160) if args.quick else args.ticks
+    tail_len, eval_ticks = 32, 8
+    shift_at = n_hist + 2 + 16
+    draws = min(args.serve_draws, 8) if args.quick else args.serve_draws
+    model = TayalHHMM(gate_mode="hard")
+    T_total = n_hist + 2 + stream
+    # same workload construction as --maint: peaked emission rows, and
+    # the mid-stream alphabet reversal as the hard distribution shift
+    x, sign = _tayal_batch(B, T_total, seed=42, alpha=0.5)
+    x_np, s_np = np.asarray(x), np.asarray(sign)
+    x_alt = (8 - x_np).astype(x_np.dtype)
+    names = [f"a{i:04d}" for i in range(B)]
+
+    # ---- one history fit, shared by every arm ----
+    fit_cfg = GibbsConfig(
+        num_warmup=30 if args.quick else 100,
+        num_samples=max(8 * draws, 64),
+        num_chains=1,
+    )
+    t0 = perf_counter()
+    samples, stats = fit_batched(
+        model,
+        {"x": x[:, :n_hist], "sign": sign[:, :n_hist]},
+        jax.random.PRNGKey(0),
+        fit_cfg,
+        chunk_size=min(args.chunk, B),
+    )
+    fit_s = perf_counter() - t0
+    healthy = np.asarray(stats["chain_healthy"]).reshape(B, -1)
+    snaps = {}
+    for i, name in enumerate(names):
+        snaps[name] = snapshot_from_fit(
+            model,
+            np.asarray(samples[i]),
+            chain_healthy=healthy[i],
+            n_draws=draws,
+            meta={"series": i, "n_hist": n_hist},
+        )
+
+    refit_cfg = GibbsConfig(
+        num_warmup=20 if args.quick else 50,
+        num_samples=max(6 * draws, 48),
+        num_chains=1,
+    )
+
+    def make_arm(tag: str):
+        """One isolated arm: own registry tempdir, scheduler, metrics —
+        every arm replays the identical trace from the identical
+        promoted snapshots."""
+        root = tempfile.mkdtemp(prefix=f"adapt_{tag}_")
+        atexit.register(shutil.rmtree, root, ignore_errors=True)
+        registry = SnapshotRegistry(root)
+        for name in names:
+            registry.promote(name, snaps[name])
+        metrics = ServeMetrics()
+        sched = MicroBatchScheduler(
+            model,
+            buckets=(8, 64, max(64, B)),
+            registry=registry,
+            metrics=metrics,
+            history_tail=tail_len,
+        )
+        sched.attach_many(
+            [
+                (
+                    name,
+                    registry.load_serving(name),
+                    {"x": x_np[i, :n_hist], "sign": s_np[i, :n_hist]},
+                    f"tenant{i % 4}",
+                )
+                for i, name in enumerate(names)
+            ]
+        )
+        return registry, sched, metrics
+
+    def make_loop(sched, registry, seed, adapt=None):
+        return MaintenanceLoop(
+            sched,
+            registry,
+            model,
+            refit_cfg,
+            jax.random.PRNGKey(seed),
+            policy=MaintenancePolicy(
+                min_interval_ticks=40, max_concurrent=max(4, B)
+            ),
+            eval_ticks=eval_ticks,
+            min_fit_ticks=16,
+            detector_factory=lambda sid: LoglikCUSUM(
+                series=sid, threshold=5.0, calibrate=12
+            ),
+            adapt=adapt,
+        )
+
+    def obs_for(i: int, t: int):
+        xx = x_alt if faults.regime_shift_active(t) else x_np
+        return {"x": int(xx[i, t]), "sign": int(s_np[i, t])}
+
+    def drive(sched, t: int):
+        for i, name in enumerate(names):
+            sched.submit(name, obs_for(i, t))
+        return sched.flush()
+
+    # preds[arm][(sid, t)] = one-step mixture predictive loglik under
+    # that arm's serving mixture — recorded BEFORE the weight update,
+    # so every value is a true forecast of tick t from data < t
+    preds = {"W": {}, "U": {}}
+
+    def record_preds(arm: str, ladder, responses, t: int) -> None:
+        for r in responses:
+            if r.shed or r.per_draw_loglik is None:
+                continue
+            if arm == "W":
+                lw = ladder.sched.weight_state_of(r.series_id)
+                if lw is None:
+                    lw = uniform_log_weights(r.per_draw_loglik.shape[-1])
+                v = weighted_mixture_loglik(lw, r.per_draw_loglik, r.draw_ok)
+            else:
+                v = uniform_mixture_loglik(r.per_draw_loglik, r.draw_ok)
+            preds[arm][(r.series_id, t)] = float(v)
+
+    # ---- arm U: uniform-stale (no adaptation, no maintenance) ----
+    _, sched_u, _ = make_arm("u")
+    for t in range(n_hist, n_hist + 2):
+        drive(sched_u, t)
+    with faults.inject(faults.RegimeShiftPlan(at_tick=shift_at)):
+        for t in range(n_hist + 2, n_hist + 2 + stream):
+            record_preds("U", None, drive(sched_u, t), t)
+
+    # ---- arm M: refit-only baseline (PR 14 ladder-less loop) ----
+    reg_m, sched_m, _ = make_arm("m")
+    loop_m = make_loop(sched_m, reg_m, seed=7)
+    for t in range(n_hist, n_hist + 2):
+        loop_m.observe(drive(sched_m, t))
+    with faults.inject(faults.RegimeShiftPlan(at_tick=shift_at)):
+        for t in range(n_hist + 2, n_hist + 2 + stream):
+            loop_m.observe(drive(sched_m, t))
+            loop_m.maybe_maintain()
+    stanza_m = loop_m.stanza()
+
+    # ---- arm W: the full ladder (reweight → rejuvenate → refit) ----
+    reg_w, sched_w, metrics_w = make_arm("w")
+    ladder = AdaptationLadder(
+        sched_w, jax.random.PRNGKey(11), escalate_after=2
+    )
+    loop_w = make_loop(sched_w, reg_w, seed=7, adapt=ladder)
+    t0 = perf_counter()
+    for t in range(n_hist, n_hist + 2):
+        resp = drive(sched_w, t)
+        ladder.observe(resp)
+        loop_w.observe(resp)
+    # warm the full post-warmup signature surface: the promotion-swap
+    # replay AND the batched rejuvenation kernel must both land their
+    # compiles before the measured window
+    warm_swap_reason = sched_w.swap_snapshot(names[0])
+    ladder.rejuvenate([names[0]], reason="warmup")
+    warmup_s = perf_counter() - t0
+    compiles_warm = metrics_w.compile_count
+    rejuv_compiles_warm = ladder.rejuvenator.compile_count
+    metrics_w.reset_throughput_window()
+
+    t0 = perf_counter()
+    with faults.inject(faults.RegimeShiftPlan(at_tick=shift_at)):
+        for t in range(n_hist + 2, n_hist + 2 + stream):
+            resp = drive(sched_w, t)
+            record_preds("W", ladder, resp, t)
+            ladder.observe(resp)
+            loop_w.observe(resp)
+            loop_w.maybe_maintain()
+    replay_s = perf_counter() - t0
+    compiles_after_warmup = (
+        (metrics_w.compile_count - compiles_warm)
+        + (ladder.rejuvenator.compile_count - rejuv_compiles_warm)
+    )
+    stanza_w = loop_w.stanza()
+    stanza = ladder.stanza()
+    summary = metrics_w.summary()
+
+    # ---- tracking gate: W vs U on the SAME post-shift ticks, paired
+    # per series AND pooled across the fleet (the --maint recovery-gate
+    # discipline: identical observations, deltas cancel shared noise) ----
+    per_series = []
+    pooled = []
+    for sid in names:
+        deltas = [
+            preds["W"][(sid, t)] - preds["U"][(sid, t)]
+            for t in range(shift_at, n_hist + 2 + stream)
+            if (sid, t) in preds["W"] and (sid, t) in preds["U"]
+            and np.isfinite(preds["W"][(sid, t)])
+            and np.isfinite(preds["U"][(sid, t)])
+        ]
+        if deltas:
+            pooled.extend(deltas)
+            per_series.append(
+                {
+                    "series": sid,
+                    "ticks": len(deltas),
+                    "mean_delta": round(float(np.mean(deltas)), 4),
+                }
+            )
+    paired_mean = (
+        float(np.mean([p["mean_delta"] for p in per_series]))
+        if per_series
+        else float("nan")
+    )
+    pooled_mean = float(np.mean(pooled)) if pooled else float("nan")
+    tracking_advantage = bool(
+        per_series and paired_mean > 0 and pooled_mean > 0
+    )
+
+    # ---- ESS-recovery gate: rejuvenation ran and restored ESS above
+    # the planner-derived floor (weights reset to uniform => ESS = D;
+    # the event ledger pins before/after per move) ----
+    rejuv_events = [
+        e for e in stanza["events"] if e.get("kind") == "rejuvenate"
+    ]
+    floor = ladder.ess_floor(draws)
+    ess_recovered = bool(
+        stanza["rejuvenations"] > 0
+        and rejuv_events
+        and all(e["ess_after"] >= floor for e in rejuv_events)
+    )
+
+    failures = []
+    if warm_swap_reason is not None:
+        failures.append(f"warmup swap rejected: {warm_swap_reason}")
+    if stanza["reweight_ticks"] == 0:
+        failures.append("no tick ever reweighted (rung 1 never engaged)")
+    if not tracking_advantage:
+        failures.append(
+            "adaptive arm did not beat the uniform-stale arm on "
+            f"post-shift ticks (paired mean {round(paired_mean, 4)}, "
+            f"pooled mean {round(pooled_mean, 4)} nats/tick)"
+        )
+    if not ess_recovered:
+        failures.append(
+            "no rejuvenation restored ESS above the floor "
+            f"(rejuvenations={stanza['rejuvenations']}, floor={floor})"
+        )
+    if compiles_after_warmup != 0:
+        failures.append(
+            f"{compiles_after_warmup} XLA compiles after warmup "
+            "(reweighting/rejuvenation must land in already-compiled "
+            "shapes)"
+        )
+    if not stanza_w["refits"] < stanza_m["refits"]:
+        failures.append(
+            "adaptation did not reduce warm refits vs the refit-only "
+            f"baseline (adaptive={stanza_w['refits']}, "
+            f"baseline={stanza_m['refits']})"
+        )
+
+    # the bench-computed verdicts ride the stanza into the manifest —
+    # scripts/bench_diff.py gates tracking_advantage true→false and
+    # floor-breach 0→>0 transitions between comparable records
+    stanza["tracking_advantage"] = tracking_advantage
+    stanza["paired_mean_delta"] = (
+        round(paired_mean, 4) if np.isfinite(paired_mean) else None
+    )
+    stanza["pooled_mean_delta"] = (
+        round(pooled_mean, 4) if np.isfinite(pooled_mean) else None
+    )
+    stanza["refits_adaptive"] = stanza_w["refits"]
+    stanza["refits_baseline"] = stanza_m["refits"]
+
+    n_timed = summary["ticks"]
+    record = stamp_record(
+        {
+            "metric": "tayal_adapt_tick_throughput",
+            "value": round(n_timed / replay_s, 1) if replay_s > 0 else None,
+            "unit": "ticks/sec",
+            "series": B,
+            "draws_per_series": draws,
+            "ticks_streamed": stream,
+            "shift_at_tick": shift_at,
+            "fit_s": round(fit_s, 3),
+            "warmup_s": round(warmup_s, 3),
+            "replay_s": round(replay_s, 3),
+            "reweight_ticks": stanza["reweight_ticks"],
+            "rejuvenations": stanza["rejuvenations"],
+            "escalations": stanza["escalations"],
+            "ess_min": stanza["ess_min"],
+            "paired_mean_delta": stanza["paired_mean_delta"],
+            "pooled_mean_delta": stanza["pooled_mean_delta"],
+            "refits_adaptive": stanza_w["refits"],
+            "refits_baseline": stanza_m["refits"],
+            "promotions_adaptive": stanza_w["promotions"],
+            "latency_p50_ms": summary["latency_p50_ms"],
+            "latency_p99_ms": summary["latency_p99_ms"],
+            "compile_count": summary["compile_count"],
+            "compiles_after_warmup": compiles_after_warmup,
+            "backend": backend["backend"],
+            "backend_fallback": backend["fallback"],
+            "degraded_cpu_smoke": degraded,
+        },
+        args,
+        model=model,
+    )
+    record["manifest"]["adapt"] = stanza
+    record["manifest"]["maint"] = stanza_w
+    print(json.dumps(record))
+    print(
+        "# adapt "
+        + ("CLOSED-LOOP OK" if not failures else "FAILED")
+        + f": reweight_ticks={stanza['reweight_ticks']} "
+        f"rejuvenations={stanza['rejuvenations']} "
+        f"escalations={stanza['escalations']} "
+        f"paired={stanza['paired_mean_delta']} "
+        f"pooled={stanza['pooled_mean_delta']} "
+        f"refits W/M={stanza_w['refits']}/{stanza_m['refits']} "
+        f"compiles_after_warmup={compiles_after_warmup}",
+        file=sys.stderr,
+    )
+    emit_manifest(args, "adapt", record, model=model)
+    if failures:
+        for f in failures:
+            print(f"# adapt FAILED: {f}", file=sys.stderr)
+        sys.exit(1)
+
+
 def plan_sweep(args, backend, topologies) -> None:
     """``--plan-sweep``: planned vs naive single-axis layouts over
     synthetic multi-device topologies (virtual CPU devices — the same
@@ -1942,6 +2319,20 @@ def main() -> None:
         "ladder fails to engage",
     )
     ap.add_argument(
+        "--adapt",
+        action="store_true",
+        help="run the tick-cadence adaptation closed-loop demo instead "
+        "of the fit bench: three arms stream the same regime-shifted "
+        "trace — adaptive (draw reweighting + Liu-West rejuvenation + "
+        "ladder-gated refits, hhmm_tpu/adapt/), uniform-stale, and the "
+        "refit-only maintenance baseline; exits nonzero unless the "
+        "adaptive arm strictly beats uniform-stale on post-shift "
+        "one-step predictive loglik (paired and pooled), rejuvenation "
+        "restores ESS above the planner floor, zero XLA compiles land "
+        "after warmup, and the adaptive arm refits strictly less than "
+        "the baseline (see docs/maintenance.md's three-rung ladder)",
+    )
+    ap.add_argument(
         "--storm-registered",
         type=int,
         default=1000,
@@ -2124,6 +2515,10 @@ def main() -> None:
 
     if args.maint:
         maint_bench(args, backend, degraded)
+        return
+
+    if args.adapt:
+        adapt_bench(args, backend, degraded)
         return
 
     from __graft_entry__ import _tayal_batch

@@ -26,6 +26,10 @@ Layer map (see SURVEY.md §7):
   compile/memory telemetry, run manifests (`docs/observability.md`).
 - ``serve``    — streaming inference service: online forward-filter core,
   posterior snapshot registry, micro-batching tick scheduler, metrics.
+- ``adapt``    — tick-cadence online adaptation: per-draw reweighting of
+  the serving particle cloud, ESS-triggered Liu–West rejuvenation, and
+  the reweight → rejuvenate → refit escalation ladder
+  (`docs/maintenance.md`).
 - ``maint``    — drift-triggered maintenance plane: debounced refit
   triggers, sliding-window warm refits, champion/challenger shadow
   evaluation, atomic snapshot promotion (`docs/maintenance.md`).

@@ -1,0 +1,46 @@
+"""hhmm_tpu.adapt — tick-cadence online parameter adaptation.
+
+The serving snapshot's D thinned draws, treated as a per-series
+particle cloud (Liu & West 2001 / Storvik 2002): per-draw log-weights
+updated every tick from the one-step predictive increments the tick
+kernels already produce, an ESS-triggered batched Liu–West
+rejuvenation move, and an escalation ladder that makes the PR 14 warm
+refit the *last* resort instead of the only one —
+
+    reweight (free, every tick)
+      → rejuvenate (cheap, on ESS collapse / first alarm)
+        → refit (expensive, only when tracking persistently fails).
+
+Layering (docs/architecture.md): rank 6 — above serve (the scheduler
+stores the opaque weight state and exposes the per-draw signal; all
+weight *math* lives here) and below maint (whose loop routes alarms
+through :class:`~hhmm_tpu.adapt.ladder.AdaptationLadder` before
+escalating to refits). ``adapt → serve/plan/obs/core`` imports are
+legal; ``serve → adapt`` and ``adapt → maint`` are back-edges the
+``layer-import`` analysis rule rejects.
+"""
+
+from .ladder import AdaptationLadder
+from .rejuvenate import Rejuvenator, liu_west_move
+from .weights import (
+    ess,
+    normalized_weights,
+    uniform_log_weights,
+    uniform_mixture_loglik,
+    update_log_weights,
+    weighted_mixture_loglik,
+    weighted_state_probs,
+)
+
+__all__ = [
+    "AdaptationLadder",
+    "Rejuvenator",
+    "liu_west_move",
+    "ess",
+    "normalized_weights",
+    "uniform_log_weights",
+    "uniform_mixture_loglik",
+    "update_log_weights",
+    "weighted_mixture_loglik",
+    "weighted_state_probs",
+]

@@ -1,0 +1,288 @@
+"""The escalation ladder: reweight → rejuvenate → refit.
+
+This is the adaptation plane's control loop, sitting between the
+scheduler (whose responses carry the per-draw reweighting signal and
+whose tables store the opaque weight state) and the maintenance plane
+(whose CUSUM alarms and warm refits are the expensive last resort):
+
+- **Rung 1 — reweight** (every tick, free): fold each non-shed
+  response's ``per_draw_loglik`` into the series' log-weights
+  (`adapt/weights.py`), publish streaming ESS.
+- **Rung 2 — rejuvenate** (on ESS collapse or a first CUSUM alarm,
+  cheap): a batched Liu–West move (`adapt/rejuvenate.py`) restores
+  cloud diversity; weights reset to uniform. Due series are padded to
+  the scheduler's bucket ladder so the move always lands on
+  already-compiled shapes, and a planner-derived per-flush budget
+  (`plan.Plan.admission_caps` ``max_rejuv_per_flush``) bounds the
+  work one flush can absorb.
+- **Rung 3 — escalate** (persistent alarms only): an alarm that
+  survives ``escalate_after`` adapted windows means reweighting and
+  rejuvenation cannot track the shift — the posterior itself is
+  wrong — and only then does the alarm fall through to
+  `maint/loop.py`'s debounced ``warm_refit`` path. Promotion resets
+  weights to uniform (the swap's committed attach clears the stored
+  state) and clears the strike counter.
+
+The ESS floor is planner-derived: ``ess_floor_frac`` (from
+``admission_caps``) × the snapshot draw count D. Counters/gauges are
+the always-on ``adapt.*`` instruments (`serve/metrics.AdaptMetrics`).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from hhmm_tpu.obs import manifest as obs_manifest
+from hhmm_tpu.serve.metrics import AdaptMetrics
+
+from . import weights as W
+from .rejuvenate import Rejuvenator
+
+__all__ = ["AdaptationLadder"]
+
+# keep the manifest stanza's event window bounded (maint/loop.py's
+# max_events discipline): cumulative truth lives in the counters
+_MAX_EVENTS = 64
+
+
+class AdaptationLadder:
+    """One ladder per scheduler. Drive it right after each flush:
+    ``ladder.observe(responses)``; wire it into the maintenance loop
+    (``MaintenanceLoop(..., adapt=ladder)``) so alarms climb the rungs
+    in order instead of jumping straight to refit."""
+
+    def __init__(
+        self,
+        scheduler,
+        key,
+        *,
+        plan=None,
+        ess_floor_frac: Optional[float] = None,
+        max_rejuv_per_flush: Optional[int] = None,
+        forget: float = 0.99,
+        shrink: float = 0.98,
+        escalate_after: int = 2,
+        metrics: Optional[AdaptMetrics] = None,
+    ):
+        self.sched = scheduler
+        caps: Dict[str, Any] = {}
+        if plan is not None:
+            caps = plan.admission_caps()
+        if ess_floor_frac is None:
+            ess_floor_frac = float(caps.get("ess_floor_frac", 0.5))
+        if max_rejuv_per_flush is None:
+            mr = caps.get("max_rejuv_per_flush")
+            max_rejuv_per_flush = int(mr) if mr is not None else None
+        if not (0.0 < float(ess_floor_frac) <= 1.0):
+            raise ValueError(
+                f"ess_floor_frac must be in (0, 1], got {ess_floor_frac}"
+            )
+        if int(escalate_after) < 1:
+            raise ValueError(
+                f"escalate_after must be >= 1, got {escalate_after}"
+            )
+        self.ess_floor_frac = float(ess_floor_frac)
+        self.max_rejuv_per_flush = max_rejuv_per_flush
+        self.forget = float(forget)
+        self.escalate_after = int(escalate_after)
+        self.metrics = metrics if metrics is not None else AdaptMetrics()
+        self.rejuvenator = Rejuvenator(key, shrink=shrink)
+        self._ess: Dict[str, float] = {}
+        self._strikes: Dict[str, int] = {}
+        self._events: deque = deque(maxlen=_MAX_EVENTS)
+        self._ess_min_seen = float("inf")
+        self._tick = 0
+
+    # ---- rung 1: reweight ----
+
+    def ess_floor(self, n_draws: int) -> float:
+        """The absolute rejuvenation trigger for a D-draw cloud."""
+        return self.ess_floor_frac * float(n_draws)
+
+    def observe(self, responses) -> int:
+        """Fold one flush's responses into the weight plane. Returns
+        the number of series reweighted. Shed responses never touch
+        weights (nothing was folded into the filter, so there is no
+        increment — the PR 16 shed contract extends to weights);
+        series whose ESS fell below the floor are rejuvenated in one
+        batched move, under the per-flush budget."""
+        self._tick += 1
+        due: List[str] = []
+        n = 0
+        for r in responses:
+            if r.shed or r.per_draw_loglik is None:
+                continue
+            sid = r.series_id
+            lw = self.sched.weight_state_of(sid)
+            new = np.asarray(
+                W.update_log_weights(
+                    lw, r.per_draw_loglik, r.draw_ok, forget=self.forget
+                )
+            )
+            self.sched.set_weight_state(sid, new)
+            e = float(W.ess(new))
+            self._ess[sid] = e
+            n += 1
+            if e < self.ess_floor(new.shape[-1]):
+                due.append(sid)
+        if n:
+            self.metrics.note_reweight(n)
+            low = min(self._ess.values())
+            if low < self._ess_min_seen:
+                self._ess_min_seen = low
+            self.metrics.set_ess_min(self._ess_min_seen)
+        if due:
+            if self.max_rejuv_per_flush is not None:
+                due = due[: self.max_rejuv_per_flush]
+            self.rejuvenate(due, reason="ess_floor")
+        obs_manifest.note_stanza("adapt", self.stanza())
+        return n
+
+    # ---- rung 2: rejuvenate ----
+
+    def _bucketed(self, n: int) -> int:
+        buckets = getattr(self.sched, "buckets", None)
+        if not buckets:
+            return n
+        for b in buckets:
+            if n <= int(b):
+                return int(b)
+        return int(buckets[-1])
+
+    def rejuvenate(self, series_ids, *, reason: str = "explicit") -> int:
+        """Run the batched Liu–West move for these series, committing
+        each result through ``replace_draw_bank`` and resetting its
+        weights to uniform. Series that are unattached/unticked or
+        whose commit is refused are skipped (degrade-don't-raise).
+        Returns the number of series actually rejuvenated."""
+        todo = []
+        for sid in series_ids:
+            bank = self.sched.draw_bank_of(sid)
+            fs = self.sched.filter_state_of(sid)
+            if bank is None or fs is None:
+                continue
+            lw = self.sched.weight_state_of(sid)
+            if lw is None:
+                lw = W.uniform_log_weights(int(bank.shape[0]))
+            todo.append((sid, bank, np.asarray(lw), fs))
+        done = 0
+        max_b = self._bucketed(len(todo)) if todo else 0
+        while todo:
+            chunk = todo[:max_b]
+            todo = todo[max_b:]
+            bn = self._bucketed(len(chunk))
+            # pad to the bucket by repeating the last entry — the
+            # scheduler's own lane-padding policy, so the move only
+            # ever compiles on the bucket ladder's shapes
+            lanes = [chunk[min(i, len(chunk) - 1)] for i in range(bn)]
+            draws_b = jnp.stack([c[1] for c in lanes])
+            lw_b = jnp.stack([jnp.asarray(c[2]) for c in lanes])
+            alpha_b = jnp.stack([c[3][0] for c in lanes])
+            ll_b = jnp.stack([c[3][1] for c in lanes])
+            ok_b = jnp.stack([c[3][2] for c in lanes])
+            new_draws, new_alpha, new_ll, new_ok = self.rejuvenator.move(
+                draws_b, lw_b, alpha_b, ll_b, ok_b
+            )
+            for i, (sid, bank, lw, _) in enumerate(chunk):
+                ess_before = self._ess.get(sid)
+                err = self.sched.replace_draw_bank(
+                    sid, new_draws[i], new_alpha[i], new_ll[i], new_ok[i]
+                )
+                if err is not None:
+                    continue
+                n_draws = int(bank.shape[0])
+                self.sched.set_weight_state(
+                    sid, W.uniform_log_weights(n_draws)
+                )
+                self._ess[sid] = float(n_draws)
+                self.metrics.note_rejuvenation()
+                self._events.append(
+                    {
+                        "kind": "rejuvenate",
+                        "series": sid,
+                        "tick": self._tick,
+                        "reason": reason,
+                        "ess_before": None
+                        if ess_before is None
+                        else round(ess_before, 3),
+                        "ess_after": float(n_draws),
+                    }
+                )
+                done += 1
+        return done
+
+    # ---- rung 3: the alarm ladder (maint/loop.py integration) ----
+
+    def on_alarm(self, series_id: str) -> str:
+        """A CUSUM alarm climbed to us. The first ``escalate_after``
+        alarms per series are answered by an immediate rejuvenation
+        (``"rejuvenate"`` — the maintenance loop treats the alarm as
+        consumed); a persisting alarm returns ``"escalate"`` and falls
+        through to the debounced refit path. Strikes clear on
+        promotion (:meth:`on_promoted`)."""
+        strikes = self._strikes.get(series_id, 0) + 1
+        self._strikes[series_id] = strikes
+        if strikes > self.escalate_after:
+            self.metrics.note_escalation()
+            self._events.append(
+                {
+                    "kind": "escalate",
+                    "series": series_id,
+                    "tick": self._tick,
+                    "strikes": strikes,
+                }
+            )
+            return "escalate"
+        self.rejuvenate([series_id], reason="alarm")
+        return "rejuvenate"
+
+    def on_promoted(self, series_id: str) -> None:
+        """A refit's snapshot was promoted and swapped in: the new
+        posterior starts clean — strikes clear, and the committed
+        attach already reset the stored weights to uniform."""
+        self._strikes.pop(series_id, None)
+        self._ess.pop(series_id, None)
+
+    # ---- reporting ----
+
+    def stanza(self) -> Dict[str, Any]:
+        """The ``adapt`` manifest stanza: cumulative counters, the
+        per-series ESS table, and the recent event window — rendered
+        by `scripts/obs_report.py` as ``== adaptation ==`` and gated
+        by `scripts/bench_diff.py` (tracking-advantage and ESS-floor
+        regressions)."""
+        m = self.metrics
+        ess_tbl = [
+            {"series": sid, "ess": round(e, 3)}
+            for sid, e in sorted(self._ess.items())
+        ]
+        floors = [
+            e < self.ess_floor(n)
+            for e, n in (
+                (e, self._n_draws_of(sid)) for sid, e in self._ess.items()
+            )
+            if n is not None
+        ]
+        return {
+            "ess_floor_frac": self.ess_floor_frac,
+            "forget": self.forget,
+            "shrink": self.rejuvenator.shrink,
+            "escalate_after": self.escalate_after,
+            "reweight_ticks": m.reweight_ticks,
+            "rejuvenations": m.rejuvenations,
+            "escalations": m.escalations,
+            "ess_min": None
+            if not np.isfinite(self._ess_min_seen)
+            else round(self._ess_min_seen, 3),
+            "floor_breaches": int(sum(floors)),
+            "ess": ess_tbl,
+            "events": list(self._events),
+        }
+
+    def _n_draws_of(self, series_id: str) -> Optional[int]:
+        bank = self.sched.draw_bank_of(series_id)
+        return None if bank is None else int(bank.shape[0])

@@ -1,0 +1,147 @@
+"""Liu–West rejuvenation: resample + kernel-shrinkage jitter.
+
+When a series' ESS collapses (most weight on a handful of draws), the
+cloud has stopped being a useful posterior approximation: reweighting
+alone can only *remove* diversity from a frozen bank. The classic
+repair (Liu & West 2001) is a kernel-smoothed resample in parameter
+space:
+
+1. systematic resample of the D draws by their normalized weights
+   (low-variance inverse-CDF with one uniform offset),
+2. shrink each survivor toward the weighted mean,
+   ``θ* ← a·θ + (1-a)·m̄``, and
+3. jitter with the complementary kernel variance,
+   ``θ' = θ* + ε,  ε ~ N(0, h²·diag V̄),  h² = 1-a²``,
+
+so the rejuvenated cloud keeps the weighted first two moments of the
+degenerate one (up to the diagonal-covariance approximation — the
+standard practical simplification; the free space is already whitened
+per-coordinate by the bijector transforms) while restoring D distinct
+support points. Everything happens in UNCONSTRAINED space: the draw
+bank the scheduler serves is exactly the flat ``[D, n_free]`` free
+vector that `core/bijectors` maps to constrained parameters inside
+``model.unpack``, so shrinkage/jitter arithmetic is closed — no
+simplex renormalization, no ordering repair.
+
+The filter state rides along: resampling draws means resampling their
+``(log_alpha, loglik, ok)`` lanes with the SAME indices — a draw and
+its filter history are one particle. Running logliks therefore become
+non-comparable across the move; the scheduler's
+``replace_draw_bank`` bumps the attach generation so the maintenance
+plane's detectors drop the spanning increment (the PR 14 contract).
+
+One batched jitted kernel processes every due series in a flush:
+``[N, D, P]`` with N padded to the scheduler's bucket ladder by the
+ladder, D and dtype preserved exactly (the fixed-D compile contract
+and the pager's byte arithmetic both survive). Seeded by splitting the
+caller-owned key per call — never reused (`analysis/prng.py`
+discipline).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from hhmm_tpu.core.lmath import safe_log_normalize
+from hhmm_tpu.obs.telemetry import register_jit
+
+__all__ = ["Rejuvenator", "liu_west_move"]
+
+
+def liu_west_move(draws, log_w, alpha, ll, ok, keys, shrink):
+    """One Liu–West move over a batch of series (pure, jit-traced).
+
+    ``draws [N, D, P]`` unconstrained banks, ``log_w [N, D]``
+    log-weights (need not be normalized), ``alpha [N, D, K]`` /
+    ``ll [N, D]`` / ``ok [N, D]`` the per-draw filter state,
+    ``keys [N]`` one PRNG key per series, ``shrink`` the static
+    Liu–West ``a`` ∈ (0, 1). Returns ``(draws', alpha', ll', ok')``
+    with identical shapes/dtypes. A series whose cloud is entirely
+    dead (no finite weight) passes through unchanged — degraded, not
+    raised; the ladder's strike counter escalates it to a refit.
+    """
+    a = float(shrink)
+    h2 = 1.0 - a * a
+
+    def one_series(dr, lw, al, l, okd, key):
+        dt = dr.dtype
+        n_draws = dr.shape[0]
+        # dead draws can never be resampled: mask before normalizing
+        lwm = jnp.where(okd, lw, -jnp.inf)
+        lwn = safe_log_normalize(lwm, axis=-1)
+        w = jnp.exp(lwn).astype(dt)  # all-dead -> all zeros
+        any_alive = jnp.isfinite(lwn).any()
+        k_u, k_n = jax.random.split(key)
+        # systematic (low-variance) inverse-CDF resample
+        u0 = jax.random.uniform(k_u, (), dtype=dt)
+        pos = (u0 + jnp.arange(n_draws, dtype=dt)) / float(n_draws)
+        cdf = jnp.cumsum(w)
+        idx = jnp.clip(jnp.searchsorted(cdf, pos), 0, n_draws - 1)
+        # weighted moments of the OLD cloud (diagonal covariance)
+        m = jnp.sum(w[:, None] * dr, axis=0)  # [P]
+        v = jnp.sum(w[:, None] * (dr - m) ** 2, axis=0)  # [P]
+        shrunk = a * dr[idx] + (1.0 - a) * m
+        noise = jax.random.normal(k_n, dr.shape, dtype=dt) * jnp.sqrt(
+            jnp.asarray(h2, dt) * v
+        )
+        new_dr = shrunk + noise
+        sel = any_alive
+        return (
+            jnp.where(sel, new_dr, dr),
+            jnp.where(sel, al[idx], al),
+            jnp.where(sel, l[idx], l),
+            jnp.where(sel, okd[idx], okd),
+        )
+
+    return jax.vmap(one_series)(draws, log_w, alpha, ll, ok, keys)
+
+
+class Rejuvenator:
+    """Owns the jitted Liu–West kernel and the PRNG stream.
+
+    ``shrink`` is the Liu–West ``a`` (default 0.98 ≈ discount
+    δ≈0.97: gentle smoothing that keeps the cloud's moments while
+    restoring support). The kernel is registered with the compile
+    registry (``adapt.rejuvenate``) so run manifests attribute its
+    specializations and the bench's compile-flatness gate covers it —
+    one compile per padded batch-bucket shape, none after warmup.
+    """
+
+    def __init__(self, key, *, shrink: float = 0.98):
+        if not (0.0 < float(shrink) < 1.0):
+            raise ValueError(f"shrink must be in (0, 1), got {shrink}")
+        self.shrink = float(shrink)
+        self._key = key
+        self._j = register_jit(
+            "adapt.rejuvenate",
+            jax.jit(liu_west_move, static_argnames=("shrink",)),
+        )
+
+    @property
+    def compile_count(self) -> int:
+        """Distinct traced signatures of the rejuvenation kernel — the
+        bench's compile-flatness gate reads this alongside the
+        scheduler's ``compile_count`` (one per batch-bucket shape,
+        flat after warmup)."""
+        cache_size = getattr(self._j, "_cache_size", None)
+        return int(cache_size()) if callable(cache_size) else 0
+
+    def move(self, draws, log_w, alpha, ll, ok) -> Tuple:
+        """Run one batched move; advances the owned key (split per
+        call, never reused). Inputs/outputs as :func:`liu_west_move`
+        minus the key axis."""
+        n = jnp.asarray(draws).shape[0]
+        self._key, sub = jax.random.split(self._key)
+        keys = jax.random.split(sub, n)
+        return self._j(
+            jnp.asarray(draws),
+            jnp.asarray(log_w),
+            jnp.asarray(alpha),
+            jnp.asarray(ll),
+            jnp.asarray(ok),
+            keys,
+            shrink=self.shrink,
+        )

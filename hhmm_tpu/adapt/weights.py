@@ -1,0 +1,164 @@
+"""Per-series draw reweighting: the adaptation plane's state.
+
+The serving snapshot's D thinned draws are a discrete posterior
+approximation; between refits they are FROZEN while the market drifts.
+This module turns them into a particle cloud in the style of Liu & West
+(2001) / Storvik (2002): a per-series ``[D]`` log-weight vector,
+updated every tick from the per-draw one-step predictive loglik
+increments the tick kernels already produce
+(``TickResponse.per_draw_loglik``), so the served mixture tilts toward
+the draws that explain the *recent* data — at tick cadence, for the
+cost of a ``[D]`` logsumexp.
+
+Conventions (shared with the serving hot path):
+
+- Every normalization routes through :func:`core.lmath.safe_logsumexp`
+  / :func:`~hhmm_tpu.core.lmath.safe_log_normalize` — an all-dead
+  cloud degrades (uniform restart / ``-inf`` mixture), it never NaNs.
+- Dead draws (``ok=False`` from the chain-health guard, or a
+  non-finite increment) carry ``-inf`` log-weight: they can never
+  re-enter the mixture, exactly as the tick response excludes them.
+- A *tempering/forgetting* exponent ``forget ∈ (0, 1]`` discounts old
+  evidence geometrically: ``log w' ∝ forget · log w + inc``. At 1.0
+  weights accumulate the full history (fastest degeneracy, sharpest
+  tracking); below 1.0 the effective evidence window is
+  ``~1/(1-forget)`` ticks.
+- Effective sample size is the streaming ``ESS = 1 / Σ ŵ_d²`` on the
+  normalized weights — D when uniform, →1 as the cloud degenerates.
+  The ladder (`adapt/ladder.py`) rejuvenates below a planner-derived
+  floor (`plan.Plan.admission_caps`).
+
+All functions accept batched ``[..., D]`` inputs and are cheap eager
+jnp ops — per-flush host work, not a jitted kernel (the per-draw
+increments already crossed to host with the responses).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from hhmm_tpu.core.lmath import safe_log_normalize, safe_logsumexp
+
+__all__ = [
+    "uniform_log_weights",
+    "update_log_weights",
+    "ess",
+    "weighted_mixture_loglik",
+    "uniform_mixture_loglik",
+    "normalized_weights",
+    "weighted_state_probs",
+]
+
+
+def uniform_log_weights(n_draws: int, dtype=np.float32) -> np.ndarray:
+    """The normalized uniform log-weight vector ``[-log D] * D`` — the
+    state of a freshly attached (or just-rejuvenated) series."""
+    return np.full((int(n_draws),), -np.log(float(n_draws)), dtype=dtype)
+
+
+def update_log_weights(
+    log_w,
+    inc,
+    ok=None,
+    *,
+    forget: float = 1.0,
+):
+    """One reweighting step: ``log w' ∝ forget · log w + inc``.
+
+    ``log_w`` ``[..., D]`` normalized log-weights (``None`` = uniform),
+    ``inc`` ``[..., D]`` the per-draw one-step predictive increments,
+    ``ok`` optional ``[..., D]`` health mask. Draws that are unhealthy
+    or produced a non-finite increment go to ``-inf`` — permanently,
+    until a rejuvenation or snapshot swap resets the cloud. A tick
+    that kills EVERY draw resets that series to uniform instead of an
+    all-``-inf`` state (degrade-don't-poison: the tick response keeps
+    averaging frozen draws in the same situation, and an all-``-inf``
+    vector would make every later mixture ``-inf`` forever).
+
+    Returns normalized log-weights with the same trailing ``D``.
+    """
+    if not (0.0 < float(forget) <= 1.0):
+        raise ValueError(f"forget must be in (0, 1], got {forget}")
+    inc = jnp.asarray(inc)
+    if log_w is None:
+        lw = jnp.full_like(inc, -jnp.log(float(inc.shape[-1])))
+    else:
+        lw = safe_log_normalize(jnp.asarray(log_w, dtype=inc.dtype), axis=-1)
+    # forget * (-inf) = -inf for forget > 0: a dead draw stays dead
+    # through tempering
+    lw = lw * jnp.asarray(forget, inc.dtype)
+    alive = jnp.isfinite(inc)
+    if ok is not None:
+        alive = alive & jnp.asarray(ok).astype(bool)
+    lw = jnp.where(alive, lw + jnp.where(alive, inc, 0.0), -jnp.inf)
+    any_alive = jnp.any(jnp.isfinite(lw), axis=-1, keepdims=True)
+    uniform = jnp.full_like(lw, -jnp.log(float(lw.shape[-1])))
+    lw = jnp.where(any_alive, lw, uniform)
+    return safe_log_normalize(lw, axis=-1)
+
+
+def ess(log_w) -> jnp.ndarray:
+    """Streaming effective sample size ``1 / Σ ŵ_d²`` on the
+    normalized weights: D when uniform, 1 when one draw carries all
+    the mass, 0.0 for an all-dead (all-``-inf``) cloud."""
+    lw = safe_log_normalize(jnp.asarray(log_w), axis=-1)
+    s2 = safe_logsumexp(2.0 * lw, axis=-1)
+    return jnp.where(jnp.isfinite(s2), jnp.exp(-s2), 0.0)
+
+
+def weighted_mixture_loglik(log_w, inc, ok=None) -> jnp.ndarray:
+    """The weighted one-step predictive ``log Σ_d ŵ_d exp(inc_d)`` —
+    what the adapted mixture assigned to this tick's observation (the
+    tracking metric ``bench.py --adapt`` duels against the uniform
+    arm). Dead draws are excluded; an all-dead cloud yields ``-inf``
+    (impossible evidence ranks below any possible one)."""
+    inc = jnp.asarray(inc)
+    lw = safe_log_normalize(
+        jnp.asarray(log_w, dtype=inc.dtype), axis=-1
+    )
+    alive = jnp.isfinite(inc)
+    if ok is not None:
+        alive = alive & jnp.asarray(ok).astype(bool)
+    contrib = jnp.where(alive, lw + jnp.where(alive, inc, 0.0), -jnp.inf)
+    return safe_logsumexp(contrib, axis=-1)
+
+
+def uniform_mixture_loglik(inc, ok=None) -> jnp.ndarray:
+    """The uniform-mixture one-step predictive over the alive draws:
+    ``logsumexp(inc) - log(n_alive)`` — the stale baseline the serving
+    plane implied before adaptation (same convention as
+    `maint/shadow.py::predictive_logliks`)."""
+    inc = jnp.asarray(inc)
+    alive = jnp.isfinite(inc)
+    if ok is not None:
+        alive = alive & jnp.asarray(ok).astype(bool)
+    contrib = jnp.where(alive, inc, -jnp.inf)
+    n_alive = alive.sum(axis=-1)
+    lse = safe_logsumexp(contrib, axis=-1)
+    return jnp.where(
+        n_alive > 0,
+        lse - jnp.log(jnp.maximum(n_alive, 1).astype(inc.dtype)),
+        -jnp.inf,
+    )
+
+
+def normalized_weights(log_w) -> np.ndarray:
+    """``exp`` of the normalized log-weights — the nonnegative measure
+    `serve/online.py::posterior_predictive_mean` (and any other
+    uniform-average consumer) accepts as ``weights=`` for a weighted
+    mixture response. Dead draws come out exactly 0."""
+    return np.asarray(jnp.exp(safe_log_normalize(jnp.asarray(log_w), axis=-1)))
+
+
+def weighted_state_probs(log_w, log_alpha) -> np.ndarray:
+    """Weighted-mixture filtered state probabilities ``[..., K]`` from
+    per-draw normalized filters ``log_alpha [..., D, K]`` — the
+    adapted replacement for the tick response's uniform
+    ``probs`` average (top-state calls, regime dashboards)."""
+    log_alpha = jnp.asarray(log_alpha)
+    lw = safe_log_normalize(
+        jnp.asarray(log_w, dtype=log_alpha.dtype), axis=-1
+    )
+    w = jnp.exp(lw)
+    return np.asarray((w[..., None] * jnp.exp(log_alpha)).sum(axis=-2))

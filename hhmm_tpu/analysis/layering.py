@@ -12,9 +12,10 @@ accrete silently.
 Ranks (higher may import lower; equal ranks may NOT import each
 other — siblings stay decoupled)::
 
-    8  viz
-    7  apps
-    6  maint
+    9  viz
+    8  apps
+    7  maint
+    6  adapt
     5  serve
     4  models, batch
     3  infer, plan
@@ -22,12 +23,17 @@ other — siblings stay decoupled)::
     1  obs
     0  core, hhmm, sim, native, robust, analysis
 
-``maint`` (the drift-triggered maintenance plane, PR 14) sits between
-``serve`` and ``apps``: it consumes the serving plane (scheduler,
-registry, drift detectors) and the batch fit path, and apps/benches
-orchestrate it — serve must never know maintenance exists (the
-measured signals flow up, the promoted snapshots flow down through
-the registry/scheduler contracts).
+``maint`` (the drift-triggered maintenance plane, PR 14) sits above
+``serve``: it consumes the serving plane (scheduler, registry, drift
+detectors) and the batch fit path, and apps/benches orchestrate it —
+serve must never know maintenance exists (the measured signals flow
+up, the promoted snapshots flow down through the registry/scheduler
+contracts). ``adapt`` (the tick-cadence adaptation plane, PR 17)
+slots between them: it reads the scheduler's per-draw response signal
+and writes back opaque weight state / rejuvenated banks through
+serve's adaptation surface, while ``maint`` calls DOWN into its
+escalation ladder — so serve must not import adapt, and adapt must
+not import maint.
 
 ``import hhmm_tpu`` (the root package: version metadata only) is
 allowed from anywhere. Function-scoped (lazy) imports are findings
@@ -58,9 +64,10 @@ LAYERS = {
     "models": 4,
     "batch": 4,
     "serve": 5,
-    "maint": 6,
-    "apps": 7,
-    "viz": 8,
+    "adapt": 6,
+    "maint": 7,
+    "apps": 8,
+    "viz": 9,
 }
 
 
@@ -189,7 +196,8 @@ class LayerImportRule(Rule):
     title = "imports follow the layering DAG (no back-edges)"
     doc = (
         "core ← obs ← kernels ← infer/plan ← models/batch ← serve ← "
-        "maint ← apps ← viz: imports must point strictly down the ranks; "
+        "adapt ← maint ← apps ← viz: imports must point strictly down "
+        "the ranks; "
         "same-rank siblings stay decoupled. A back-edge couples a "
         "substrate to its consumer and breeds import cycles. Deliberate "
         "lazy cycle-breaking imports carry an inline pragma with a "

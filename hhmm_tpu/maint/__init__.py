@@ -26,9 +26,14 @@ staleness gauge — and this plane *acts* on them:
   promote, with ``maint.*`` product counters and the ``maint``
   manifest stanza.
 
-Layering: ``maint`` sits between ``serve`` and ``apps`` in the
+Layering: ``maint`` sits between ``adapt`` and ``apps`` in the
 enforced DAG (`hhmm_tpu/analysis/layering.py`) — it may import
-serve/batch/models and below; apps may orchestrate it.
+adapt/serve/batch/models and below; apps may orchestrate it. The
+adaptation plane (`hhmm_tpu/adapt/`) is the rung BELOW refits:
+``MaintenanceLoop(..., adapt=AdaptationLadder(...))`` routes CUSUM
+alarms through reweight→rejuvenate first, and only a persisting alarm
+escalates into the refit queue (docs/maintenance.md's three-rung
+ladder).
 """
 
 from hhmm_tpu.maint.loop import MaintenanceLoop, MaintMetrics

@@ -148,6 +148,7 @@ class MaintenanceLoop:
         retry=None,
         max_events: int = 32,
         staleness_sweep_every: int = 64,
+        adapt=None,
     ):
         if scheduler.history_tail <= 0:
             raise ValueError(
@@ -176,6 +177,12 @@ class MaintenanceLoop:
                 f"{staleness_sweep_every}"
             )
         self.staleness_sweep_every = int(staleness_sweep_every)
+        # the adaptation ladder (hhmm_tpu/adapt/ladder.py, a rank
+        # BELOW maint — we call down, it never calls up): when wired,
+        # CUSUM alarms climb reweight→rejuvenate first and only a
+        # persisting alarm escalates into the refit queue; promotions
+        # report back so strikes/weights reset with the new posterior
+        self.adapt = adapt
         self._factory = detector_factory or (
             lambda sid: LoglikCUSUM(series=sid)
         )
@@ -246,6 +253,17 @@ class MaintenanceLoop:
                 # evidence restarts, and the spanning "increment" would
                 # be a phantom jump of the whole evidence scale
                 _, alarmed = st["det"].update(ll - prev)
+            if alarmed and not st.get("owed") and self.adapt is not None:
+                # the escalation ladder's cheap rung: a fresh alarm is
+                # first answered by a Liu–West rejuvenation; only an
+                # alarm that persists through the configured number of
+                # adapted windows falls through to the refit queue.
+                # OWED alarms already escalated — they stay owed to
+                # the policy, not to the ladder (re-rejuvenating while
+                # a refit is stuck would mask the very signal the
+                # policy is waiting to act on).
+                if self.adapt.on_alarm(sid) == "rejuvenate":
+                    continue
             if alarmed or st.get("owed"):
                 # an alarm CONSUMES the detector (it re-baselines on
                 # the post-shift distribution — the alarm-storm fix),
@@ -369,6 +387,13 @@ class MaintenanceLoop:
                 eval_tail,
                 margin=self.margin,
                 series_id=sid,
+                # with the ladder wired, the champion defends under its
+                # ADAPTED mixture — the same tilt the responses serve
+                champion_weights=(
+                    sched.weight_state_of(sid)
+                    if self.adapt is not None
+                    else None
+                ),
             )
             if verdict.accepted:
                 result = promote_snapshot(sched, reg, sid, cand)
@@ -388,6 +413,11 @@ class MaintenanceLoop:
                     st["det"].reset()
                     st["ll"] = None
                     st["gen"] = None
+                    if self.adapt is not None:
+                        # promotion resets the ladder too: strikes
+                        # clear, and the swap's committed attach
+                        # already reset the weights to uniform
+                        self.adapt.on_promoted(sid)
                 else:
                     self.metrics._failed_swaps.inc()
                 self._events.append(

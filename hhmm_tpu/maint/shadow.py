@@ -114,7 +114,12 @@ class ShadowVerdict:
         }
 
 
-def predictive_logliks(model, snap, eval_data: Dict[str, Any]) -> np.ndarray:
+def predictive_logliks(
+    model,
+    snap,
+    eval_data: Dict[str, Any],
+    weights=None,
+) -> np.ndarray:
     """Per-tick one-step posterior-predictive loglik [T] of ``snap``'s
     posterior mixture over ``eval_data``.
 
@@ -124,6 +129,11 @@ def predictive_logliks(model, snap, eval_data: Dict[str, Any]) -> np.ndarray:
     ``M[t] = lse_d(L_d[t]) − log D`` and the per-tick predictive is its
     increment ``M[t] − M[t−1]`` (with ``M[0]`` the first tick's own
     evidence) — exact under the equal-weight posterior-draw mixture.
+    ``weights`` (optional ``[D]`` log-weights, the adaptation plane's
+    per-series state) replaces the equal-weight mixture with the
+    weighted one, ``M[t] = lse_d(log ŵ_d + L_d[t])``, renormalized
+    over the finite draws — shadow evaluation then judges snapshots on
+    the same tilted mixture the adapted responses actually serve.
     Draws whose final evidence is non-finite (NaN parameters, dead
     filters) are excluded from the mixture; with no finite draw at all
     every tick reads ``-inf`` (an unservable posterior must LOSE the
@@ -139,10 +149,20 @@ def predictive_logliks(model, snap, eval_data: Dict[str, Any]) -> np.ndarray:
     # pass over the same eval-tail shape) reuse one compiled program
     lls = np.asarray(_evidence_fn(model)(draws, data))  # [D, T]
     finite = np.isfinite(lls[:, -1])
+    if weights is not None:
+        lw = np.asarray(weights, np.float64).reshape(-1)
+        finite = finite & np.isfinite(lw)
     if not finite.any():
         return np.full(lls.shape[1], -np.inf)
     kept = jnp.asarray(np.where(finite[:, None], lls, -np.inf))
-    mix = np.asarray(safe_logsumexp(kept, axis=0)) - np.log(finite.sum())
+    if weights is None:
+        mix = np.asarray(safe_logsumexp(kept, axis=0)) - np.log(finite.sum())
+    else:
+        # renormalize the log-weights over the surviving draws so the
+        # mixture stays a probability mixture even after exclusions
+        lw_kept = jnp.asarray(np.where(finite, lw, -np.inf))
+        lw_norm = lw_kept - safe_logsumexp(lw_kept, axis=-1)
+        mix = np.asarray(safe_logsumexp(lw_norm[:, None] + kept, axis=0))
     out = np.empty_like(mix)
     out[0] = mix[0]
     out[1:] = np.diff(mix)
@@ -157,9 +177,18 @@ def shadow_evaluate(
     *,
     margin: float = 0.0,
     series_id: str = "",
+    champion_weights=None,
 ) -> ShadowVerdict:
     """Judge ``challenger`` against ``champion`` on the held-out tail.
-    See the module docstring for the acceptance rule."""
+    See the module docstring for the acceptance rule.
+
+    ``champion_weights`` (optional ``[D]`` log-weights) scores the
+    champion under its CURRENT adapted mixture rather than the uniform
+    one: with the adaptation plane active, the serving responses are
+    already tilted, so the bar a refit must clear is the tilted
+    champion — a fresh candidate only displaces a posterior the cheap
+    rungs could not rescue. The challenger is always uniform (a fresh
+    refit has no weight history)."""
     sizes = {int(np.asarray(v).shape[0]) for v in eval_data.values()}
     if len(sizes) != 1 or 0 in sizes:
         raise ValueError(
@@ -167,7 +196,9 @@ def shadow_evaluate(
             f"got lengths {sorted(sizes)}"
         )
     T = sizes.pop()
-    d_champ = predictive_logliks(model, champion, eval_data)
+    d_champ = predictive_logliks(
+        model, champion, eval_data, weights=champion_weights
+    )
     d_chall = predictive_logliks(model, challenger, eval_data)
     mean_champ = float(np.mean(d_champ))
     mean_chall = float(np.mean(d_chall))

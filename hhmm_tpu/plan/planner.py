@@ -145,7 +145,9 @@ class Plan:
         flush_factor: int = 4,
         per_series: int = 2,
         credit_factor: int = 1,
-    ) -> Dict[str, int]:
+        ess_floor_frac: float = 0.5,
+        rejuv_factor: int = 1,
+    ) -> Dict[str, Any]:
         """Shed-aware admission caps derived from the planner-owned
         serve bucket ladder (the scheduler's
         ``AdmissionPolicy.from_plan`` consumes this — serve owns the
@@ -157,13 +159,29 @@ class Plan:
         credit a tenant can bank between flushes (``credit_factor``
         largest-buckets' worth): a starved tenant can reclaim at most
         one extra bucket-ladder rung per flush, so its recovery burst
-        also drains in already-compiled shapes."""
+        also drains in already-compiled shapes.
+
+        The adaptation plane's knobs ride along (consumed by
+        `hhmm_tpu/adapt/ladder.py`, dropped by
+        ``AdmissionPolicy.from_plan``): ``ess_floor_frac`` is the
+        rejuvenation trigger as a fraction of the snapshot draw count
+        (ESS below it means the particle cloud has degenerated), and
+        ``max_rejuv_per_flush`` bounds how many series one flush may
+        rejuvenate — ``rejuv_factor`` largest-buckets' worth, so the
+        batched Liu–West move also always lands in already-compiled
+        bucket shapes."""
         top = int(self.buckets[-1])
+        if not (0.0 < float(ess_floor_frac) <= 1.0):
+            raise ValueError(
+                f"ess_floor_frac must be in (0, 1], got {ess_floor_frac}"
+            )
         return {
             "max_queue_depth": max(1, int(depth_factor)) * top,
             "max_ticks_per_flush": max(1, int(flush_factor)) * top,
             "max_pending_per_series": max(1, int(per_series)),
             "credit_cap_ticks": max(1, int(credit_factor)) * top,
+            "ess_floor_frac": float(ess_floor_frac),
+            "max_rejuv_per_flush": max(1, int(rejuv_factor)) * top,
         }
 
     # ---- placement objects (the ONLY construction site outside

@@ -49,7 +49,7 @@ from hhmm_tpu.obs import metrics as obs_metrics
 from hhmm_tpu.obs import request as obs_request
 from hhmm_tpu.obs import telemetry
 
-__all__ = ["ServeMetrics", "SLOSpec", "evaluate_slo"]
+__all__ = ["AdaptMetrics", "ServeMetrics", "SLOSpec", "evaluate_slo"]
 
 
 class ServeMetrics:
@@ -386,6 +386,66 @@ class ServeMetrics:
             "device_loss_events": self.device_loss_events,
             "compile_count": int(self.compile_count),
         }
+
+
+# ---- adaptation-plane metrics (hhmm_tpu/adapt) ----
+
+
+class AdaptMetrics:
+    """Always-on counters/gauges for the tick-cadence adaptation plane
+    (`hhmm_tpu/adapt/`): how often weights moved, how degenerate the
+    particle cloud got, and how far up the escalation ladder
+    (reweight → rejuvenate → refit, docs/maintenance.md) each window
+    climbed. Lives in serve/ — not adapt/ — so the import stays DOWN
+    the layer DAG (adapt ranks above serve) and the instruments share
+    the scheduler metrics' attach-once registry discipline. Product
+    metrics like ``ServeMetrics``: they record regardless of the trace
+    flag. NOT in ``ServeMetrics.summary()`` (schema frozen); read them
+    from the properties or the shared registry exports."""
+
+    def __init__(self):
+        self._reweight_ticks = obs_metrics.Counter()
+        self._rejuvenations = obs_metrics.Counter()
+        self._escalations = obs_metrics.Counter()
+        # the smallest effective sample size observed across the fleet
+        # since the last set — the degeneracy watermark the ESS-floor
+        # gate (scripts/bench_diff.py) reads
+        self._ess_min = obs_metrics.Gauge()
+        for name, inst in (
+            ("adapt.reweight_ticks", self._reweight_ticks),
+            ("adapt.rejuvenations", self._rejuvenations),
+            ("adapt.escalations", self._escalations),
+            ("adapt.ess_min", self._ess_min),
+        ):
+            obs_metrics.attach(name, inst)
+
+    def note_reweight(self, n: int = 1) -> None:
+        self._reweight_ticks.inc(n)
+
+    def note_rejuvenation(self, n: int = 1) -> None:
+        self._rejuvenations.inc(n)
+
+    def note_escalation(self, n: int = 1) -> None:
+        self._escalations.inc(n)
+
+    def set_ess_min(self, v: float) -> None:
+        self._ess_min.set(v)
+
+    @property
+    def reweight_ticks(self) -> int:
+        return int(self._reweight_ticks.get())
+
+    @property
+    def rejuvenations(self) -> int:
+        return int(self._rejuvenations.get())
+
+    @property
+    def escalations(self) -> int:
+        return int(self._escalations.get())
+
+    @property
+    def ess_min(self) -> float:
+        return float(self._ess_min.get())
 
 
 # ---- serve SLOs ----

@@ -192,28 +192,36 @@ def posterior_predictive_mean(
     per-draw transitions, ``state_means`` [D, K] per-draw emission means
     (``mu_k``). Per draw: ``E[x_{t+1} | x_{1:t}, θ_d] = Σ_j p(z_{t+1}=j)
     μ_{d,j}``; the returned scalar is the (``weights``-)averaged draw
-    mean — the Monte Carlo posterior-predictive mean. Pass the
-    scheduler's per-draw health mask as ``weights`` so quarantined
-    draws (non-finite parameters, frozen stale filters) cannot poison
-    the forecast. An all-zero mask falls back to averaging whatever
-    per-draw forecasts are still FINITE — stricter than the tick
-    response's all-frozen-draws average, because a frozen filter can be
-    finite while its NaN parameters still poison the forecast side."""
+    mean — the Monte Carlo posterior-predictive mean. ``weights`` is a
+    nonnegative measure over draws: pass the scheduler's per-draw
+    health mask for the classic masked average, or the adaptation
+    plane's normalized particle weights (``exp`` of ``adapt.weights``'
+    log-weights) for a weighted mixture forecast — fractional values
+    are honored, NOT binarized into a mask. A weight vector with no
+    surviving mass falls back to averaging whatever per-draw forecasts
+    are still FINITE — stricter than the tick response's
+    all-frozen-draws average, because a frozen filter can be finite
+    while its NaN parameters still poison the forecast side."""
     pred = jax.vmap(
         lambda a, lA: jnp.exp(predictive_state_logprobs(a, lA))
     )(log_alpha, log_A)
     per_draw = jnp.sum(pred * state_means, axis=-1)  # [D]
     if weights is None:
         return jnp.mean(per_draw)
-    w = (jnp.asarray(weights) > 0).astype(per_draw.dtype)
-    # masked draws must be *zeroed*, not just zero-weighted: a NaN
-    # parameter draw would survive `NaN * 0`. With every draw
-    # quarantined, fall back to whatever per-draw values are still
-    # finite (frozen filters can forecast even when the mask is down);
-    # only a series with NO finite draw value at all yields NaN — the
-    # genuinely-undefined case, which arrives alongside a
-    # ``degraded=True`` tick response consumers must gate on.
+    w = jnp.asarray(weights).astype(per_draw.dtype)
+    w = jnp.where(jnp.isfinite(w) & (w > 0), w, 0.0)
+    # zero-weight and non-finite draws must be *zeroed*, not just
+    # zero-weighted: a NaN parameter draw would survive `NaN * 0`. A
+    # weighted draw whose own forecast is non-finite also contributes
+    # nothing (its mass sheds; the mixture renormalizes over the
+    # survivors). With every draw quarantined, fall back to whatever
+    # per-draw values are still finite (frozen filters can forecast
+    # even when the mask is down); only a series with NO finite draw
+    # value at all yields NaN — the genuinely-undefined case, which
+    # arrives alongside a ``degraded=True`` tick response consumers
+    # must gate on.
     finite = jnp.isfinite(per_draw).astype(per_draw.dtype)
+    w = w * finite
     w = jnp.where(jnp.sum(w) > 0, w, finite)
     vals = jnp.where(w > 0, per_draw, 0.0)
     return jnp.sum(vals * w) / jnp.sum(w)

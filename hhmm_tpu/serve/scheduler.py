@@ -144,6 +144,16 @@ class TickResponse:
     latency_s: float
     shed: bool = False
     error: Optional[str] = None
+    # per-draw one-step predictive loglik increments [D] and the
+    # per-draw health mask [D] for this tick — the adaptation plane's
+    # (`hhmm_tpu/adapt/`) reweighting inputs, computed from the tick
+    # kernels' existing per-draw running logliks (no extra kernel
+    # output, so the per-bucket compile contract is untouched). Frozen
+    # (quarantined) draws contribute a 0.0 increment with ok=False.
+    # ``None`` on shed responses: a shed tick folded nothing, so there
+    # is no increment and weights must not move (adapt relies on this).
+    per_draw_loglik: Optional[np.ndarray] = None
+    draw_ok: Optional[np.ndarray] = None
 
 
 @dataclass(frozen=True)
@@ -224,14 +234,20 @@ class AdmissionPolicy:
         capacity-bounded flush — and a starved tenant's credit-funded
         recovery burst — always drains in already-compiled bucket
         shapes. ``tenant_shares``/``flush_order`` pass through as
-        keyword args (weights are deployment policy, not topology)."""
+        keyword args (weights are deployment policy, not topology).
+        The adaptation-plane caps that ``admission_caps`` also derives
+        (``ess_floor_frac``, ``max_rejuv_per_flush``) belong to
+        `hhmm_tpu/adapt/`, not to admission — dropped here."""
         shares = kw.pop("tenant_shares", None)
         order = kw.pop("flush_order", "drr")
+        caps = dict(plan.admission_caps(**kw))
+        for adapt_key in ("ess_floor_frac", "max_rejuv_per_flush"):
+            caps.pop(adapt_key, None)
         return cls(
             max_series=max_series,
             tenant_shares=shares,
             flush_order=order,
-            **plan.admission_caps(**kw),
+            **caps,
         )
 
 
@@ -400,6 +416,16 @@ class MicroBatchScheduler:
         # Survives detach (pager evictions must not strip a series'
         # tenant) but LRU-bounded at TENANT_BINDINGS_CAP.
         self._tenant_of: "OrderedDict[str, str]" = OrderedDict()
+        # adaptation-plane weight state per series (hhmm_tpu/adapt/):
+        # OPAQUE to the scheduler — serve ranks below adapt in the
+        # import DAG, so all weight math lives up there and this table
+        # only provides the lifecycle: survives detach like the tail
+        # (a pager eviction must not cost learned weights; submit()'s
+        # warm page-in restores it bitwise around the re-attach),
+        # reset by any other committed attach (swap_snapshot: new
+        # draws, uniform weights), released by unregister(); LRU-
+        # bounded like the tenant bindings
+        self._weights: "OrderedDict[str, Any]" = OrderedDict()
         self._undelivered: List[TickResponse] = []
         self._draws_cache: Dict[Tuple[str, ...], jnp.ndarray] = {}
         self._obs_dtypes: Dict[str, Any] = {}
@@ -444,7 +470,12 @@ class MicroBatchScheduler:
         denom = w.sum()
         probs = (jnp.exp(kept.log_alpha) * w[:, None]).sum(0) / denom
         mean_ll = (kept.loglik * w).sum() / denom
-        return kept.log_alpha, kept.loglik, okd, probs, mean_ll
+        # per-draw one-step predictive increment log p(x_t | x_{<t}, θ_d)
+        # — the adaptation plane's reweighting signal (TickResponse
+        # ``per_draw_loglik``). A frozen draw kept its previous running
+        # loglik, so its increment is exactly 0.0 (and okd marks it dead)
+        inc = kept.loglik - prev.loglik
+        return kept.log_alpha, kept.loglik, okd, probs, mean_ll, inc
 
     def _init_impl(self, draws, obs):
         """First tick of a batch of fresh series: α₀ from the model's
@@ -703,6 +734,14 @@ class MicroBatchScheduler:
             self._attach_gen[series_id] = (
                 self._attach_gen.get(series_id, 0) + 1
             )
+            # ...and replaces the DRAW BANK: adaptation-plane particle
+            # weights indexed against the old draws are meaningless for
+            # the new ones, so a committed attach resets them to
+            # uniform (= no stored state). The warm page-in path in
+            # submit() restores the saved state around this reset —
+            # the bank there is bitwise the one the weights were
+            # learned on.
+            self._weights.pop(series_id, None)
         for series_id in keeps:
             self._attach_t.setdefault(series_id, now)
         if self._attach_t:
@@ -844,6 +883,12 @@ class MicroBatchScheduler:
             self.pager.discard(series_id)  # no-op if the pager evicted us
         if rec is None:
             return False
+        if rec.get("rejuvenated"):
+            # a rejuvenated bank lives only in memory — a later page-in
+            # restores the ORIGINAL snapshot draws, so weights learned
+            # on the rejuvenated cloud would be mismatched; drop them
+            # (uniform restart) instead of replaying them bitwise
+            self._weights.pop(series_id, None)
         self._attach_t.pop(series_id, None)
         # the tenant binding deliberately SURVIVES detach: the pager's
         # eviction path lands here, and a paged-out series must come
@@ -851,6 +896,10 @@ class MicroBatchScheduler:
         # not escape its quota pool by having series page out and back
         # in). The entry is one small string per explicitly-tenanted
         # series; a later attach with a different tenant rebinds.
+        # The adaptation-plane weight state (self._weights) survives
+        # for the same reason: the paged-out draw bank comes back
+        # bitwise identical through the warm page-in, so the learned
+        # weights stay valid — eviction must not reset tracking.
         self._oldest_attach_t = (
             min(self._attach_t.values()) if self._attach_t else None
         )
@@ -875,8 +924,9 @@ class MicroBatchScheduler:
     def unregister(self, series_id: str) -> bool:
         """Full goodbye: :meth:`detach` plus everything detach
         deliberately retains — the history tail (the warm page-in
-        replay source), the tenant binding, and the attach-generation
-        counter. Use when a series is leaving the fleet for good;
+        replay source), the tenant binding, the attach-generation
+        counter, and the adaptation-plane weight state. Use when a
+        series is leaving the fleet for good;
         plain eviction should use :meth:`detach` (via the pager) so
         the series can page back in warm. Returns True if anything
         was released."""
@@ -885,6 +935,7 @@ class MicroBatchScheduler:
         self.metrics.note_tail_bytes(self._tail_bytes)
         released = (self._tenant_of.pop(series_id, None) is not None) or released
         released = (self._attach_gen.pop(series_id, None) is not None) or released
+        released = (self._weights.pop(series_id, None) is not None) or released
         return released
 
     def _drop_tail(self, series_id: str) -> bool:
@@ -1245,6 +1296,12 @@ class MicroBatchScheduler:
             # state matches the never-evicted stream over the tail
             # horizon instead of restarting cold from the snapshot
             hist = self.history_tail_of(series_id)
+            # the attach below resets adaptation weights (new bank =
+            # uniform weights, the right default for a swap) — but a
+            # page-in restores the SAME bank the weights were learned
+            # on (snapshots are immutable at rest), so save the state
+            # across the attach and replay it bitwise on commit
+            wstate = self._weights.get(series_id)
             rej = self.attach_many([(series_id, snap, hist)])
             if rej:
                 self._shed_now(
@@ -1255,6 +1312,9 @@ class MicroBatchScheduler:
                     trace=trace,
                 )
                 return
+            if wstate is not None:
+                self._weights[series_id] = wstate
+                self._weights.move_to_end(series_id)
             if hist is not None:
                 self.metrics.note_warm_page_in()
         pol = self.admission
@@ -1592,7 +1652,9 @@ class MicroBatchScheduler:
             # request plane's "form" share; the synced call below is
             # its "device" share
             self.recorder.stage(traces, "dispatch")
-            alpha, ll, okd, probs, mean_ll = jax.block_until_ready(fn(*fargs))
+            alpha, ll, okd, probs, mean_ll, inc = jax.block_until_ready(
+                fn(*fargs)
+            )
         self._obs_dtypes.update(dtype_locks)  # dispatch succeeded
         if self.profile_every and trace_enabled():
             # the sampled-flush profile target: this exact warm
@@ -1632,6 +1694,8 @@ class MicroBatchScheduler:
                     healthy_draws=n_ok,
                     degraded=degraded,
                     latency_s=done - t_submit,
+                    per_draw_loglik=np.asarray(inc[i]),
+                    draw_ok=np.asarray(okd[i]),
                 )
             )
         # respond: the post-process share ends with the built responses
@@ -1731,6 +1795,108 @@ class MicroBatchScheduler:
                 "is quarantined (healthy=False) and the serving state "
                 "is healthy — kept, not swapped"
             )
+        return None
+
+    # ---- adaptation surface (hhmm_tpu/adapt) ----
+
+    def weight_state_of(self, series_id: str):
+        """The adaptation plane's stored per-series weight state, or
+        ``None`` (= uniform weights / never adapted). OPAQUE here:
+        serve ranks below adapt in the import DAG, so the scheduler
+        stores but never interprets it. Lifecycle: survives
+        :meth:`detach` (and is replayed bitwise through warm
+        page-ins), reset to ``None`` by any other committed attach
+        (``swap_snapshot``: new draws, uniform weights), released by
+        :meth:`unregister`; shed ticks never touch it (no increment
+        was folded)."""
+        return self._weights.get(series_id)
+
+    def set_weight_state(self, series_id: str, state) -> None:
+        """Store (or with ``None``, clear) one series' adaptation
+        weight state. LRU-bounded at TENANT_BINDINGS_CAP like the
+        tenant bindings — at fleet scale a detached-forever series
+        must not pin host memory."""
+        if state is None:
+            self._weights.pop(series_id, None)
+            return
+        self._weights[series_id] = state
+        self._weights.move_to_end(series_id)
+        while len(self._weights) > TENANT_BINDINGS_CAP:
+            self._weights.popitem(last=False)
+
+    def draw_bank_of(self, series_id: str):
+        """The raw unconstrained draw bank ``[D, n_free]`` of one
+        attached series (``None`` when not attached) — the particle
+        cloud the adaptation plane resamples. Read-only by convention:
+        replacements go through :meth:`replace_draw_bank` so the
+        caches/generation bookkeeping stay consistent."""
+        rec = self._series.get(series_id)
+        return None if rec is None else rec["draws"]
+
+    def filter_state_of(self, series_id: str):
+        """``(log_alpha [D, K], loglik [D], ok [D])`` of one attached,
+        ticked series, or ``None`` — :meth:`state` minus the unpacked
+        constrained params (whose lazy jitted unpack the adaptation
+        plane's resample does not need and must not pay for)."""
+        rec = self._series.get(series_id)
+        if rec is None or rec["alpha"] is None:
+            return None
+        return rec["alpha"], rec["ll"], rec["ok"]
+
+    def replace_draw_bank(
+        self, series_id: str, draws, alpha, ll, ok
+    ) -> Optional[str]:
+        """In-place draw-bank replacement — the rejuvenation commit
+        (`hhmm_tpu/adapt/rejuvenate.py`): a resampled+jittered particle
+        cloud with its resampled filter state takes over serving for
+        one series. Returns ``None`` on success, else the rejection
+        reason (degrade-don't-raise: a refused replacement leaves the
+        serving state untouched).
+
+        The draw count AND dtype must match the current bank exactly —
+        the fixed-D compile contract and the pager's byte arithmetic
+        both assume the bank's shape/dtype never changes between
+        attaches. Commits like a mini-attach: the cached lane stacks
+        containing this series are invalidated, the unpacked-params
+        cache drops, and the attach generation bumps so the
+        maintenance plane's drift detectors drop the increment that
+        spans the discontinuity (the resampled running logliks are not
+        comparable to the pre-rejuvenation ones). The staleness clock
+        is deliberately NOT reset: the cloud still derives from the
+        same aging snapshot, and rejuvenation must not silence
+        staleness-triggered refits."""
+        rec = self._series.get(series_id)
+        if rec is None:
+            return f"series {series_id!r} is not attached"
+        if rec["alpha"] is None:
+            return f"series {series_id!r} has not received a tick yet"
+        cur = rec["draws"]
+        draws = jnp.asarray(draws)
+        if draws.shape != cur.shape or draws.dtype != cur.dtype:
+            return (
+                f"draw bank mismatch for {series_id!r}: got "
+                f"{draws.shape}/{draws.dtype}, serving "
+                f"{cur.shape}/{cur.dtype} (fixed-D contract)"
+            )
+        alpha = jnp.asarray(alpha, dtype=rec["alpha"].dtype)
+        ll = jnp.asarray(ll, dtype=rec["ll"].dtype)
+        ok = jnp.asarray(ok, dtype=rec["ok"].dtype)
+        if (
+            alpha.shape != rec["alpha"].shape
+            or ll.shape != rec["ll"].shape
+            or ok.shape != rec["ok"].shape
+        ):
+            return f"filter state shape mismatch for {series_id!r}"
+        rec["draws"], rec["alpha"], rec["ll"], rec["ok"] = draws, alpha, ll, ok
+        rec["params"] = None
+        # the bank now diverges from the snapshot at rest: an eviction
+        # would page the ORIGINAL snapshot back in, so the saved weight
+        # state must not be replayed over it (detach drops it)
+        rec["rejuvenated"] = True
+        self._draws_cache = {
+            k: v for k, v in self._draws_cache.items() if series_id not in k
+        }
+        self._attach_gen[series_id] = self._attach_gen.get(series_id, 0) + 1
         return None
 
     # ---- introspection ----

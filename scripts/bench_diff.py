@@ -67,6 +67,16 @@ Further gate rules:
   same workload is a closed-loop regression even if the bench's own
   gates were loosened. A first record with zero promotions is reported
   but has no promoting baseline, so it does not gate.
+- **Adaptation gates like resilience**: a record whose manifest stanza
+  carries an ``adapt`` stanza (`bench.py --adapt`, `hhmm_tpu/adapt/`)
+  fails the gate when a comparable baseline that TRACKED
+  (``tracking_advantage`` true — the reweighted/rejuvenated mixture
+  beat the uniform-stale arm post-shift) is followed by one that does
+  not, or when a baseline with zero ``floor_breaches`` is followed by
+  a record whose tracked series sit below the ESS floor — either way
+  the cheap rungs of the reweight→rejuvenate→refit ladder stopped
+  carrying their load. A first record without a tracking/clean
+  baseline is reported ungated.
 - **Request-plane health gates inverted too**: a record whose manifest
   stanza carries a ``request`` stanza (`hhmm_tpu/obs/request.py`,
   embedded by ``bench.py --serve`` / ``--serve-storm``) fails the gate
@@ -212,6 +222,8 @@ def diff(
     last_escaped_by_key: Dict[Tuple, int] = {}
     last_parity_by_key: Dict[Tuple, bool] = {}
     last_promotions_by_key: Dict[Tuple, int] = {}
+    last_tracking_by_key: Dict[Tuple, bool] = {}
+    last_breaches_by_key: Dict[Tuple, int] = {}
     last_costs_by_key: Dict[Tuple, Dict[str, float]] = {}
     last_request_by_key: Dict[Tuple, Dict[str, Optional[float]]] = {}
     failures = 0
@@ -405,6 +417,49 @@ def diff(
                 else:
                     row["status"] += f"; maint promotions {promos}"
                 last_promotions_by_key[key] = promos
+            # the adaptation plane rides the same key, gated like the
+            # resilience gate on two observables: the tracking verdict
+            # (weighted/rejuvenated arm beat uniform-stale post-shift)
+            # and ESS-floor breaches (tracked series whose weight
+            # cloud degenerated without a rejuvenation catching it)
+            adapt = (rec.get("manifest") or {}).get("adapt")
+            if isinstance(adapt, dict) and "tracking_advantage" in adapt:
+                tracking = bool(adapt.get("tracking_advantage"))
+                prev_tracking = last_tracking_by_key.get(key)
+                if prev_tracking is True and not tracking:
+                    failures += 1
+                    row["gated"] = True
+                    row["status"] += (
+                        "; ADAPTATION REGRESSION: tracking advantage "
+                        "lost (baseline beat the uniform-stale arm)"
+                    )
+                elif not tracking:
+                    row["status"] += (
+                        "; not tracking (no tracking baseline)"
+                    )
+                else:
+                    row["status"] += "; adaptation tracking"
+                last_tracking_by_key[key] = tracking
+            if isinstance(adapt, dict) and "floor_breaches" in adapt:
+                try:
+                    breaches = int(adapt.get("floor_breaches") or 0)
+                except (TypeError, ValueError):
+                    breaches = -1  # malformed: visible, never a baseline
+                prev_breaches = last_breaches_by_key.get(key)
+                if prev_breaches == 0 and breaches != 0:
+                    failures += 1
+                    row["gated"] = True
+                    row["status"] += (
+                        f"; ESS-FLOOR REGRESSION: {breaches} series "
+                        "below the floor (baseline was clean)"
+                    )
+                elif breaches != 0:
+                    row["status"] += (
+                        f"; {breaches} below ESS floor (no clean baseline)"
+                    )
+                else:
+                    row["status"] += "; ESS above floor"
+                last_breaches_by_key[key] = breaches
             # the request plane rides the same key, gated INVERTED
             # (lower is better): fairness-spread growth is tenant
             # starvation creeping in, queue-share growth is latency

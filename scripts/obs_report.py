@@ -546,6 +546,70 @@ def render_maint(man: Dict[str, Any], out) -> None:
         )
 
 
+def render_adapt(man: Dict[str, Any], out) -> None:
+    """The ``adapt`` stanza (`hhmm_tpu/adapt/`, `bench.py --adapt`):
+    the reweight→rejuvenate→refit ladder's counters, the per-series
+    streaming-ESS table, the recent rejuvenation/escalation events,
+    and the TRACKING/STALE verdict (did the adapted mixture beat the
+    uniform-stale arm on the post-shift ticks)."""
+    adapt = man.get("adapt") or _record_manifest(man).get("adapt")
+    if not isinstance(adapt, dict):
+        return  # no adaptation plane in this run: no section
+    _section("adaptation", out)
+    for key, label in (
+        ("ess_floor_frac", "ESS floor (fraction of D)"),
+        ("forget", "forgetting exponent"),
+        ("shrink", "Liu-West shrink a"),
+        ("escalate_after", "escalate after (strikes)"),
+        ("reweight_ticks", "reweighted ticks"),
+        ("rejuvenations", "rejuvenations"),
+        ("escalations", "escalations (-> refit queue)"),
+        ("ess_min", "ESS min (window)"),
+        ("floor_breaches", "series below floor"),
+        ("paired_mean_delta", "paired mean delta (nats/tick)"),
+        ("pooled_mean_delta", "pooled mean delta (nats/tick)"),
+        ("refits_adaptive", "refits (adaptive arm)"),
+        ("refits_baseline", "refits (refit-only baseline)"),
+    ):
+        if key in adapt:
+            print(f"  {label}: {_fmt(adapt.get(key))}", file=out)
+    ess = adapt.get("ess")
+    if isinstance(ess, list) and ess:
+        rows = [
+            (_fmt(e.get("series")), _fmt(e.get("ess")))
+            for e in ess
+            if isinstance(e, dict)
+        ]
+        _table(("series", "ESS"), rows, out)
+    events = adapt.get("events")
+    if isinstance(events, list) and events:
+        rows = []
+        for e in events:
+            if not isinstance(e, dict):
+                continue
+            rows.append(
+                (
+                    _fmt(e.get("tick")),
+                    _fmt(e.get("series")),
+                    _fmt(e.get("kind")),
+                    _fmt(e.get("reason") or e.get("strikes")),
+                    _fmt(e.get("ess_before")),
+                    _fmt(e.get("ess_after")),
+                )
+            )
+        _table(
+            ("tick", "series", "kind", "reason", "ESS before", "ESS after"),
+            rows,
+            out,
+        )
+    if "tracking_advantage" in adapt:
+        print(
+            "  verdict: "
+            + ("TRACKING" if adapt.get("tracking_advantage") else "STALE"),
+            file=out,
+        )
+
+
 def render_convergence(metrics: Dict[str, Dict[str, Any]], out) -> None:
     _section("convergence (interim, per fit chunk)", out)
     by_chunk: Dict[str, Dict[str, Any]] = {}
@@ -752,6 +816,7 @@ def render(
     render_request(man, out)
     render_storm(man, out)
     render_maint(man, out)
+    render_adapt(man, out)
     render_analysis(analysis if analysis is not None else man.get("analysis"), out)
     render_slo(man, out)
 

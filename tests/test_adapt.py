@@ -1,0 +1,776 @@
+"""Adaptation-plane suite (`hhmm_tpu/adapt/`, docs/maintenance.md's
+three-rung ladder — tier-1, fast).
+
+Pins the subsystem's contracts:
+
+- **weight math** (`adapt/weights.py`): normalized log-weight updates
+  with forgetting, dead-draw ``-inf`` discipline with the all-dead
+  uniform restart, streaming ESS bounds, and the weighted/uniform
+  mixture predictives the bench duels;
+- **Liu–West kernel** (`adapt/rejuvenate.py`): shape/dtype/draw-count
+  preservation, PRNG determinism, dead draws never resampled, the
+  all-dead passthrough, degenerate-weight collapse toward the
+  surviving particle;
+- **ladder** (`adapt/ladder.py`): reweight on observe (sheds never
+  touch weights), ESS-floor rejuvenation, the strike sequence
+  rejuvenate→rejuvenate→escalate, promotion clearing strikes, the
+  manifest stanza;
+- **maintenance routing** (`maint/loop.py`): a fresh CUSUM alarm is
+  consumed by the ladder; an escalated alarm falls through to the
+  refit queue; an OWED alarm never re-enters the ladder;
+- **weight-state lifecycle** (scheduler surface): survives
+  detach→warm page-in bitwise, reset by ``swap_snapshot``'s committed
+  attach, released by ``unregister``, never created for shed ticks;
+  a REJUVENATED bank's weights are dropped on detach (the paged-in
+  snapshot is not the bank they were learned on);
+- **weighted forecasts** (`serve/online.py`): fractional
+  ``posterior_predictive_mean`` weights are honored (not binarized),
+  non-finite draws are zeroed, zero-mass weights fall back to the
+  finite draws, and only a no-finite-draw series yields NaN.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hhmm_tpu.adapt import (
+    AdaptationLadder,
+    Rejuvenator,
+    ess,
+    liu_west_move,
+    normalized_weights,
+    uniform_log_weights,
+    uniform_mixture_loglik,
+    update_log_weights,
+    weighted_mixture_loglik,
+    weighted_state_probs,
+)
+from hhmm_tpu.models import MultinomialHMM
+from hhmm_tpu.serve import (
+    MicroBatchScheduler,
+    PosteriorSnapshot,
+    SnapshotRegistry,
+    model_spec,
+    posterior_predictive_mean,
+)
+from hhmm_tpu.serve.scheduler import TickResponse
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fake_snapshot(model, n_draws=6, scale=0.3, seed=0, healthy=True):
+    rng = np.random.default_rng(seed)
+    draws = (rng.normal(size=(n_draws, model.n_free)) * scale).astype(
+        np.float32
+    )
+    return PosteriorSnapshot(
+        spec=model_spec(model), draws=draws, healthy=healthy
+    )
+
+
+def _attached_sched(n_draws=4, history_tail=16, sid="s", buckets=(4,)):
+    """One MultinomialHMM series attached and ticked twice — the
+    minimal state every adaptation surface needs (a bank, a filter,
+    per-draw increments)."""
+    model = MultinomialHMM(K=2, L=3)
+    sched = MicroBatchScheduler(
+        model, buckets=buckets, history_tail=history_tail
+    )
+    sched.attach(sid, _fake_snapshot(model, n_draws=n_draws))
+    for t in range(2):
+        r = sched.tick({sid: {"x": t % 3}})[sid]
+        assert not r.shed
+    return model, sched, r
+
+
+class TestWeights:
+    def test_uniform_is_normalized_and_ess_is_d(self):
+        lw = uniform_log_weights(8)
+        assert lw.shape == (8,) and lw.dtype == np.float32
+        np.testing.assert_allclose(np.exp(lw).sum(), 1.0, rtol=1e-6)
+        np.testing.assert_allclose(float(ess(lw)), 8.0, rtol=1e-5)
+
+    def test_update_tilts_toward_better_draws(self):
+        inc = np.array([0.0, 0.0, 2.0, 0.0], np.float32)
+        lw = np.asarray(update_log_weights(None, inc))
+        w = normalized_weights(lw)
+        np.testing.assert_allclose(w.sum(), 1.0, rtol=1e-6)
+        assert np.argmax(w) == 2 and w[2] > 0.5
+        # a second identical increment sharpens further; ESS drops
+        lw2 = np.asarray(update_log_weights(lw, inc))
+        assert normalized_weights(lw2)[2] > w[2]
+        assert float(ess(lw2)) < float(ess(lw)) < 4.0
+
+    def test_forgetting_widens_the_window(self):
+        """forget < 1 discounts accumulated evidence: after the same
+        increments, the tempered weights are closer to uniform (higher
+        ESS) than the full-history ones."""
+        inc = np.array([0.0, 0.0, 1.5], np.float32)
+        full = tempered = None
+        for _ in range(6):
+            full = update_log_weights(full, inc, forget=1.0)
+            tempered = update_log_weights(tempered, inc, forget=0.5)
+        assert float(ess(tempered)) > float(ess(full))
+
+    @pytest.mark.parametrize("bad", [0.0, -0.1, 1.5])
+    def test_forget_validation(self, bad):
+        with pytest.raises(ValueError, match="forget"):
+            update_log_weights(None, np.zeros(3, np.float32), forget=bad)
+
+    def test_dead_draws_pinned_at_neg_inf(self):
+        inc = np.array([0.0, np.nan, 0.0, np.inf], np.float32)
+        ok = np.array([True, True, False, True])
+        lw = np.asarray(update_log_weights(None, inc, ok))
+        # non-finite increment (1, 3) and unhealthy (2) are all dead
+        assert np.isneginf(lw[[1, 2, 3]]).all() and np.isfinite(lw[0])
+        # dead draws stay dead through later updates and forgetting
+        lw2 = np.asarray(
+            update_log_weights(lw, np.zeros(4, np.float32), forget=0.9)
+        )
+        assert np.isneginf(lw2[[1, 2, 3]]).all()
+        assert normalized_weights(lw2)[0] == 1.0
+        np.testing.assert_allclose(float(ess(lw2)), 1.0, rtol=1e-5)
+
+    def test_all_dead_resets_to_uniform(self):
+        inc = np.full(4, np.nan, np.float32)
+        lw = np.asarray(update_log_weights(None, inc))
+        np.testing.assert_allclose(lw, uniform_log_weights(4), rtol=1e-6)
+        # but the pure all--inf vector reports ESS 0 (nothing alive)
+        assert float(ess(np.full(4, -np.inf, np.float32))) == 0.0
+
+    def test_mixture_logliks(self):
+        inc = np.array([1.0, -1.0, 0.0, np.nan], np.float32)
+        ok = np.array([True, True, False, True])
+        u = float(uniform_mixture_loglik(inc, ok))
+        # uniform over the 2 alive draws: logsumexp([1,-1]) - log 2
+        expect = np.log((np.exp(1.0) + np.exp(-1.0)) / 2.0)
+        np.testing.assert_allclose(u, expect, rtol=1e-5)
+        # with every draw alive, uniform weights ARE the uniform mixture
+        alive_inc = np.array([1.0, -1.0, 0.5, 0.0], np.float32)
+        np.testing.assert_allclose(
+            float(weighted_mixture_loglik(uniform_log_weights(4), alive_inc)),
+            float(uniform_mixture_loglik(alive_inc)),
+            rtol=1e-5,
+        )
+        # with dead draws, the weighted mixture SHEDS their mass (no
+        # renormalization — a dead draw's weight is lost evidence),
+        # here exactly the 2-of-4 alive fraction below the renormalized
+        # uniform baseline
+        lw = uniform_log_weights(4)
+        np.testing.assert_allclose(
+            float(weighted_mixture_loglik(lw, inc, ok)),
+            u - np.log(2.0),
+            rtol=1e-5,
+        )
+        # tilting toward the better draw beats the uniform mixture
+        tilt = np.log(
+            np.array([0.9, 0.1 / 3, 0.1 / 3, 0.1 / 3], np.float32)
+        )
+        assert float(weighted_mixture_loglik(tilt, inc, ok)) > u
+        # an all-dead cloud is -inf evidence, never NaN
+        dead = np.full(4, np.nan, np.float32)
+        assert np.isneginf(float(uniform_mixture_loglik(dead)))
+        assert np.isneginf(float(weighted_mixture_loglik(lw, dead)))
+
+    def test_weighted_state_probs(self):
+        la = np.log(
+            np.array([[0.9, 0.1], [0.2, 0.8]], np.float32)
+        )  # [D=2, K=2]
+        # uniform weights = plain draw average
+        p = weighted_state_probs(uniform_log_weights(2), la)
+        np.testing.assert_allclose(p, [0.55, 0.45], rtol=1e-5)
+        np.testing.assert_allclose(p.sum(), 1.0, rtol=1e-6)
+        # a one-hot weight selects its draw's filter
+        one_hot = np.array([0.0, -np.inf], np.float32)
+        np.testing.assert_allclose(
+            weighted_state_probs(one_hot, la), [0.9, 0.1], rtol=1e-5
+        )
+
+    def test_normalized_weights_zero_for_dead(self):
+        lw = np.array([0.0, -np.inf, 0.0], np.float32)
+        w = normalized_weights(lw)
+        assert w[1] == 0.0
+        np.testing.assert_allclose(w, [0.5, 0.0, 0.5], rtol=1e-6)
+
+
+class TestRejuvenator:
+    def _cloud(self, rng, n=2, d=6, p=5, k=3):
+        draws = rng.normal(size=(n, d, p)).astype(np.float32)
+        lw = rng.normal(size=(n, d)).astype(np.float32)
+        alpha = rng.normal(size=(n, d, k)).astype(np.float32)
+        ll = rng.normal(size=(n, d)).astype(np.float32)
+        ok = np.ones((n, d), bool)
+        return draws, lw, alpha, ll, ok
+
+    def test_shapes_dtypes_preserved_and_deterministic(self, rng):
+        draws, lw, alpha, ll, ok = self._cloud(rng)
+        r1 = Rejuvenator(jax.random.PRNGKey(0))
+        r2 = Rejuvenator(jax.random.PRNGKey(0))
+        out1 = r1.move(draws, lw, alpha, ll, ok)
+        out2 = r2.move(draws, lw, alpha, ll, ok)
+        for a, b, ref in zip(out1, out2, (draws, alpha, ll, ok)):
+            assert a.shape == ref.shape and a.dtype == ref.dtype
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # the cloud actually moved (resample + jitter)
+        assert not np.array_equal(np.asarray(out1[0]), draws)
+        # the owned key advances: a second move differs from the first
+        out3 = r1.move(draws, lw, alpha, ll, ok)
+        assert not np.array_equal(np.asarray(out3[0]), np.asarray(out1[0]))
+
+    def test_degenerate_weights_collapse_to_winner(self, rng):
+        """One-hot weights: every resampled particle descends from the
+        winning draw — shrunk toward it (the weighted mean IS the
+        winner) plus kernel noise scaled by the weighted variance,
+        which is 0 for a point mass, so the move is exact."""
+        draws, _, alpha, ll, ok = self._cloud(rng, n=1)
+        lw = np.full((1, 6), -np.inf, np.float32)
+        lw[0, 4] = 0.0
+        nd, na, nl, nk = Rejuvenator(jax.random.PRNGKey(3)).move(
+            draws, lw, alpha, ll, ok
+        )
+        np.testing.assert_allclose(
+            np.asarray(nd), np.broadcast_to(draws[:, 4:5], draws.shape),
+            rtol=0, atol=1e-5,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(na), np.broadcast_to(alpha[:, 4:5], alpha.shape)
+        )
+
+    def test_dead_draws_never_resampled(self, rng):
+        """Even with the HIGHEST log-weight, an ok=False draw cannot
+        appear in the rejuvenated cloud's ancestry."""
+        draws, _, alpha, ll, ok = self._cloud(rng, n=1)
+        draws[0, 2] = 100.0  # a poisoned, easily recognizable draw
+        lw = np.zeros((1, 6), np.float32)
+        lw[0, 2] = 50.0  # weight says "take me"
+        ok[0, 2] = False  # health says never
+        nd, _, _, nk = Rejuvenator(jax.random.PRNGKey(4)).move(
+            draws, lw, alpha, ll, ok
+        )
+        assert np.asarray(nd).max() < 50.0
+        assert np.asarray(nk).all()  # survivors are all healthy lanes
+
+    def test_all_dead_cloud_passes_through(self, rng):
+        draws, lw, alpha, ll, ok = self._cloud(rng, n=2)
+        ok[1] = False  # series 1: nothing alive to resample
+        nd, na, nl, nk = Rejuvenator(jax.random.PRNGKey(5)).move(
+            draws, lw, alpha, ll, ok
+        )
+        assert not np.array_equal(np.asarray(nd[0]), draws[0])
+        np.testing.assert_array_equal(np.asarray(nd[1]), draws[1])
+        np.testing.assert_array_equal(np.asarray(na[1]), alpha[1])
+        np.testing.assert_array_equal(np.asarray(nk[1]), ok[1])
+
+    @pytest.mark.parametrize("bad", [0.0, 1.0, -0.5, 1.5])
+    def test_shrink_validation(self, bad):
+        with pytest.raises(ValueError, match="shrink"):
+            Rejuvenator(jax.random.PRNGKey(0), shrink=bad)
+
+    def test_keeps_weighted_moments_approximately(self, rng):
+        """The Liu–West identity: the rejuvenated cloud's mean tracks
+        the weighted mean, and its spread does not explode (a·V + h²·V
+        = V in expectation)."""
+        d, p = 256, 3
+        draws = rng.normal(size=(1, d, p)).astype(np.float32)
+        lw = rng.normal(size=(1, d)).astype(np.float32)
+        alpha = np.zeros((1, d, 2), np.float32)
+        ll = np.zeros((1, d), np.float32)
+        ok = np.ones((1, d), bool)
+        (nd,) = Rejuvenator(jax.random.PRNGKey(7)).move(
+            draws, lw, alpha, ll, ok
+        )[:1]
+        w = np.exp(lw[0] - lw[0].max())
+        w /= w.sum()
+        m = (w[:, None] * draws[0]).sum(0)
+        v = (w[:, None] * (draws[0] - m) ** 2).sum(0)
+        nd = np.asarray(nd[0])
+        np.testing.assert_allclose(nd.mean(0), m, atol=4 * np.sqrt(v / d).max())
+        assert (nd.var(0) < 3 * v).all()
+
+
+def _resp(sid, inc, ok=None, shed=False):
+    """A minimal synthetic TickResponse for ladder-unit tests."""
+    d = 0 if inc is None else len(inc)
+    return TickResponse(
+        series_id=sid,
+        probs=np.array([0.5, 0.5]),
+        loglik=0.0,
+        healthy_draws=d,
+        degraded=False,
+        latency_s=0.0,
+        shed=shed,
+        per_draw_loglik=None if inc is None else np.asarray(inc, np.float32),
+        draw_ok=None if inc is None else (
+            np.ones(d, bool) if ok is None else np.asarray(ok, bool)
+        ),
+    )
+
+
+class TestAdaptationLadder:
+    def test_observe_reweights_and_skips_sheds(self):
+        model, sched, _ = _attached_sched(n_draws=4)
+        ladder = AdaptationLadder(sched, jax.random.PRNGKey(0))
+        inc = np.array([0.5, 0.0, 0.0, 0.0], np.float32)
+        n = ladder.observe(
+            [
+                _resp("s", inc),
+                _resp("ghost", None, shed=True),  # shed: no weights
+                _resp("noinc", None),  # no per-draw signal: skipped
+            ]
+        )
+        assert n == 1
+        lw = sched.weight_state_of("s")
+        assert lw is not None and lw.shape == (4,)
+        assert np.argmax(normalized_weights(lw)) == 0
+        assert sched.weight_state_of("ghost") is None
+        assert sched.weight_state_of("noinc") is None
+        assert ladder.metrics.reweight_ticks == 1
+        st = ladder.stanza()
+        assert st["reweight_ticks"] == 1 and st["rejuvenations"] == 0
+        assert st["ess"][0]["series"] == "s"
+
+    def test_ess_floor_triggers_batched_rejuvenation(self):
+        model, sched, _ = _attached_sched(n_draws=4)
+        ladder = AdaptationLadder(
+            sched, jax.random.PRNGKey(0), ess_floor_frac=0.9, forget=1.0
+        )
+        bank0 = np.asarray(sched.draw_bank_of("s"))
+        gen0 = sched.attach_generation("s")
+        # a brutal tilt: one draw dominates, ESS ~ 1 < floor 3.6
+        inc = np.array([50.0, 0.0, 0.0, 0.0], np.float32)
+        ladder.observe([_resp("s", inc)])
+        assert ladder.metrics.rejuvenations == 1
+        # the committed move: new bank (same shape/dtype), bumped
+        # generation, uniform weights, ESS restored to D
+        bank1 = np.asarray(sched.draw_bank_of("s"))
+        assert bank1.shape == bank0.shape and bank1.dtype == bank0.dtype
+        assert not np.array_equal(bank1, bank0)
+        assert sched.attach_generation("s") == gen0 + 1
+        np.testing.assert_allclose(
+            sched.weight_state_of("s"), uniform_log_weights(4), rtol=1e-6
+        )
+        ev = ladder.stanza()["events"]
+        assert ev and ev[-1]["kind"] == "rejuvenate"
+        assert ev[-1]["reason"] == "ess_floor"
+        assert ev[-1]["ess_after"] == 4.0 > ev[-1]["ess_before"]
+        # ticking still serves after the swap-in (filter state intact)
+        r = sched.tick({"s": {"x": 2}})["s"]
+        assert not r.shed and not r.degraded
+
+    def test_rejuvenation_budget_caps_per_flush(self):
+        model = MultinomialHMM(K=2, L=3)
+        sched = MicroBatchScheduler(model, buckets=(4,), history_tail=8)
+        snap = _fake_snapshot(model, n_draws=4)
+        sched.attach_many([(f"s{i}", snap, None) for i in range(3)])
+        for t in range(2):
+            sched.tick({f"s{i}": {"x": (t + i) % 3} for i in range(3)})
+        ladder = AdaptationLadder(
+            sched,
+            jax.random.PRNGKey(1),
+            ess_floor_frac=1.0,
+            max_rejuv_per_flush=1,
+        )
+        inc = np.array([9.0, 0.0, 0.0, 0.0], np.float32)
+        ladder.observe([_resp(f"s{i}", inc) for i in range(3)])
+        assert ladder.metrics.rejuvenations == 1  # budget, not 3
+
+    def test_plan_caps_feed_the_ladder(self):
+        class FakePlan:
+            def admission_caps(self):
+                return {"ess_floor_frac": 0.25, "max_rejuv_per_flush": 3}
+
+        model, sched, _ = _attached_sched()
+        ladder = AdaptationLadder(
+            sched, jax.random.PRNGKey(0), plan=FakePlan()
+        )
+        assert ladder.ess_floor_frac == 0.25
+        assert ladder.max_rejuv_per_flush == 3
+        assert ladder.ess_floor(8) == 2.0
+        # explicit kwargs beat the plan
+        l2 = AdaptationLadder(
+            sched, jax.random.PRNGKey(0), plan=FakePlan(), ess_floor_frac=0.5
+        )
+        assert l2.ess_floor_frac == 0.5
+
+    def test_constructor_validation(self):
+        model, sched, _ = _attached_sched()
+        with pytest.raises(ValueError, match="ess_floor_frac"):
+            AdaptationLadder(
+                sched, jax.random.PRNGKey(0), ess_floor_frac=0.0
+            )
+        with pytest.raises(ValueError, match="escalate_after"):
+            AdaptationLadder(
+                sched, jax.random.PRNGKey(0), escalate_after=0
+            )
+
+    def test_alarm_strikes_rejuvenate_then_escalate(self):
+        model, sched, _ = _attached_sched(n_draws=4)
+        ladder = AdaptationLadder(
+            sched, jax.random.PRNGKey(0), escalate_after=2
+        )
+        assert ladder.on_alarm("s") == "rejuvenate"
+        assert ladder.on_alarm("s") == "rejuvenate"
+        assert ladder.metrics.rejuvenations == 2
+        assert ladder.on_alarm("s") == "escalate"
+        assert ladder.metrics.escalations == 1
+        ev = ladder.stanza()["events"][-1]
+        assert ev["kind"] == "escalate" and ev["strikes"] == 3
+        # promotion clears the strikes: the ladder starts over
+        ladder.on_promoted("s")
+        assert ladder.on_alarm("s") == "rejuvenate"
+
+    def test_rejuvenate_skips_unattached_and_unticked(self):
+        model, sched, _ = _attached_sched()
+        ladder = AdaptationLadder(sched, jax.random.PRNGKey(0))
+        sched.attach("cold", _fake_snapshot(model, n_draws=4))
+        assert ladder.rejuvenate(["nope", "cold"]) == 0
+        assert ladder.metrics.rejuvenations == 0
+
+
+class TestMaintRouting:
+    """The loop.observe alarm path with a stub always-alarm detector
+    and a recording fake ladder: fresh alarms are consumed by the
+    ladder, escalations fall through to the refit queue, OWED alarms
+    never re-enter the ladder."""
+
+    class _AlwaysAlarm:
+        def __init__(self):
+            self.resets = 0
+
+        def update(self, inc):
+            return float(inc), True
+
+        def reset(self):
+            self.resets += 1
+
+    class _FakeAdapt:
+        def __init__(self, answers):
+            self.answers = list(answers)
+            self.calls = []
+
+        def on_alarm(self, sid):
+            self.calls.append(sid)
+            return self.answers.pop(0)
+
+        def on_promoted(self, sid):
+            self.calls.append(("promoted", sid))
+
+    def _loop(self, adapt, policy):
+        from hhmm_tpu.infer import GibbsConfig
+        from hhmm_tpu.maint import MaintenanceLoop
+
+        model, sched, _ = _attached_sched(n_draws=4, history_tail=16)
+        loop = MaintenanceLoop(
+            sched,
+            None,
+            model,
+            GibbsConfig(num_warmup=2, num_samples=2, num_chains=1),
+            jax.random.PRNGKey(0),
+            policy=policy,
+            detector_factory=lambda sid: self._AlwaysAlarm(),
+            adapt=adapt,
+        )
+        return model, sched, loop
+
+    def _tick_and_observe(self, sched, loop, t):
+        rs = sched.tick({"s": {"x": t % 3}})
+        return loop.observe(rs.values())
+
+    def test_fresh_alarm_consumed_by_ladder(self):
+        from hhmm_tpu.maint import MaintenancePolicy
+
+        fake = self._FakeAdapt(["rejuvenate", "rejuvenate", "escalate"])
+        model, sched, loop = self._loop(
+            fake, MaintenancePolicy(min_interval_ticks=1)
+        )
+        # tick 1 seeds the detector (no prev loglik -> no alarm)
+        assert self._tick_and_observe(sched, loop, 0) == 0
+        assert fake.calls == []
+        # ticks 2-3: alarms answered by rejuvenation, nothing enqueued
+        assert self._tick_and_observe(sched, loop, 1) == 0
+        assert self._tick_and_observe(sched, loop, 2) == 0
+        assert fake.calls == ["s", "s"]
+        # tick 4: the ladder escalates -> the refit queue takes it
+        assert self._tick_and_observe(sched, loop, 0) == 1
+        assert fake.calls == ["s", "s", "s"]
+
+    def test_owed_alarm_skips_the_ladder(self):
+        from hhmm_tpu.maint import MaintenancePolicy
+
+        # debounce window so large the second alarm cannot land
+        fake = self._FakeAdapt(["escalate", "escalate", "escalate"])
+        model, sched, loop = self._loop(
+            fake, MaintenancePolicy(min_interval_ticks=1000)
+        )
+        self._tick_and_observe(sched, loop, 0)  # seed
+        # first alarm: ladder escalates, policy accepts -> enqueued
+        assert self._tick_and_observe(sched, loop, 1) == 1
+        assert fake.calls == ["s"]
+        # second alarm: ladder escalates, policy debounces -> OWED
+        assert self._tick_and_observe(sched, loop, 2) == 0
+        assert fake.calls == ["s", "s"]
+        # third tick: the alarm is OWED — it must retry the policy
+        # directly, NOT climb the ladder again (re-rejuvenating would
+        # mask the signal the stuck refit is waiting on)
+        assert self._tick_and_observe(sched, loop, 0) == 0
+        assert fake.calls == ["s", "s"]
+
+    def test_unwired_loop_routes_straight_to_policy(self):
+        from hhmm_tpu.maint import MaintenancePolicy
+
+        model, sched, loop = self._loop(
+            None, MaintenancePolicy(min_interval_ticks=1)
+        )
+        self._tick_and_observe(sched, loop, 0)
+        assert self._tick_and_observe(sched, loop, 1) == 1
+
+
+class TestWeightStateLifecycle:
+    """Satellite: the scheduler's opaque weight-state table across
+    detach/page-in/swap/unregister — the contracts `adapt/` builds on."""
+
+    def _paged(self, tmp_path, n_draws=3):
+        from hhmm_tpu.serve import SnapshotPager
+
+        model = MultinomialHMM(K=2, L=3)
+        reg = SnapshotRegistry(str(tmp_path))
+        reg.save("s", _fake_snapshot(model, n_draws=n_draws))
+        pager = SnapshotPager(reg, budget_bytes=10**9)
+        sched = MicroBatchScheduler(
+            model, buckets=(4,), registry=reg, pager=pager, history_tail=16
+        )
+        return model, reg, pager, sched
+
+    def test_weights_survive_detach_and_warm_page_in_bitwise(self, tmp_path):
+        """Evict an adapted series, touch it back in: the replayed
+        stream AND the weight state are bitwise the never-evicted
+        ones — adaptation does not reset on paging churn."""
+        model, reg, pager, sched = self._paged(tmp_path)
+        control = MicroBatchScheduler(model, buckets=(4,), history_tail=16)
+        control.attach("s", reg.load("s"))
+        ladder = AdaptationLadder(sched, jax.random.PRNGKey(0))
+        lctrl = AdaptationLadder(control, jax.random.PRNGKey(0))
+        obs = [{"x": t % 3} for t in range(10)]
+        for t in range(5):
+            rp = sched.tick({"s": obs[t]})["s"]
+            rc = control.tick({"s": obs[t]})["s"]
+            assert not rp.shed and not rc.shed
+            ladder.observe([rp])
+            lctrl.observe([rc])
+        w0 = np.asarray(sched.weight_state_of("s")).copy()
+        assert pager.evict("s")  # detach: the weights SURVIVE
+        assert "s" not in sched.series_ids()
+        np.testing.assert_array_equal(sched.weight_state_of("s"), w0)
+        for t in range(5, 10):
+            rp = sched.tick({"s": obs[t]})["s"]  # t=5 pages in WARM
+            rc = control.tick({"s": obs[t]})["s"]
+            assert not rp.shed
+            ladder.observe([rp])
+            lctrl.observe([rc])
+            np.testing.assert_array_equal(rp.probs, rc.probs)
+            np.testing.assert_array_equal(
+                rp.per_draw_loglik, rc.per_draw_loglik
+            )
+        wp = np.asarray(sched.weight_state_of("s"))
+        wc = np.asarray(control.weight_state_of("s"))
+        np.testing.assert_array_equal(wp, wc)
+        assert wp.dtype == wc.dtype
+        assert sched.metrics.warm_page_ins == 1
+
+    def test_rejuvenated_bank_drops_weights_on_detach(self, tmp_path):
+        """A rejuvenated bank lives only in memory: a page-in restores
+        the ORIGINAL snapshot, so saved weights indexed against the
+        rejuvenated cloud must not be replayed over it."""
+        model, reg, pager, sched = self._paged(tmp_path, n_draws=4)
+        for t in range(2):
+            sched.tick({"s": {"x": t % 3}})
+        ladder = AdaptationLadder(sched, jax.random.PRNGKey(0))
+        assert ladder.rejuvenate(["s"]) == 1
+        sched.set_weight_state(
+            "s", np.array([0.0, -1.0, -2.0, -3.0], np.float32)
+        )
+        assert pager.evict("s")
+        assert sched.weight_state_of("s") is None
+
+    def test_swap_snapshot_resets_weights(self, tmp_path):
+        model, reg, pager, sched = self._paged(tmp_path)
+        sched.tick({"s": {"x": 0}})
+        sched.set_weight_state("s", uniform_log_weights(3) + 0.5)
+        reg.promote("s", _fake_snapshot(model, n_draws=3, seed=9))
+        assert sched.swap_snapshot("s") is None
+        # the committed attach reset the stored state: new draws,
+        # uniform (= no stored) weights
+        assert sched.weight_state_of("s") is None
+
+    def test_unregister_releases_weights(self, tmp_path):
+        model, reg, pager, sched = self._paged(tmp_path)
+        sched.tick({"s": {"x": 0}})
+        sched.set_weight_state("s", uniform_log_weights(3))
+        assert sched.unregister("s")
+        assert sched.weight_state_of("s") is None
+
+    def test_shed_ticks_carry_no_increment(self):
+        """The reweighting signal is absent exactly when nothing was
+        folded: a shed response has per_draw_loglik=None, and the
+        ladder leaves the weight table untouched."""
+        model = MultinomialHMM(K=2, L=3)
+        sched = MicroBatchScheduler(model, buckets=(4,))
+        r = sched.tick({"nobody": {"x": 0}})["nobody"]  # no registry
+        assert r.shed and r.per_draw_loglik is None and r.draw_ok is None
+        ladder = AdaptationLadder(sched, jax.random.PRNGKey(0))
+        assert ladder.observe([r]) == 0
+        assert sched.weight_state_of("nobody") is None
+
+    def test_replace_draw_bank_validation(self):
+        model, sched, _ = _attached_sched(n_draws=4)
+        bank = np.asarray(sched.draw_bank_of("s"))
+        alpha, ll, ok = sched.filter_state_of("s")
+        err = sched.replace_draw_bank("ghost", bank, alpha, ll, ok)
+        assert "not attached" in err
+        sched.attach("cold", _fake_snapshot(model, n_draws=4))
+        err = sched.replace_draw_bank("cold", bank, alpha, ll, ok)
+        assert "not received a tick" in err
+        # fixed-D contract: draw-count and dtype must match exactly
+        err = sched.replace_draw_bank("s", bank[:2], alpha, ll, ok)
+        assert "fixed-D" in err
+        # float16 survives jnp.asarray (float64 would silently demote
+        # back to float32 without x64, masking the mismatch)
+        err = sched.replace_draw_bank(
+            "s", bank.astype(np.float16), alpha, ll, ok
+        )
+        assert "fixed-D" in err
+        err = sched.replace_draw_bank("s", bank, alpha[:2], ll, ok)
+        assert "filter state shape" in err
+        # a refused replacement left the serving state untouched
+        np.testing.assert_array_equal(
+            np.asarray(sched.draw_bank_of("s")), bank
+        )
+
+
+class TestWeightedForecast:
+    """Satellite: `posterior_predictive_mean` weights are a measure,
+    not a mask — fractional values tilt the mixture."""
+
+    def _inputs(self):
+        # uniform filters/transitions: the predictive state dist is
+        # uniform, so each draw's forecast is the mean of its mu row
+        d, k = 3, 2
+        la = np.full((d, k), np.log(0.5), np.float32)
+        lA = np.full((d, k, k), np.log(0.5), np.float32)
+        mu = np.array([[0.0, 0.0], [1.0, 1.0], [2.0, 2.0]], np.float32)
+        return la, lA, mu  # per-draw forecasts: [0, 1, 2]
+
+    def test_fractional_weights_honored_not_binarized(self):
+        la, lA, mu = self._inputs()
+        w = np.array([0.5, 0.25, 0.25], np.float32)
+        got = float(posterior_predictive_mean(la, lA, mu, weights=w))
+        # binarizing w into a mask would give mean([0,1,2]) = 1.0
+        np.testing.assert_allclose(got, 0.75, rtol=1e-6)
+        # and the adaptation plane's exp-weights plug straight in
+        lw = np.log(np.array([0.5, 0.25, 0.25], np.float32))
+        got2 = float(
+            posterior_predictive_mean(
+                la, lA, mu, weights=normalized_weights(lw)
+            )
+        )
+        np.testing.assert_allclose(got2, 0.75, rtol=1e-6)
+
+    def test_nonfinite_weights_and_draws_zeroed(self):
+        la, lA, mu = self._inputs()
+        # NaN/negative weights contribute nothing (not NaN-poisoning)
+        w = np.array([1.0, np.nan, -2.0], np.float32)
+        got = float(posterior_predictive_mean(la, lA, mu, weights=w))
+        np.testing.assert_allclose(got, 0.0, atol=1e-7)
+        # a weighted draw whose own forecast is NaN sheds its mass
+        mu2 = mu.copy()
+        mu2[0] = np.nan
+        w2 = np.array([1.0, 1.0, 0.0], np.float32)
+        got2 = float(posterior_predictive_mean(la, lA, mu2, weights=w2))
+        np.testing.assert_allclose(got2, 1.0, rtol=1e-6)
+
+    def test_zero_mass_falls_back_to_finite_draws(self):
+        la, lA, mu = self._inputs()
+        w = np.zeros(3, np.float32)
+        got = float(posterior_predictive_mean(la, lA, mu, weights=w))
+        np.testing.assert_allclose(got, 1.0, rtol=1e-6)  # mean of 0,1,2
+        # only a series with NO finite per-draw value yields NaN
+        mu_nan = np.full_like(mu, np.nan)
+        assert np.isnan(
+            float(posterior_predictive_mean(la, lA, mu_nan, weights=w))
+        )
+        # unweighted path unchanged: plain draw mean
+        np.testing.assert_allclose(
+            float(posterior_predictive_mean(la, lA, mu)), 1.0, rtol=1e-6
+        )
+
+
+class TestCompileDiscipline:
+    def test_rejuvenation_lands_on_bucket_shapes(self):
+        """Two single-series rejuvenations after a warm one add no jit
+        signatures: the ladder pads to the scheduler's bucket ladder,
+        so the move only ever compiles per bucket shape."""
+        model = MultinomialHMM(K=2, L=3)
+        sched = MicroBatchScheduler(model, buckets=(4,), history_tail=8)
+        snap = _fake_snapshot(model, n_draws=4)
+        sched.attach_many([(f"s{i}", snap, None) for i in range(3)])
+        for t in range(2):
+            sched.tick({f"s{i}": {"x": (t + i) % 3} for i in range(3)})
+        ladder = AdaptationLadder(sched, jax.random.PRNGKey(0))
+        assert ladder.rejuvenate(["s0"]) == 1  # warms the [4,...] shape
+        warm = ladder.rejuvenator.compile_count
+        assert warm >= 1
+        assert ladder.rejuvenate(["s1"]) == 1
+        assert ladder.rejuvenate(["s0", "s1", "s2"]) == 3  # padded to 4
+        assert ladder.rejuvenator.compile_count == warm
+
+    def test_tick_after_rejuvenation_compile_flat(self):
+        model, sched, _ = _attached_sched(n_draws=4)
+        sched.tick({"s": {"x": 2}})
+        warm = sched.metrics.compile_count
+        ladder = AdaptationLadder(sched, jax.random.PRNGKey(0))
+        assert ladder.rejuvenate(["s"]) == 1
+        r = sched.tick({"s": {"x": 1}})["s"]
+        assert not r.shed and not r.degraded
+        assert sched.metrics.compile_count == warm
+
+
+# ---------------------------------------------------------------------------
+# the end-to-end closed-loop gate (subprocess, slow)
+
+
+@pytest.mark.slow
+class TestAdaptBenchQuick:
+    def test_adapt_quick_tracks_the_shift(self):
+        """`bench.py --adapt --quick` exits 0 only if the weighted arm
+        beats the uniform-stale arm post-shift (paired AND pooled),
+        every rejuvenation restored ESS above the floor, the adaptive
+        arm refit strictly less than the refit-only baseline, and zero
+        compiles landed after warmup."""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--adapt", "--quick", "--cpu"],
+            capture_output=True,
+            text=True,
+            env=env,
+            timeout=560,
+            cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        rec = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert rec["metric"] == "tayal_adapt_tick_throughput"
+        adapt = rec["manifest"]["adapt"]
+        assert adapt["tracking_advantage"] is True
+        assert adapt["paired_mean_delta"] > 0
+        assert adapt["pooled_mean_delta"] > 0
+        assert adapt["reweight_ticks"] > 0
+        assert adapt["rejuvenations"] >= 1
+        assert adapt["refits_adaptive"] < adapt["refits_baseline"]
+        assert rec["compiles_after_warmup"] == 0
+        assert "CLOSED-LOOP OK" in proc.stderr

@@ -701,6 +701,37 @@ class TestLayering:
         hits = _fires(rep, "layer-import")
         assert len(hits) == 1 and "back-edge" in hits[0].message
 
+    # paired fixtures for the adapt rank (serve 5 < adapt 6 < maint 7):
+    # the adaptation plane reads serve's per-draw signal and writes
+    # back through serve's adaptation surface, maint calls DOWN into
+    # its escalation ladder — and neither inversion is silent
+
+    def test_adapt_importing_maint_back_edge_fires(self, tmp_path):
+        src = "from hhmm_tpu.maint import MaintenanceLoop\n"
+        rep = _run(tmp_path, {"hhmm_tpu/adapt/toy.py": src}, ["layer-import"])
+        hits = _fires(rep, "layer-import")
+        assert len(hits) == 1 and "back-edge" in hits[0].message
+
+    def test_serve_importing_adapt_back_edge_fires(self, tmp_path):
+        src = "from hhmm_tpu.adapt import AdaptationLadder\n"
+        rep = _run(tmp_path, {"hhmm_tpu/serve/toy.py": src}, ["layer-import"])
+        hits = _fires(rep, "layer-import")
+        assert len(hits) == 1 and "back-edge" in hits[0].message
+
+    def test_adapt_consumes_serve_and_kernels_silent(self, tmp_path):
+        src = (
+            "from hhmm_tpu.serve.metrics import AdaptMetrics\n"
+            "from hhmm_tpu.core.lmath import safe_logsumexp\n"
+            "from hhmm_tpu.kernels import dispatch\n"
+        )
+        rep = _run(tmp_path, {"hhmm_tpu/adapt/toy.py": src}, ["layer-import"])
+        assert not _fires(rep, "layer-import"), _ids(rep)
+
+    def test_maint_calls_down_into_adapt_silent(self, tmp_path):
+        src = "from hhmm_tpu.adapt import AdaptationLadder\n"
+        rep = _run(tmp_path, {"hhmm_tpu/maint/toy.py": src}, ["layer-import"])
+        assert not _fires(rep, "layer-import"), _ids(rep)
+
 
 # ---------------------------------------------------------------------------
 # rule: pallas-import (kernels/dispatch.py is the only Pallas entry)

@@ -892,7 +892,7 @@ def _run_bench_diff(*argv):
 
 def _write_fixture_rounds(
     d, values, stamped=True, traced=None, slo=None, escaped=None, request=None,
-    duel=None, parity=None,
+    duel=None, parity=None, adapt=None,
 ):
     for n, v in enumerate(values, start=1):
         rec = {
@@ -929,6 +929,14 @@ def _write_fixture_rounds(
             if parity is not None and parity[n - 1] is not None:
                 rec["manifest"].setdefault("storm", {})["warm_page_in"] = {
                     "parity": bool(parity[n - 1])
+                }
+            if adapt is not None and adapt[n - 1] is not None:
+                tracking, breaches = adapt[n - 1]
+                rec["manifest"]["adapt"] = {
+                    "tracking_advantage": bool(tracking),
+                    "floor_breaches": int(breaches),
+                    "rejuvenations": 3,
+                    "escalations": 1,
                 }
             if slo is not None and slo[n - 1] is not None:
                 attained = bool(slo[n - 1])
@@ -1128,6 +1136,54 @@ class TestBenchDiffFairnessDuel:
         assert "warm page-in parity" in proc.stdout
 
 
+class TestBenchDiffAdaptation:
+    """The `bench.py --adapt` ``adapt`` stanza gates like resilience:
+    a tracking baseline -> tracking lost, or a clean ESS baseline ->
+    series below the floor, is an adaptation regression; without the
+    matching baseline both report ungated."""
+
+    def test_tracking_lost_after_baseline_fails(self, tmp_path):
+        _write_fixture_rounds(
+            tmp_path, [100.0, 100.0], adapt=[(True, 0), (False, 0)]
+        )
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 1, proc.stdout
+        assert "ADAPTATION REGRESSION" in proc.stdout
+
+    def test_tracking_held_passes(self, tmp_path):
+        _write_fixture_rounds(
+            tmp_path, [100.0, 99.0], adapt=[(True, 0), (True, 0)]
+        )
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 0, proc.stdout
+        assert "adaptation tracking" in proc.stdout
+
+    def test_first_stale_reported_not_gated(self, tmp_path):
+        # no tracking baseline to regress from: visible, not fatal
+        _write_fixture_rounds(
+            tmp_path, [100.0, 99.0], adapt=[(False, 0), (False, 0)]
+        )
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 0, proc.stdout
+        assert "not tracking (no tracking baseline)" in proc.stdout
+
+    def test_floor_breach_after_clean_baseline_fails(self, tmp_path):
+        _write_fixture_rounds(
+            tmp_path, [100.0, 100.0], adapt=[(True, 0), (True, 2)]
+        )
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 1, proc.stdout
+        assert "ESS-FLOOR REGRESSION" in proc.stdout
+
+    def test_first_breach_reported_not_gated(self, tmp_path):
+        _write_fixture_rounds(
+            tmp_path, [100.0, 99.0], adapt=[(True, 1), (True, 1)]
+        )
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 0, proc.stdout
+        assert "below ESS floor (no clean baseline)" in proc.stdout
+
+
 class TestBenchDiffRequestPlane:
     """The `request` manifest stanza (`hhmm_tpu/obs/request.py`) gates
     INVERTED on the same comparability key: fairness-spread or
@@ -1260,6 +1316,11 @@ class TestObsReport:
         assert "warm device re-time update/b128" in out
         # the storm fairness arms
         assert "skewed p99 spread 66.8182 ms vs balanced 2.3868 ms" in out
+        # the adaptation plane: ladder counters, ESS table, verdict
+        assert "== adaptation ==" in out
+        assert "rejuvenations: 5" in out
+        assert "ESS min (window): 1.99" in out
+        assert "verdict: TRACKING" in out
         # SLO verdicts: the fixture has both a PASS and a FAIL check
         assert "PASS" in out and "FAIL" in out and "UNMET" in out
 
