@@ -165,6 +165,7 @@ WORKLOAD_FLAGS = (
     "storm_rounds",
     "ticks",
     "serve_draws",
+    "pipeline",
     "quick",
     "cpu",
 )
@@ -238,6 +239,190 @@ def emit_manifest(args, mode: str, record: dict, model=None) -> None:
             file=sys.stderr,
             flush=True,
         )
+
+
+def _pipeline_overlap_duel(model, obs_fn, quick: bool) -> dict:
+    """Sync-vs-async scheduler duel for the ``--pipeline`` arm
+    (`hhmm_tpu/pipeline/`, docs/serving.md "Async pipeline"): an
+    identical offered traffic through the classic blocking scheduler
+    and a pipelined one, fresh scheduler/metrics/recorder per arm so
+    neither contaminates the other or the main bench's compile
+    accounting — the fairness-duel pattern. The fleet splits into two
+    interleaved cohorts, one submitting per round, and the arms differ
+    exactly where the pipeline differs. The BLOCKING host is
+    unavailable for a whole dispatch+sync+commit window per flush, so
+    under a one-cohort flush budget its crank turns every OTHER round
+    and drains the two backlogged cohorts with back-to-back flushes —
+    the second ages in the pending queue through the first's blocked
+    window, which is the only segment the request plane can charge a
+    cross-flush wait to (``flush`` admits its whole drain upfront, so
+    intra-flush waits land in the form share). The pipelined host
+    DOUBLE-BUFFERS: each round it submits and dispatches one cohort
+    while the other cohort's flight is still airborne (disjoint
+    series, so the fold-order guard never defers), then harvests the
+    older flight — whose device time ran hidden behind this round's
+    submission and batch formation, and whose commit runs outside any
+    tick's queue window while the fresh flight is airborne in turn.
+
+    The ``ok`` verdict requires: the async arm's overall queue share
+    STRICTLY below the sync arm's (the overlap gate — device time
+    left the pending-queue segment), a positive overlap share (device
+    time actually hidden behind host work), bitwise response parity
+    keyed ``(round, series)`` — per-device fan-out reorders responses,
+    so order-keyed parity would false-fail a correct pipeline — zero
+    sheds, and a flat post-warmup compile count in BOTH arms.
+    `scripts/bench_diff.py` re-checks the queue-share inequality
+    within the record exactly like the FIFO-vs-DRR duel."""
+    from hhmm_tpu.obs.request import RequestRecorder
+    from hhmm_tpu.serve import (
+        AdmissionPolicy,
+        MicroBatchScheduler,
+        PosteriorSnapshot,
+        ServeMetrics,
+        model_spec,
+    )
+
+    n_series, n_draws = 64, 2
+    cohort = n_series // 2
+    rounds = 4 if quick else 8
+    snap = PosteriorSnapshot(
+        spec=model_spec(model),
+        draws=(
+            np.random.default_rng(23).normal(size=(n_draws, model.n_free))
+            * 0.3
+        ).astype(np.float32),
+    )
+    arms: dict = {}
+    parity: dict = {}
+    sheds = 0
+    pipe_stats = pipe_block = None
+    for arm in ("sync", "async"):
+        pipelined = arm == "async"
+        rec = RequestRecorder(enabled=True, window_s=600.0)
+        met = ServeMetrics()
+        sched = MicroBatchScheduler(
+            model,
+            buckets=(cohort,),
+            metrics=met,
+            recorder=rec,
+            pipeline=pipelined,
+            # one cohort per flush: the sync arm's backlogged second
+            # cohort must wait for the NEXT flush call (the cross-flush
+            # queue wait the duel measures), never drain as an
+            # intra-flush wave whose wait hides in the form share
+            admission=AdmissionPolicy(
+                max_ticks_per_flush=cohort, flush_order="fifo"
+            ),
+        )
+        sched.attach_many(
+            [
+                (f"p{i:03d}", snap, None, f"tenant{i % 4}")
+                for i in range(n_series)
+            ]
+        )
+        got: list = []
+
+        def drive(r: int, prologue: bool = False) -> None:
+            # cohort r%2 submits this round (series i with i%2 == r%2)
+            for i in range(r % 2, n_series, 2):
+                sched.submit(
+                    f"p{i:03d}", obs_fn(i, r), tenant=f"tenant{i % 4}"
+                )
+            if pipelined:
+                # double-buffer: launch this cohort next to the other
+                # cohort's airborne flight (disjoint series — the
+                # fold-order guard never defers), THEN harvest that
+                # older flight: its device time ran hidden behind this
+                # round's submit+form, and its commit lands while the
+                # fresh flight is airborne, outside any queue window.
+                # The first round after a drain is the pipeline
+                # PROLOGUE — nothing older is airborne yet, and
+                # harvesting would reap the flight just launched
+                sched.dispatch_async()
+                if not prologue:
+                    got.extend(sched.harvest(max_flights=1))
+            elif r % 2 == 1:
+                # every OTHER round: the blocking host just came back
+                # from a full dispatch+sync+commit window; the two
+                # backlogged cohorts drain as back-to-back one-cohort
+                # flushes, the second queuing through the first's
+                # blocked window
+                got.extend(sched.flush())
+                got.extend(sched.flush())
+
+        # warmup: two rounds per cohort land its init + update compiles
+        for k, r in enumerate((0, 1, 2, 3)):
+            drive(r, prologue=k == 0)
+        if pipelined:
+            got.extend(sched.harvest())  # epilogue: drain the last flight
+        compiles_warm = met.compile_count
+        rec.reset_window()
+        got = []
+        for k, r in enumerate(range(4, 4 + rounds)):
+            drive(r, prologue=k == 0)
+        if pipelined:
+            got.extend(sched.harvest())
+        stz = rec.stanza()
+        overall = stz["overall"]
+        # order-independent parity digest: series s's k-th measured
+        # response is round k's (flights harvest FIFO per series)
+        seen: dict = {}
+        counters: dict = {}
+        for rsp in got:
+            k = counters.get(rsp.series_id, 0)
+            counters[rsp.series_id] = k + 1
+            seen[(k, rsp.series_id)] = None if rsp.shed else float(rsp.loglik)
+            sheds += int(rsp.shed)
+        parity[arm] = seen
+        arms[arm] = {
+            "queue_share": overall.get("queue_share"),
+            "device_share": overall.get("device_share"),
+            "other_share": overall.get("other_share"),
+            "overlap_share": overall.get("overlap_share"),
+            "ticks": overall.get("ticks"),
+            "compiles_after_warmup": met.compile_count - compiles_warm,
+        }
+        if pipelined:
+            pipe_stats = sched.pipeline_stats() or {}
+            pipe_block = stz.get("pipeline") or {}
+    sync_q = arms["sync"]["queue_share"]
+    async_q = arms["async"]["queue_share"]
+    overlap = arms["async"]["overlap_share"]
+    keys = set(parity["sync"]) | set(parity["async"])
+    mismatches = sum(
+        1 for k in keys if parity["sync"].get(k) != parity["async"].get(k)
+    )
+    ok = (
+        isinstance(sync_q, (int, float))
+        and isinstance(async_q, (int, float))
+        and async_q < sync_q
+        and isinstance(overlap, (int, float))
+        and overlap > 0.0
+        and mismatches == 0
+        and sheds == 0
+        and arms["sync"]["compiles_after_warmup"] == 0
+        and arms["async"]["compiles_after_warmup"] == 0
+    )
+    return {
+        "series": n_series,
+        "rounds": rounds,
+        "draws": n_draws,
+        "sync": arms["sync"],
+        "async": arms["async"],
+        "sync_queue_share": sync_q,
+        "async_queue_share": async_q,
+        "overlap_share": overlap,
+        "parity_mismatches": mismatches,
+        "sheds": sheds,
+        "in_flight_depth": (pipe_block or {}).get("in_flight_depth"),
+        "in_flight_peak": (pipe_block or {}).get("in_flight_peak"),
+        "harvested_flights": (pipe_block or {}).get("harvested_flights"),
+        "n_devices": (pipe_stats or {}).get("n_devices"),
+        "per_device_served": (pipe_stats or {}).get("per_device_served"),
+        "deferred_ticks": (pipe_stats or {}).get("deferred_ticks"),
+        "placement": (pipe_stats or {}).get("placement"),
+        "ok": ok,
+    }
 
 
 def serve_bench(args, backend, degraded) -> None:
@@ -333,6 +518,7 @@ def serve_bench(args, backend, degraded) -> None:
         registry=registry,
         metrics=metrics,
         recorder=recorder,
+        pipeline=args.pipeline,
     )
     t0 = perf_counter()
     sched.attach_many(
@@ -349,10 +535,21 @@ def serve_bench(args, backend, degraded) -> None:
     attach_s = perf_counter() - t0
 
     def replay(t_lo, t_hi):
+        # --pipeline: the overlap drive — round t's ticks are submitted
+        # (host work) while round t-1's flight is still airborne, then
+        # the flight is harvested and round t dispatched async; the
+        # trailing harvest drains the last flight so every replay ends
+        # with nothing in the air (clean warmup/measured boundary)
         for t in range(t_lo, t_hi):
             for i, name in enumerate(names):
                 sched.submit(name, {"x": int(x_np[i, t]), "sign": int(s_np[i, t])})
-            sched.flush()
+            if args.pipeline:
+                sched.harvest()
+                sched.dispatch_async()
+            else:
+                sched.flush()
+        if args.pipeline:
+            sched.harvest()
 
     warm_n = min(2, ticks)
     replay(n_hist, n_hist + warm_n)
@@ -374,6 +571,23 @@ def serve_bench(args, backend, degraded) -> None:
     request_stanza = recorder.stanza()
     req_overall = request_stanza["overall"]
     req_fair = request_stanza["fairness"]
+    # --pipeline: the overlap duel (sync vs async arms on identical
+    # traffic) plus the MAIN pipelined replay's own fan-out counters
+    pipeline_stanza = None
+    if args.pipeline:
+        pipeline_stanza = _pipeline_overlap_duel(
+            model,
+            lambda i, r: {
+                "x": int(x_np[i % B, r % T]),
+                "sign": int(s_np[i % B, r % T]),
+            },
+            args.quick,
+        )
+        pipeline_stanza["fleet"] = dict(
+            sched.pipeline_stats() or {},
+            overlap_share=req_overall.get("overlap_share"),
+            **(request_stanza.get("pipeline") or {}),
+        )
     # SLO attainment (serve/metrics.py): the explicit serving objectives
     # — p99 tick latency, snapshot staleness, recompile budget — judged
     # over the steady-state window and embedded in the manifest stanza
@@ -436,6 +650,9 @@ def serve_bench(args, backend, degraded) -> None:
     # spread growth gate, scripts/bench_diff.py)
     serve_record["manifest"]["slo"] = slo
     serve_record["manifest"]["request"] = request_stanza
+    if pipeline_stanza is not None:
+        serve_record["pipeline_overlap_ok"] = pipeline_stanza["ok"]
+        serve_record["manifest"]["pipeline"] = pipeline_stanza
     print(json.dumps(serve_record))
     print(
         "# serve SLO "
@@ -473,6 +690,25 @@ def serve_bench(args, backend, degraded) -> None:
             file=sys.stderr,
         )
         sys.exit(1)
+    if pipeline_stanza is not None:
+        print(
+            "# serve pipeline duel "
+            + ("OK" if pipeline_stanza["ok"] else "FAILED")
+            + f": queue share sync={pipeline_stanza['sync_queue_share']}"
+            f" -> async={pipeline_stanza['async_queue_share']}, overlap "
+            f"{pipeline_stanza['overlap_share']}, parity mismatches "
+            f"{pipeline_stanza['parity_mismatches']}, in-flight peak "
+            f"{pipeline_stanza['in_flight_peak']}",
+            file=sys.stderr,
+        )
+        if not pipeline_stanza["ok"]:
+            print(
+                "# serve bench FAILED: --pipeline overlap gate (async "
+                "queue share must sit strictly below the sync arm with "
+                "bitwise parity and a flat compile count)",
+                file=sys.stderr,
+            )
+            sys.exit(1)
 
 
 def serve_storm(args, backend, degraded) -> None:
@@ -842,6 +1078,22 @@ def serve_storm(args, backend, degraded) -> None:
         and par_probs_delta <= 1e-6
         and par_metrics.warm_page_ins >= 1
     )
+
+    # ---- async-pipeline overlap duel (no faults, --pipeline only):
+    # same probe shape as --serve's (`hhmm_tpu/pipeline/`) — fresh
+    # schedulers per arm, gated on queue share + parity + compiles
+    pipeline_stanza = None
+    if args.pipeline:
+        try:
+            pipeline_stanza = _pipeline_overlap_duel(
+                model, obs_for, args.quick
+            )
+        except Exception as e:
+            escaped += 1
+            print(
+                f"# serve-storm: pipeline-probe escape: {e}",
+                file=sys.stderr,
+            )
     probes_s = perf_counter() - t0
 
     summary = metrics.summary()
@@ -905,6 +1157,18 @@ def serve_storm(args, backend, degraded) -> None:
             f"(sheds={par_shed}, loglik_delta={par_ll_delta}, "
             f"probs_delta={par_probs_delta}, "
             f"warm_page_ins={par_metrics.warm_page_ins})"
+        )
+    if args.pipeline and (
+        pipeline_stanza is None or not pipeline_stanza["ok"]
+    ):
+        failures.append(
+            "async pipeline did not beat the sync arm on the overlap "
+            "duel (queue share "
+            f"sync={(pipeline_stanza or {}).get('sync_queue_share')} "
+            f"async={(pipeline_stanza or {}).get('async_queue_share')}, "
+            f"overlap={(pipeline_stanza or {}).get('overlap_share')}, "
+            "parity mismatches "
+            f"{(pipeline_stanza or {}).get('parity_mismatches')})"
         )
 
     storm_stanza = {
@@ -981,6 +1245,9 @@ def serve_storm(args, backend, degraded) -> None:
     record["manifest"]["slo"] = slo
     record["manifest"]["storm"] = storm_stanza
     record["manifest"]["request"] = request_stanza
+    if pipeline_stanza is not None:
+        record["pipeline_overlap_ok"] = pipeline_stanza["ok"]
+        record["manifest"]["pipeline"] = pipeline_stanza
     print(json.dumps(record))
     print(
         "# serve-storm "
@@ -2304,6 +2571,19 @@ def main() -> None:
         "any injected fault escapes as an exception, shedding/paging "
         "never engage, resident bytes exceed the budget, or any XLA "
         "compile lands after warmup (see docs/serving.md)",
+    )
+    ap.add_argument(
+        "--pipeline",
+        action="store_true",
+        help="with --serve / --serve-storm: exercise the async "
+        "double-buffered flush pipeline (hhmm_tpu/pipeline/). --serve "
+        "drives its main replay through dispatch_async/harvest; both "
+        "benches additionally run a sync-vs-async overlap duel on "
+        "identical compact traffic and fail (exit 1 / storm gate) "
+        "unless the async arm's queue share sits strictly below the "
+        "sync arm's with bitwise response parity, positive overlap "
+        "share, and a flat post-warmup compile count (see "
+        "docs/serving.md)",
     )
     ap.add_argument(
         "--maint",

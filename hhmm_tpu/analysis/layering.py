@@ -17,7 +17,7 @@ other — siblings stay decoupled)::
     7  maint
     6  adapt
     5  serve
-    4  models, batch
+    4  models, batch, pipeline
     3  infer, plan
     2  kernels
     1  obs
@@ -33,7 +33,13 @@ slots between them: it reads the scheduler's per-draw response signal
 and writes back opaque weight state / rejuvenated banks through
 serve's adaptation surface, while ``maint`` calls DOWN into its
 escalation ladder — so serve must not import adapt, and adapt must
-not import maint.
+not import maint. ``pipeline`` (the async flush pipeline, PR 18)
+sits between ``plan`` and ``serve``: it consumes the planner's mesh
+decision (series→device placement, recorded into the plan stanza
+from above) and the serving layer drives it (in-flight flush table,
+per-device fan-out) — serve imports pipeline, pipeline must never
+import serve (flights carry opaque groups; every state commit stays
+in the scheduler).
 
 ``import hhmm_tpu`` (the root package: version metadata only) is
 allowed from anywhere. Function-scoped (lazy) imports are findings
@@ -63,6 +69,7 @@ LAYERS = {
     "plan": 3,
     "models": 4,
     "batch": 4,
+    "pipeline": 4,
     "serve": 5,
     "adapt": 6,
     "maint": 7,
@@ -195,7 +202,8 @@ class LayerImportRule(Rule):
     id = "layer-import"
     title = "imports follow the layering DAG (no back-edges)"
     doc = (
-        "core ← obs ← kernels ← infer/plan ← models/batch ← serve ← "
+        "core ← obs ← kernels ← infer/plan ← models/batch/pipeline ← "
+        "serve ← "
         "adapt ← maint ← apps ← viz: imports must point strictly down "
         "the ranks; "
         "same-rank siblings stay decoupled. A back-edge couples a "

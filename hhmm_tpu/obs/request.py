@@ -78,8 +78,21 @@ __all__ = [
 # 10): hhmm_tpu/serve/ imports THIS, never time.perf_counter directly
 now = perf_counter
 
-# lifecycle stage order; each maps to a ``t_<stage>`` stamp slot
-STAGES = ("enqueue", "admit", "bucket", "dispatch", "device", "respond")
+# lifecycle stage order; each maps to a ``t_<stage>`` stamp slot.
+# ``harvest`` is async-pipeline-only (hhmm_tpu/pipeline/): stamped when
+# the harvester turns to an in-flight flush, BEFORE its blocking sync —
+# dispatch→harvest is device time HIDDEN behind host work (the overlap
+# the pipeline exists to buy), harvest→device is the residual stall the
+# harvester actually waited. Absent on the synchronous path.
+STAGES = (
+    "enqueue", "admit", "bucket", "dispatch", "harvest", "device", "respond"
+)
+
+# in-flight flush registrations are bounded: a harvester that died
+# mid-air must not grow the flight table forever (the oldest flight's
+# traces simply lose their harvest stamp — decompose degrades to the
+# synchronous attribution)
+FLIGHT_TABLE_CAP = 4096
 
 # tenants beyond the exact-tracking cap fold here — the aggregate
 # stays truthful even when tenant = series at fleet scale
@@ -125,6 +138,7 @@ class TickTrace:
         "t_admit",
         "t_bucket",
         "t_dispatch",
+        "t_harvest",
         "t_device",
         "t_respond",
     )
@@ -140,6 +154,7 @@ class TickTrace:
         self.t_admit: Optional[float] = None
         self.t_bucket: Optional[float] = None
         self.t_dispatch: Optional[float] = None
+        self.t_harvest: Optional[float] = None
         self.t_device: Optional[float] = None
         self.t_respond: Optional[float] = None
 
@@ -173,6 +188,17 @@ class TickTrace:
         if self.t_bucket is not None:
             out["assign_s"] = self.t_bucket - t_adm
             out["stack_s"] = t_dis - self.t_bucket
+        if self.t_harvest is not None:
+            # async pipeline split of the device share: dispatch→harvest
+            # is device time HIDDEN behind host work (overlap won);
+            # harvest→device is the residual stall the harvester waited.
+            # The harvest stamp comes from the HARVEST SITE per in-flight
+            # flush (note_harvest) — under double-buffering the stamps no
+            # longer happen in dispatch call order, and attributing the
+            # sync by call order would charge flush N's device time to
+            # flush N+1's ticks.
+            out["hidden_s"] = max(0.0, self.t_harvest - t_dis)
+            out["stall_s"] = max(0.0, t_dev - self.t_harvest)
         return out
 
 
@@ -190,6 +216,8 @@ class _TenantStats:
         "sum_form",
         "sum_device",
         "sum_post",
+        "sum_hidden",
+        "sum_stall",
         "samples",
         "stride",
         "count",
@@ -206,6 +234,8 @@ class _TenantStats:
         self.sum_form = 0.0
         self.sum_device = 0.0
         self.sum_post = 0.0
+        self.sum_hidden = 0.0
+        self.sum_stall = 0.0
         # (t_end, total_s) pairs, oldest first
         self.samples: deque = deque()
         self.stride = 1
@@ -221,6 +251,8 @@ class _TenantStats:
         self.sum_form += d["form_s"]
         self.sum_device += d["device_s"]
         self.sum_post += d["post_s"]
+        self.sum_hidden += d.get("hidden_s", 0.0)
+        self.sum_stall += d.get("stall_s", 0.0)
         if self.count % self.stride == 0:
             self.samples.append((t_end, d["total_s"]))
             # prune the stale end first — a window that already slid
@@ -297,6 +329,14 @@ class RequestRecorder:
         self._sched_credit_cap = 0.0
         self._sched_tenants: Dict[str, Dict[str, Any]] = {}
         self._sched_last_order: List[str] = []
+        # async-pipeline flight registrations (begin_flight /
+        # note_harvest): flush_id -> the flight's traces, so the
+        # harvest-site stamp lands on the RIGHT in-flight flush even
+        # when two flushes interleave; bounded at FLIGHT_TABLE_CAP
+        self._flights: "Dict[Any, List[Optional[TickTrace]]]" = {}
+        self._flight_order: deque = deque()
+        self._inflight_peak = 0
+        self._harvested_flights = 0
 
     # ---- enablement (the obs/trace.py discipline) ----
 
@@ -464,6 +504,57 @@ class RequestRecorder:
                 if spread is not None:
                     obs_metrics.gauge("serve.request.p99_spread_ms").set(spread)
 
+    def begin_flight(
+        self, flush_id: Any, traces: Sequence[Optional[TickTrace]]
+    ) -> None:
+        """An async dispatch went in-flight (`hhmm_tpu/pipeline/`):
+        register its traces under ``flush_id`` so the harvest-site
+        stamp (:meth:`note_harvest`) lands on THIS flush's ticks and
+        not whatever dispatched most recently. Publishes the live
+        in-flight depth gauge (``serve.request.in_flight_depth``)."""
+        if not self.enabled():
+            return
+        with self._lock:
+            self._flights[flush_id] = list(traces)
+            self._flight_order.append(flush_id)
+            while len(self._flight_order) > FLIGHT_TABLE_CAP:
+                stale = self._flight_order.popleft()
+                self._flights.pop(stale, None)
+            depth = len(self._flights)
+            if depth > self._inflight_peak:
+                self._inflight_peak = depth
+        obs_metrics.gauge("serve.request.in_flight_depth").set(depth)
+
+    def note_harvest(self, flush_id: Any) -> None:
+        """The harvester turned to in-flight flush ``flush_id`` (one
+        clock read, BEFORE its blocking sync): stamp ``t_harvest`` on
+        exactly that flush's traces. Under double-buffered dispatch
+        the device-complete stamps no longer happen in dispatch call
+        order — this per-flight stamp is what keeps device time
+        attributed to the tick that actually spent it (the hidden/
+        stall split in :meth:`TickTrace.decompose`)."""
+        if not self.enabled():
+            return
+        t = self._clock()
+        with self._lock:
+            traces = self._flights.pop(flush_id, None)
+            if flush_id in self._flight_order:
+                self._flight_order.remove(flush_id)
+            if traces is not None:
+                self._harvested_flights += 1
+            depth = len(self._flights)
+        if traces is None:
+            return
+        for tr in traces:
+            if tr is not None:
+                tr.t_harvest = t
+        obs_metrics.gauge("serve.request.in_flight_depth").set(depth)
+
+    def in_flight_depth(self) -> int:
+        """Currently registered un-harvested flights."""
+        with self._lock:
+            return len(self._flights)
+
     def note_device_time(self, kernel: str, bucket: int, p50_s: float) -> None:
         """PR 8's sampled warm re-timing landed: the pure device
         re-execution p50 for this (kernel, bucket) — the refinement of
@@ -574,6 +665,11 @@ class RequestRecorder:
             self._sched_credit_cap = 0.0
             self._sched_tenants = {}
             self._sched_last_order = []
+            # LIVE in-flight flights carry over exactly like queue
+            # occupancy (their harvest lands in the new window); the
+            # peak restarts from the live depth
+            self._inflight_peak = len(self._flights)
+            self._harvested_flights = 0
 
     def stanza(self, top: Optional[int] = 16) -> Dict[str, Any]:
         """JSON-ready request-plane stanza for the run manifest /
@@ -625,12 +721,22 @@ class RequestRecorder:
             sum_other = sum(
                 st.sum_form + st.sum_post for _, st in items
             )
+            sum_hidden = sum(st.sum_hidden for _, st in items)
             overall = {
                 "ticks": sum(st.ticks for _, st in items),
                 "sheds": sum(st.sheds for _, st in items),
                 "queue_share": _share(sum_queue, sum_total),
                 "device_share": _share(sum_device, sum_total),
                 "other_share": _share(sum_other, sum_total),
+                # async pipeline: fraction of device time hidden behind
+                # host work (0/None on the synchronous path — no
+                # harvest stamps, nothing hidden)
+                "overlap_share": _share(sum_hidden, sum_device),
+            }
+            pipeline = {
+                "in_flight_depth": len(self._flights),
+                "in_flight_peak": self._inflight_peak,
+                "harvested_flights": self._harvested_flights,
             }
         spread = self.p99_spread_ms()
         return {
@@ -648,4 +754,5 @@ class RequestRecorder:
             },
             "profiled_device_ms": profiled,
             "scheduler": sched,
+            "pipeline": pipeline,
         }

@@ -1,0 +1,31 @@
+"""Async serving pipeline: double-buffered dispatch + per-device
+fan-out between the planner and the serving layer.
+
+Rank 4 in the layering DAG — above `plan`/`obs` (it consumes the mesh
+decision and annotates the plan stanza), below `serve` (the scheduler
+drives it; the pipeline must never import serve — flights carry
+opaque groups and the scheduler owns every state commit).
+
+- :mod:`hhmm_tpu.pipeline.place` — consistent-hash series→device
+  placement (:class:`DevicePlacement`), shared by the scheduler's
+  per-device pending queues and the pager's per-device residency
+  partition, recorded into the plan stanza.
+- :mod:`hhmm_tpu.pipeline.dispatch` — the in-flight flush table
+  (:class:`InFlightTable` of :class:`Flight`\\ s): un-synced device
+  futures parked between a non-blocking ``dispatch_async`` and a
+  ``harvest`` that syncs and commits, with the in-flight series
+  guard and FIFO harvest order.
+
+See docs/serving.md "Async pipeline" for the dispatch/harvest
+contract and docs/architecture.md for the layer map entry.
+"""
+
+from hhmm_tpu.pipeline.dispatch import Flight, InFlightTable
+from hhmm_tpu.pipeline.place import DevicePlacement, placement_for_plan
+
+__all__ = [
+    "DevicePlacement",
+    "Flight",
+    "InFlightTable",
+    "placement_for_plan",
+]

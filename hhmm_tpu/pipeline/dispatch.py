@@ -1,0 +1,168 @@
+"""In-flight flush table: the double-buffered dispatch substrate of
+the async serving pipeline (`docs/serving.md` "Async pipeline").
+
+JAX dispatch is already asynchronous — calling a jitted kernel
+returns device arrays whose computation proceeds in the background;
+``block_until_ready`` is the sync point. The synchronous scheduler
+threw that overlap away by syncing inside every dispatch. This module
+keeps the un-synced outputs alive instead: each dispatched flush
+group becomes a :class:`Flight` (device futures + everything the
+commit needs), parked in an :class:`InFlightTable` until a harvest
+demands the responses. Between dispatch and harvest the HOST is free
+— the next flush's queue drain, lane padding, and obs staging overlap
+the device's execution of the previous one (the cellular-batching
+overlap, Gao et al., applied to the tick kernels).
+
+Contracts the table enforces (the scheduler builds on them):
+
+- **commit-at-harvest**: a flight carries NO committed state — the
+  scheduler mutates filter state, history tails, staleness clocks,
+  and metrics only after the harvest-side sync succeeds, so a flight
+  that dies in the air sheds without torn state (invariant 8);
+- **in-flight series guard**: a series with an un-harvested flight
+  must not dispatch again — the next tick would stack filter state
+  the in-flight kernel is about to replace, folding observations out
+  of order. :meth:`InFlightTable.series_in_flight` is the guard set;
+  the scheduler defers guarded ticks to the next flush;
+- **FIFO harvest**: flights harvest in dispatch order
+  (:meth:`pop_oldest`), so multi-wave series fold in submission order
+  across flush boundaries;
+- **leaf lock**: the table's lock guards only its own dicts — no I/O,
+  no jax dispatch, no callbacks run under it (the PR 12 lock-order
+  rule: the pipeline's node in the lock DAG stays a leaf). The
+  blocking sync itself happens in the SCHEDULER, outside any lock.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["Flight", "InFlightTable"]
+
+
+class Flight:
+    """One dispatched-but-unharvested flush group: the un-synced
+    device outputs plus the host-side context its harvest-time commit
+    needs. Opaque to the table — the scheduler (one layer up) builds
+    and commits flights; the table only sequences them."""
+
+    __slots__ = (
+        "flush_id",
+        "kernel",
+        "bucket",
+        "device_index",
+        "group",
+        "traces",
+        "outputs",
+        "dtype_locks",
+        "fn",
+        "fargs",
+        "t_dispatch",
+    )
+
+    def __init__(
+        self,
+        flush_id: int,
+        kernel: str,
+        bucket: int,
+        device_index: int,
+        group: List[Any],
+        traces: List[Any],
+        outputs: Any,
+        dtype_locks: Dict[str, Any],
+        fn: Any,
+        fargs: tuple,
+        t_dispatch: float,
+    ):
+        self.flush_id = flush_id
+        self.kernel = kernel
+        self.bucket = bucket
+        self.device_index = device_index
+        self.group = group
+        self.traces = traces
+        self.outputs = outputs
+        self.dtype_locks = dtype_locks
+        self.fn = fn
+        self.fargs = fargs
+        self.t_dispatch = t_dispatch
+
+    @property
+    def series(self) -> List[str]:
+        return [p[0] for p in self.group]
+
+
+class InFlightTable:
+    """FIFO table of :class:`Flight`\\ s with the in-flight series
+    guard and depth accounting. Thread-safe; the lock is a LEAF in
+    the lock-order DAG (nothing blocking, no foreign locks, no
+    callbacks under it)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._flights: "OrderedDict[int, Flight]" = OrderedDict()
+        # series -> reference count of flights carrying it (a padded
+        # lane repeats a series inside ONE flight; across flights the
+        # guard defers re-dispatch, so counts are 1 in practice — the
+        # refcount keeps the set correct even if that changes)
+        self._series: Dict[str, int] = {}
+        self._next_id = 0
+        self._peak_depth = 0
+        self._dispatched = 0
+        self._harvested = 0
+
+    def next_id(self) -> int:
+        with self._lock:
+            self._next_id += 1
+            return self._next_id
+
+    def add(self, flight: Flight) -> None:
+        with self._lock:
+            self._flights[flight.flush_id] = flight
+            for s in set(flight.series):
+                self._series[s] = self._series.get(s, 0) + 1
+            self._dispatched += 1
+            if len(self._flights) > self._peak_depth:
+                self._peak_depth = len(self._flights)
+
+    def pop_oldest(self) -> Optional[Flight]:
+        """Remove and return the oldest flight (dispatch order), or
+        ``None`` when nothing is in flight. The caller syncs/commits
+        it OUTSIDE this table's lock."""
+        with self._lock:
+            if not self._flights:
+                return None
+            _, flight = self._flights.popitem(last=False)
+            for s in set(flight.series):
+                n = self._series.get(s, 0) - 1
+                if n <= 0:
+                    self._series.pop(s, None)
+                else:
+                    self._series[s] = n
+            self._harvested += 1
+            return flight
+
+    def guarded(self, series_id: str) -> bool:
+        """True while ``series_id`` has an un-harvested flight — its
+        next tick must wait (fold-order guard)."""
+        with self._lock:
+            return series_id in self._series
+
+    def series_in_flight(self) -> set:
+        with self._lock:
+            return set(self._series)
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._flights)
+
+    def stats(self) -> Dict[str, int]:
+        """JSON-ready table counters for the pipeline stanza."""
+        with self._lock:
+            return {
+                "depth": len(self._flights),
+                "peak_depth": self._peak_depth,
+                "dispatched": self._dispatched,
+                "harvested": self._harvested,
+            }

@@ -211,6 +211,19 @@ class Plan:
         self._mesh = Mesh(np.asarray(devices[:need]).reshape(shape), names)
         return self._mesh
 
+    def device_list(self) -> list:
+        """The concrete device handles this plan was built over (the
+        injected test devices, else the process' ``jax.devices()``),
+        truncated to the plan's device count. The async pipeline's
+        per-device fan-out (`hhmm_tpu/pipeline/`) targets these
+        directly with ``jax.device_put`` — one bucket ladder per
+        device — instead of the mesh sharding a single big flush
+        would use."""
+        import jax
+
+        devices = list(self._devices) if self._devices else jax.devices()
+        return devices[: max(1, min(int(self.n_devices), len(devices)))]
+
     def sharding(self, *axes):
         """``NamedSharding`` placing each array dimension on the named
         mesh axis (or replicated for ``None`` / axes the mesh doesn't

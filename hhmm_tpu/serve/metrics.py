@@ -89,6 +89,11 @@ class ServeMetrics:
         # re-timed; the per-(kernel, bucket) device-time gauges go to
         # the shared plane directly in note_flush_profile
         self._profiled_flushes = obs_metrics.Counter()
+        # async pipeline (hhmm_tpu/pipeline): ticks a dispatch
+        # generation deferred because their series still had an
+        # un-harvested flight (the fold-order guard) — they stay
+        # queued, not shed, and drain next generation
+        self._inflight_deferred = obs_metrics.Counter()
         # snapshot staleness (ROADMAP item 3): seconds since the oldest
         # serving snapshot was attached, written by the scheduler per
         # flush; the peak is the SLO-facing watermark for the window
@@ -118,6 +123,7 @@ class ServeMetrics:
             ("serve.tail_evictions", self._tail_evictions),
             ("serve.warm_page_ins", self._warm_page_ins),
             ("serve.tail_resident_bytes", self._tail_bytes),
+            ("serve.pipeline_deferred_ticks", self._inflight_deferred),
         ):
             obs_metrics.attach(name, inst)
         # tenant label values this instance has already created on the
@@ -293,6 +299,17 @@ class ServeMetrics:
         """A pager page-in replayed the series' retained history tail
         through the attach machinery instead of cold filtering."""
         self._warm_page_ins.inc()
+
+    def note_inflight_deferred(self, n: int = 1) -> None:
+        """An async dispatch generation deferred ``n`` queued ticks
+        whose series still had un-harvested flights (the pipeline's
+        fold-order guard) — deferred, not shed: they stay queued and
+        drain the next generation."""
+        self._inflight_deferred.inc(n)
+
+    @property
+    def inflight_deferred_ticks(self) -> int:
+        return int(self._inflight_deferred.get())
 
     @property
     def tail_evictions(self) -> int:

@@ -162,6 +162,22 @@ class SnapshotPager:
         self._resident_bytes = 0
         self._peak_resident_bytes = 0
         self._on_evict: Optional[Callable[[str], None]] = None
+        # in-flight load table (the async pipeline's double-load fix):
+        # name -> (Event, [result]) while a cold load is running. Two
+        # per-device queues paging the same series in concurrently must
+        # collapse to ONE registry .npz read — setdefault-first-writer-
+        # wins claims the slot under the lock, the load itself runs
+        # OUTSIDE it (PR 12 held-lock-escape), racers wait on the event
+        # and reuse the winner's result
+        self._loading: Dict[str, Tuple[threading.Event, list]] = {}
+        # per-device residency partition (async pipeline): the SAME
+        # consistent-hash placement the scheduler fans out with
+        # (hhmm_tpu/pipeline/place.py), so a snapshot stays resident
+        # adjacent to the device that serves it. None = single
+        # partition (the historical behavior, bit-for-bit)
+        self._placement = None
+        self._dev_of: Dict[str, int] = {}
+        self._dev_bytes: Dict[int, int] = {}
         # always-on product metrics (the ServeMetrics attach discipline)
         self._loads = obs_metrics.Counter()
         self._reloads = obs_metrics.Counter()
@@ -170,6 +186,7 @@ class SnapshotPager:
         self._misses = obs_metrics.Counter()
         self._budget_overruns = obs_metrics.Counter()
         self._load_retries = obs_metrics.Counter()
+        self._load_coalesced = obs_metrics.Counter()
         self._resident_gauge = obs_metrics.Gauge()
         for name, inst in (
             ("serve.pager_loads", self._loads),
@@ -179,11 +196,32 @@ class SnapshotPager:
             ("serve.pager_misses", self._misses),
             ("serve.pager_budget_overruns", self._budget_overruns),
             ("serve.pager_load_retries", self._load_retries),
+            ("serve.pager_load_coalesced", self._load_coalesced),
             ("serve.pager_resident_bytes", self._resident_gauge),
         ):
             obs_metrics.attach(name, inst)
 
     # ---- wiring ----
+
+    def set_placement(self, placement) -> None:
+        """Adopt the async pipeline's series→device placement
+        (:class:`hhmm_tpu.pipeline.place.DevicePlacement`): residency
+        splits into per-device partitions keyed by the SAME hash the
+        scheduler fans flushes out with, each holding an even share of
+        the byte budget (``budget_bytes // n_devices``, re-derived on
+        :meth:`refresh_budget`). One device's hot tenants can then
+        never evict another device's snapshots — eviction pressure is
+        as partitioned as the flush fan-out. ``None`` restores the
+        single global partition."""
+        with self._lock:
+            self._placement = placement
+            self._dev_of = {}
+            self._dev_bytes = {}
+            if placement is not None:
+                for name, (_, nbytes) in self._resident.items():
+                    d = placement.device_of(name)
+                    self._dev_of[name] = d
+                    self._dev_bytes[d] = self._dev_bytes.get(d, 0) + nbytes
 
     def set_evict_listener(self, fn: Optional[Callable[[str], None]]) -> None:
         """Called with each evicted name AFTER it leaves the resident
@@ -250,6 +288,20 @@ class SnapshotPager:
                 snap = self.registry.load(name)
             return snap
 
+        # in-flight load coalescing: two per-device flush queues (the
+        # async pipeline) paging the SAME series in concurrently must
+        # not both read the .npz — setdefault-first-writer-wins claims
+        # the slot under the lock; the loser waits on the winner's
+        # event OUTSIDE the lock and reuses its result
+        slot = (threading.Event(), [None])
+        with self._lock:
+            claimed = self._loading.setdefault(name, slot)
+        if claimed is not slot:
+            # racer: the first writer owns the load
+            self._load_coalesced.inc()
+            claimed[0].wait()
+            return claimed[1][0]
+
         # bounded second chances for TRANSIENT faults (robust/retry.py):
         # a torn read quarantines the file, so the retry only heals if a
         # concurrent writer re-saves during the backoff — exactly the
@@ -258,13 +310,23 @@ class SnapshotPager:
         # default failed-predicate: result is None (the registry's
         # corrupt-file-is-a-miss convention).
         kw = {} if self._retry_sleep is None else {"sleep": self._retry_sleep}
-        return retry_call(
-            _load_once,
-            self.load_retry,
-            on_retry=lambda attempt, err: self._load_retries.inc(),
-            salt=hash(name) & 0x7FFFFFFF,
-            **kw,
-        )
+        snap = None
+        try:
+            snap = retry_call(
+                _load_once,
+                self.load_retry,
+                on_retry=lambda attempt, err: self._load_retries.inc(),
+                salt=hash(name) & 0x7FFFFFFF,
+                **kw,
+            )
+        finally:
+            # release racers even on an exhausted/raising load (they
+            # see the miss and degrade exactly like the owner)
+            with self._lock:
+                self._loading.pop(name, None)
+            slot[1][0] = snap
+            slot[0].set()
+        return snap
 
     def touch(self, name: str) -> Optional[PosteriorSnapshot]:
         """Load-or-hit WITH admission (:meth:`load` + :meth:`admit`):
@@ -292,11 +354,11 @@ class SnapshotPager:
                 return
             if entry is not None:
                 self._resident.pop(name)
-                self._resident_bytes -= entry[1]
+                self._account_del_locked(name, entry[1])
             reload = name in self._ever_resident
             self._ever_resident.add(name)
             self._resident[name] = (snap, nbytes)
-            self._resident_bytes += nbytes
+            self._account_add_locked(name, nbytes)
             victims, overrun = self._collect_victims_locked(exempt=name)
             bytes_now = self._note_peak_locked()
         self._loads.inc()
@@ -317,6 +379,34 @@ class SnapshotPager:
 
     # ---- eviction ----
 
+    def _account_add_locked(self, name: str, nbytes: int) -> None:
+        """Lock held. Global + per-device-partition byte accounting."""
+        self._resident_bytes += nbytes
+        if self._placement is not None:
+            d = self._placement.device_of(name)
+            self._dev_of[name] = d
+            self._dev_bytes[d] = self._dev_bytes.get(d, 0) + nbytes
+
+    def _account_del_locked(self, name: str, nbytes: int) -> None:
+        """Lock held. Reverse of :meth:`_account_add_locked`."""
+        self._resident_bytes -= nbytes
+        d = self._dev_of.pop(name, None)
+        if d is not None:
+            left = self._dev_bytes.get(d, 0) - nbytes
+            if left <= 0:
+                self._dev_bytes.pop(d, None)
+            else:
+                self._dev_bytes[d] = left
+
+    def device_budget_bytes(self) -> Optional[int]:
+        """Each device partition's even share of the byte budget
+        (``None`` without a placement) — re-derived from whatever the
+        current budget is, so :meth:`refresh_budget`'s live-watermark
+        re-derivation splits through automatically."""
+        if self._placement is None or self._placement.n_devices <= 1:
+            return None
+        return max(1, self.budget_bytes // self._placement.n_devices)
+
     def _collect_victims_locked(
         self, exempt: Optional[str] = None
     ) -> Tuple[List[str], bool]:
@@ -327,8 +417,36 @@ class SnapshotPager:
         overrun is reported and allowed — shedding a tick to save
         memory is the admission policy's call, not the pager's.
         Listener dispatch and counters happen in :meth:`_publish`,
-        after the lock is released."""
+        after the lock is released.
+
+        With a placement attached (async pipeline) an inner pass runs
+        first: each over-budget DEVICE partition evicts LRU-first
+        among its own names until its even share of the budget holds
+        — one device's hot set can never push another device's
+        snapshots out. The global pass still runs after (partitions
+        under their share can still sum over a shrunk budget)."""
         victims: List[str] = []
+        dev_budget = self.device_budget_bytes()
+        if dev_budget is not None:
+            for d in [
+                d for d, b in self._dev_bytes.items() if b > dev_budget
+            ]:
+                while self._dev_bytes.get(d, 0) > dev_budget:
+                    victim = next(
+                        (
+                            n
+                            for n in self._resident  # LRU-first order
+                            if self._dev_of.get(n) == d
+                            and n != exempt
+                            and n not in self._pinned
+                        ),
+                        None,
+                    )
+                    if victim is None:
+                        break  # only pinned/exempt left: allowed overrun
+                    _, nbytes = self._resident.pop(victim)
+                    self._account_del_locked(victim, nbytes)
+                    victims.append(victim)
         while self._resident_bytes > self.budget_bytes:
             victim = next(
                 (
@@ -341,7 +459,7 @@ class SnapshotPager:
             if victim is None:
                 return victims, True
             _, nbytes = self._resident.pop(victim)
-            self._resident_bytes -= nbytes
+            self._account_del_locked(victim, nbytes)
             victims.append(victim)
         return victims, False
 
@@ -385,7 +503,7 @@ class SnapshotPager:
             entry = self._resident.pop(name, None)
             if entry is None:
                 return False
-            self._resident_bytes -= entry[1]
+            self._account_del_locked(name, entry[1])
             bytes_now = self._note_peak_locked()
         self._publish(bytes_now, [name])
         return True
@@ -396,7 +514,7 @@ class SnapshotPager:
         with self._lock:
             entry = self._resident.pop(name, None)
             if entry is not None:
-                self._resident_bytes -= entry[1]
+                self._account_del_locked(name, entry[1])
             self._pinned.discard(name)
             bytes_now = self._note_peak_locked()
         if entry is not None:
@@ -425,7 +543,15 @@ class SnapshotPager:
             resident = len(self._resident)
             resident_bytes = self._resident_bytes
             peak = self._peak_resident_bytes
-        return {
+            per_device = (
+                None
+                if self._placement is None
+                else {
+                    str(d): int(b)
+                    for d, b in sorted(self._dev_bytes.items())
+                }
+            )
+        out = {
             "budget_bytes": int(self.budget_bytes),
             "budget_source": self.budget_source,
             "resident": resident,
@@ -438,4 +564,11 @@ class SnapshotPager:
             "misses": int(self._misses.get()),
             "budget_overruns": int(self._budget_overruns.get()),
             "load_retries": int(self._load_retries.get()),
+            "load_coalesced": int(self._load_coalesced.get()),
         }
+        if per_device is not None:
+            out["per_device_bytes"] = per_device
+            dev_budget = self.device_budget_bytes()
+            if dev_budget is not None:
+                out["device_budget_bytes"] = int(dev_budget)
+        return out

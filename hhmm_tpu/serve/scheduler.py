@@ -86,6 +86,12 @@ from hhmm_tpu.obs import request as obs_request
 from hhmm_tpu.obs.telemetry import register_jit
 from hhmm_tpu.obs.trace import enabled as trace_enabled
 from hhmm_tpu.obs.trace import span, traced
+from hhmm_tpu.pipeline import (
+    DevicePlacement,
+    Flight,
+    InFlightTable,
+    placement_for_plan,
+)
 from hhmm_tpu.robust import faults
 from hhmm_tpu.robust.guards import finite_mask, guard_update
 from hhmm_tpu.serve.metrics import ServeMetrics
@@ -280,6 +286,8 @@ class MicroBatchScheduler:
         recorder: Optional[obs_request.RequestRecorder] = None,
         history_tail: int = 0,
         tail_budget_bytes: Optional[int] = None,
+        pipeline: bool = False,
+        placement: Optional[DevicePlacement] = None,
     ):
         """``plan``: an optional :class:`hhmm_tpu.plan.Plan` — the
         topology-aware placement decision (`docs/sharding.md`). When
@@ -451,6 +459,51 @@ class MicroBatchScheduler:
         except ValueError:
             self._model_spec = None
         self._signatures: set = set()
+        # ---- async flush pipeline (hhmm_tpu/pipeline) ----
+        # ``pipeline=True`` turns flush into dispatch_async + harvest
+        # (double-buffered: flush N+1's host-side bucket formation
+        # overlaps flush N's device time) with per-device fan-out over
+        # the placement hash. Passing an explicit placement implies
+        # pipeline mode.
+        if placement is not None:
+            pipeline = True
+        self._inflight: Optional[InFlightTable] = None
+        self._placement: Optional[DevicePlacement] = None
+        self._pipe_devices: list = []
+        self._dev_served: Dict[int, int] = {}
+        self._deferred_inflight = 0
+        self._update_async_j = None
+        if pipeline:
+            if placement is None:
+                placement = placement_for_plan(plan)
+            devs = (
+                plan.device_list() if plan is not None else list(jax.devices())
+            )
+            if placement.n_devices > len(devs):
+                raise ValueError(
+                    f"placement spans {placement.n_devices} devices but the "
+                    f"plan/backend exposes only {len(devs)}"
+                )
+            self._placement = placement
+            self._pipe_devices = devs[: placement.n_devices]
+            self._inflight = InFlightTable()
+            if plan is not None:
+                # the plan stanza carries the placement (annotated from
+                # ABOVE: plan ranks below pipeline in the layering DAG)
+                placement.record(plan)
+            if pager is not None:
+                # one hash, two consumers: the pager's residency
+                # partition must agree with the dispatch fan-out
+                pager.set_placement(placement)
+            # async update kernel: donates the freshly-stacked
+            # alpha/ll/ok input buffers (NEVER arg 0, the cached draw
+            # bank) so the device reuses their memory while the next
+            # flush's bucket forms on the host. A separate registered
+            # jit — invariant 5 and the compile audit see it.
+            self._update_async_j = register_jit(
+                "serve.tick_update_async",
+                jax.jit(self._update_impl, donate_argnums=(1, 2, 3)),
+            )
 
     # ---- jitted kernels (one specialization per bucket shape) ----
 
@@ -1099,6 +1152,22 @@ class MicroBatchScheduler:
         wave-splitting and fold semantics are unchanged; only WHICH
         ticks wait differs from FIFO."""
         pend = self._pending
+        selected = self._drr_select(pend, budget, pol)
+        drained = [p for i, p in enumerate(pend) if selected[i]]
+        self._pending = [p for i, p in enumerate(pend) if not selected[i]]
+        return drained
+
+    def _drr_select(
+        self,
+        pend: List[Tuple[str, Dict[str, Any], float, str, Any]],
+        budget: int,
+        pol: AdmissionPolicy,
+    ) -> List[bool]:
+        """DRR selection core over an EXPLICIT pending list: returns
+        the selected mask and handles the credit banking + flush-plan
+        recording side effects. The sync flush applies it to the whole
+        queue; the async pipeline applies it per device queue (split
+        budget), so DRR fairness holds within each device's flights."""
         shares = pol.tenant_shares or {}
         by_tenant: "OrderedDict[str, deque]" = OrderedDict()
         series_next: Dict[str, deque] = {}
@@ -1158,8 +1227,6 @@ class MicroBatchScheduler:
             if best is None or not take_one(best):
                 break
             n_taken += 1
-        drained = [p for i, p in enumerate(pend) if selected[i]]
-        self._pending = [p for i, p in enumerate(pend) if not selected[i]]
         # credit: stranded tenants bank their unused entitlement (capped),
         # fully-served tenants start the next flush with a clean slate
         stranded = {t: len(q) for t, q in by_tenant.items() if q}
@@ -1177,7 +1244,7 @@ class MicroBatchScheduler:
                 if served.get(t):
                     served_ord[t] = served[t]
             self._record_flush_plan(pol, "drr", served_ord, stranded)
-        return drained
+        return selected
 
     def _record_flush_plan(
         self,
@@ -1384,6 +1451,8 @@ class MicroBatchScheduler:
         Dispatched groups commit their state atomically, so a degraded
         group's series keep their pre-tick filter state (the caller may
         re-submit the observation)."""
+        if self._inflight is not None:
+            return self._flush_pipelined()
         carried, self._undelivered = self._undelivered, []
         if not self._pending:
             return carried
@@ -1533,6 +1602,472 @@ class MicroBatchScheduler:
         self.recorder.flush_done()
         self._refresh_compile_count()
         return carried + responses
+
+    # ---- async flush pipeline (hhmm_tpu/pipeline) ----
+
+    def _flush_pipelined(self) -> List[TickResponse]:
+        """Pipelined :meth:`flush` with synchronous semantics: every
+        admissible generation dispatches and harvests immediately.
+        Queued repeats of one series become successive GENERATIONS
+        (the in-flight guard admits one tick per series per flight),
+        each harvested before the next dispatches — the same fold
+        order as the sync path's waves. Overlap-seeking callers drive
+        :meth:`dispatch_async` / :meth:`harvest` directly instead; the
+        per-flush admission budget spans the generations exactly as it
+        spans the sync path's waves."""
+        out: List[TickResponse] = []
+        pol = self.admission
+        budget = (
+            None
+            if pol is None or pol.max_ticks_per_flush is None
+            else int(pol.max_ticks_per_flush)
+        )
+        while True:
+            n_flights, n_drained, n_deferred = self._dispatch_generation(
+                budget
+            )
+            out.extend(self.harvest())
+            if budget is not None:
+                budget -= n_drained
+                if budget <= 0:
+                    break
+            if n_flights == 0 or not n_deferred:
+                break
+        return out
+
+    @traced("serve.dispatch_async")
+    def dispatch_async(self) -> int:
+        """Non-blocking dispatch: drain one admissible generation of
+        pending ticks into per-device :class:`Flight`\\ s (jax async
+        dispatch — the jitted kernels are ENQUEUED, never synced) and
+        return the number of flights now airborne. 0 means nothing was
+        dispatchable: empty queue, or every pending series still
+        guarded by an un-harvested flight. Pair with :meth:`harvest`;
+        :meth:`flush` composes both back into sync semantics. While a
+        flight is airborne the host is free — callers submit/form the
+        NEXT flush's ticks over the device time of this one."""
+        if self._inflight is None:
+            raise ValueError("dispatch_async() requires pipeline=True")
+        n_flights, _, _ = self._dispatch_generation()
+        return n_flights
+
+    @traced("serve.harvest")
+    def harvest(self, max_flights: Optional[int] = None) -> List[TickResponse]:
+        """Sync + commit airborne flights, oldest first (fold order),
+        plus any parked shed responses. The ``note_harvest`` stamp
+        lands BEFORE the device sync: dispatch→harvest time is latency
+        the pipeline HID behind host work (``hidden_s``); the sync
+        wait after the stamp is true device stall (``stall_s``). All
+        state commits happen here (commit-at-harvest): a flight that
+        dies at sync sheds its whole group with NO torn state
+        (invariant 8) — its series keep their pre-tick filter state.
+        ``max_flights`` bounds how many flights to reap (``None`` =
+        drain the table)."""
+        if self._inflight is None:
+            raise ValueError("harvest() requires pipeline=True")
+        carried, self._undelivered = self._undelivered, []
+        t0 = obs_request.now()
+        responses: List[TickResponse] = []
+        folded: List[Tuple[str, Dict[str, Any], float, str, Any]] = []
+        n = 0
+        while max_flights is None or n < max_flights:
+            flight = self._inflight.pop_oldest()
+            if flight is None:
+                break
+            n += 1
+            self.recorder.note_harvest(flight.flush_id)
+            try:
+                outs = jax.block_until_ready(flight.outputs)
+            except Exception as e:
+                # the flight died in the air: nothing was committed
+                # (commit-at-harvest), so shedding the group leaves
+                # every series at its pre-tick state (invariant 8)
+                if _looks_like_device_loss(e):
+                    self.metrics.note_device_loss()
+                self.metrics.note_dispatch_error(
+                    len(flight.group),
+                    tenants=[
+                        p[4].tenant if p[4] is not None else p[3]
+                        for p in flight.group
+                    ],
+                )
+                err = f"{type(e).__name__}: {e}"
+                for p in flight.group:
+                    self.recorder.shed(p[4], f"flight failed ({err})")
+                responses.extend(
+                    self._make_shed(s, ts, f"flight failed ({err})")
+                    for s, _, ts, _, _ in flight.group
+                )
+                continue
+            resp, committed = self._commit_flight(flight, outs)
+            responses.extend(resp)
+            folded.extend(committed)
+        if n:
+            done = obs_request.now()
+            for p in folded:
+                self.metrics.observe_latency(done - p[2])
+            self.metrics.observe_flush(len(folded), done - t0)
+            if self._oldest_attach_t is not None:
+                self.metrics.observe_staleness(done - self._oldest_attach_t)
+            if self.pager is not None:
+                self.pager.shrink_to_budget()
+            self.recorder.flush_done()
+            self._refresh_compile_count()
+        return carried + responses
+
+    def _dispatch_generation(
+        self, budget: Optional[int] = None
+    ) -> Tuple[int, int, int]:
+        """One async dispatch generation: drain admissible pending
+        ticks — ONE per series; the in-flight guard defers a series'
+        later ticks and any series with an un-harvested flight — fan
+        them out per placement device, and enqueue one Flight per
+        bucket chunk without syncing. Returns ``(n_flights, n_drained,
+        n_deferred)``. Deferred ticks stay queued with their pins and
+        quota slots intact (they were never admitted)."""
+        pend = self._pending
+        if not pend:
+            return (0, 0, 0)
+        pol = self.admission
+        guard = self._inflight.series_in_flight()
+        eligible: List[Tuple[str, Dict[str, Any], float, str, Any]] = []
+        emap: List[int] = []  # eligible index -> pend index
+        seen: set = set()
+        for i, p in enumerate(pend):
+            if p[0] in guard or p[0] in seen:
+                continue
+            seen.add(p[0])
+            eligible.append(p)
+            emap.append(i)
+        n_deferred = len(pend) - len(eligible)
+        if n_deferred:
+            self._deferred_inflight += n_deferred
+            self.metrics.note_inflight_deferred(n_deferred)
+        if not eligible:
+            return (0, 0, n_deferred)
+        if budget is None:
+            budget = (
+                len(eligible)
+                if pol is None or pol.max_ticks_per_flush is None
+                else int(pol.max_ticks_per_flush)
+            )
+        budget = max(0, min(int(budget), len(eligible)))
+        if budget == 0:
+            return (0, 0, n_deferred)
+        drr = pol is not None and pol.flush_order == "drr"
+        # fan out BEFORE admission: each device drains its own queue
+        # with its budget share, so DRR fairness holds per device
+        split = self._placement.split(eligible, key=lambda p: p[0])
+        order = sorted(split)
+        # work-conserving budget split: even entitlement per device,
+        # leftover waterfalls to still-backlogged devices
+        shares: Dict[int, int] = {d: 0 for d in order}
+        hungry = {d: len(split[d]) for d in order}
+        remaining = budget
+        while remaining > 0:
+            active = [d for d in order if hungry[d] > 0]
+            if not active:
+                break
+            per = max(1, remaining // len(active))
+            for d in active:
+                take = min(per, hungry[d], remaining)
+                shares[d] += take
+                hungry[d] -= take
+                remaining -= take
+                if remaining <= 0:
+                    break
+        taken_pend: set = set()
+        drained_by_dev: Dict[int, list] = {}
+        n_drained = 0
+        for d in order:
+            pairs = split[d]  # [(eligible_index, entry)]
+            share = shares[d]
+            if share <= 0:
+                continue
+            entries = [p for _, p in pairs]
+            if drr and share < len(entries):
+                sel = self._drr_select(entries, share, pol)
+            else:
+                sel = [i < share for i in range(len(entries))]
+                if drr:
+                    # full drain for this device: banked catch-up
+                    # credit is spent/voided (mirrors the sync path)
+                    for p in entries[:share]:
+                        self._credit.pop(p[3], None)
+                if self.recorder.enabled():
+                    served: "OrderedDict[str, int]" = OrderedDict()
+                    for p in entries[:share]:
+                        served[p[3]] = served.get(p[3], 0) + 1
+                    stranded: Dict[str, int] = {}
+                    for p in entries[share:]:
+                        stranded[p[3]] = stranded.get(p[3], 0) + 1
+                    self._record_flush_plan(
+                        pol, "drr" if drr else "fifo", served, stranded
+                    )
+            dev_list = []
+            for (ei, p), s in zip(pairs, sel):
+                if s:
+                    taken_pend.add(emap[ei])
+                    dev_list.append(p)
+            if dev_list:
+                drained_by_dev[d] = dev_list
+                n_drained += len(dev_list)
+        if not n_drained:
+            return (0, 0, n_deferred)
+        self._pending = [
+            p for i, p in enumerate(pend) if i not in taken_pend
+        ]
+        drained_all = [p for d in order for p in drained_by_dev.get(d, ())]
+        for p in drained_all:
+            self._dec_pending(p[0])
+            self._dec_tenant(p[3])
+        self.recorder.admit([p[4] for p in drained_all])
+        n_flights = 0
+        for d in order:
+            group_d = drained_by_dev.get(d)
+            if group_d:
+                n_flights += self._launch_device(d, group_d)
+        return (n_flights, n_drained, n_deferred)
+
+    def _launch_device(self, device_index: int, drained: list) -> int:
+        """Shed-validate one device's drained ticks (locked keyset,
+        detached-since-submit), split fresh/live, and enqueue one
+        un-synced Flight per bucket chunk. A chunk whose dispatch
+        fails sheds immediately — nothing was committed and its series
+        never entered the in-flight table."""
+        if self._obs_keys_lock is not None:
+            ref = self._obs_keys_lock
+        else:
+            counts: Dict[Tuple[str, ...], int] = {}
+            for p in drained:
+                k = tuple(sorted(p[1].keys()))
+                counts[k] = counts.get(k, 0) + 1
+            ref = max(counts, key=counts.get)
+        ok_list = []
+        for p in drained:
+            keys = tuple(sorted(p[1].keys()))
+            if keys != ref:
+                err = (
+                    f"observation keys {list(keys)} do not match "
+                    f"this scheduler's locked keys {list(ref)}"
+                )
+                self.metrics.note_shed_tick(
+                    tenant=p[4].tenant if p[4] is not None else p[3]
+                )
+                self.recorder.shed(p[4], err)
+                self._undelivered.append(self._make_shed(p[0], p[2], err))
+            elif p[0] not in self._series:
+                self.metrics.note_shed_tick(
+                    tenant=p[4].tenant if p[4] is not None else p[3]
+                )
+                self.recorder.shed(p[4], "series detached")
+                self._undelivered.append(
+                    self._make_shed(p[0], p[2], "series detached")
+                )
+            else:
+                ok_list.append(p)
+        fresh = [p for p in ok_list if self._series[p[0]]["alpha"] is None]
+        live = [p for p in ok_list if self._series[p[0]]["alpha"] is not None]
+        n_flights = 0
+        for group, kernel in ((fresh, "init"), (live, "update")):
+            for c0 in range(0, len(group), self.buckets[-1]):
+                chunk = group[c0 : c0 + self.buckets[-1]]
+                try:
+                    flight = self._dispatch_begin(chunk, kernel, device_index)
+                except Exception as e:
+                    # tracing/compilation failures surface HERE (jax
+                    # compiles eagerly; only execution is async):
+                    # degrade the chunk, keep launching the rest
+                    if _looks_like_device_loss(e):
+                        self.metrics.note_device_loss()
+                    self.metrics.note_dispatch_error(
+                        len(chunk),
+                        tenants=[
+                            p[4].tenant if p[4] is not None else p[3]
+                            for p in chunk
+                        ],
+                    )
+                    err = f"{type(e).__name__}: {e}"
+                    for p in chunk:
+                        self.recorder.shed(p[4], f"dispatch failed ({err})")
+                    self._undelivered.extend(
+                        self._make_shed(s, ts, f"dispatch failed ({err})")
+                        for s, _, ts, _, _ in chunk
+                    )
+                    continue
+                self._inflight.add(flight)
+                self.recorder.begin_flight(flight.flush_id, flight.traces)
+                n_flights += 1
+        return n_flights
+
+    def _dispatch_begin(
+        self, group, kernel: str, device_index: int
+    ) -> Flight:
+        """Form one device's bucket micro-batch and ENQUEUE the jitted
+        tick kernel without syncing: the returned Flight holds the
+        device futures plus everything :meth:`_commit_flight` needs.
+        Inputs land on the owning device via ``device_put`` (the
+        placement hash — the same partition the pager's residency
+        budget keys on). The update path runs the DONATED async jit:
+        the freshly-stacked alpha/ll/ok buffers (never the cached draw
+        bank) hand their device memory back for reuse while the next
+        flush forms on the host."""
+        lanes = self._pad_lanes(group)
+        bn = len(lanes)
+        traces = [p[4] for p in group]
+        self.recorder.stage(traces, "bucket")
+        obs_keys = sorted(group[0][1].keys())
+        obs_b = {}
+        dtype_locks: Dict[str, Any] = {}
+        for k in obs_keys:
+            arr = jnp.asarray(np.stack([np.asarray(p[1][k]) for p in lanes]))
+            # same dtype-lock discipline as the sync path; the lock
+            # COMMITS at harvest (after the flight's sync succeeds)
+            locked = self._obs_dtypes.get(k)
+            if locked is None:
+                dtype_locks[k] = arr.dtype
+            else:
+                promoted = jnp.promote_types(locked, arr.dtype)
+                if promoted != locked:
+                    dtype_locks[k] = promoted
+                arr = arr.astype(dtype_locks.get(k, locked))
+            obs_b[k] = arr
+        device = (
+            self._pipe_devices[device_index]
+            if device_index < len(self._pipe_devices)
+            else None
+        )
+        if device is not None:
+            place = lambda a: jax.device_put(a, device)  # noqa: E731
+        else:
+            place = lambda a: a  # noqa: E731
+        obs_b = {k: place(v) for k, v in obs_b.items()}
+        lane_key = tuple(p[0] for p in lanes)
+        draws_b = self._draws_cache.get(lane_key)
+        if draws_b is None:
+            if len(self._draws_cache) >= 64:  # bound churny memberships
+                self._draws_cache.clear()
+            draws_b = place(
+                jnp.stack([self._series[s]["draws"] for s in lane_key])
+            )
+            self._draws_cache[lane_key] = draws_b
+        faults.dispatch_fault()
+        with span(f"serve.dispatch.{kernel}") as sp:
+            sp.annotate(bucket=bn, device=device_index, pipelined=True)
+            if kernel == "init":
+                fn, fargs = self._init_j, (draws_b, obs_b)
+            else:
+                alpha_b = place(
+                    jnp.stack([self._series[p[0]]["alpha"] for p in lanes])
+                )
+                ll_b = place(
+                    jnp.stack([self._series[p[0]]["ll"] for p in lanes])
+                )
+                ok_b = place(
+                    jnp.stack([self._series[p[0]]["ok"] for p in lanes])
+                )
+                fn = self._update_async_j
+                fargs = (draws_b, alpha_b, ll_b, ok_b, obs_b)
+            self.recorder.stage(traces, "dispatch")
+            outputs = fn(*fargs)  # enqueued on the device, NOT synced
+        return Flight(
+            flush_id=self._inflight.next_id(),
+            kernel=kernel,
+            bucket=bn,
+            device_index=device_index,
+            group=list(group),
+            traces=traces,
+            outputs=outputs,
+            dtype_locks=dtype_locks,
+            fn=fn,
+            fargs=fargs,
+            t_dispatch=obs_request.now(),
+        )
+
+    def _commit_flight(
+        self, flight: Flight, outs
+    ) -> Tuple[List[TickResponse], list]:
+        """Commit one synced flight — dtype locks, keyset lock, filter
+        state, history tails, responses: exactly the commit the sync
+        path runs inline, moved to harvest time. Returns ``(responses,
+        committed_entries)``; a series detached between dispatch and
+        harvest (pager eviction) drops its lane as a shed — its filter
+        state is already gone, nothing is torn."""
+        alpha, ll, okd, probs, mean_ll, inc = outs
+        self._obs_dtypes.update(flight.dtype_locks)
+        if self._obs_keys_lock is None and flight.group:
+            self._obs_keys_lock = tuple(sorted(flight.group[0][1].keys()))
+        obs_b = flight.fargs[-1]
+        self._note_signature(
+            flight.kernel,
+            flight.bucket,
+            tuple(str(obs_b[k].dtype) for k in sorted(obs_b)),
+        )
+        done = obs_request.now()
+        self.recorder.stage(flight.traces, "device", t=done)
+        responses: List[TickResponse] = []
+        committed: list = []
+        committed_traces: list = []
+        for i, (series_id, obs_i, t_submit, tenant, trace) in enumerate(
+            flight.group
+        ):
+            rec = self._series.get(series_id)
+            if rec is None:
+                self.metrics.note_shed_tick(
+                    tenant=trace.tenant if trace is not None else tenant
+                )
+                self.recorder.shed(trace, "series detached in flight")
+                responses.append(
+                    self._make_shed(
+                        series_id, t_submit, "series detached in flight"
+                    )
+                )
+                continue
+            rec["alpha"], rec["ll"], rec["ok"] = alpha[i], ll[i], okd[i]
+            if self.history_tail:
+                self._tail_append(series_id, obs_i)
+            n_ok = int(np.asarray(okd[i]).sum())
+            degraded = bool(rec["degraded_attach"]) or n_ok == 0
+            if degraded:
+                self.metrics.note_degraded_response()
+            responses.append(
+                TickResponse(
+                    series_id=series_id,
+                    probs=np.asarray(probs[i]),
+                    loglik=float(mean_ll[i]),
+                    healthy_draws=n_ok,
+                    degraded=degraded,
+                    latency_s=done - t_submit,
+                    per_draw_loglik=np.asarray(inc[i]),
+                    draw_ok=np.asarray(okd[i]),
+                )
+            )
+            committed.append(flight.group[i])
+            committed_traces.append(trace)
+        self._dev_served[flight.device_index] = self._dev_served.get(
+            flight.device_index, 0
+        ) + len(committed)
+        self.recorder.complete_group(
+            committed_traces, kernel=flight.kernel, bucket=flight.bucket
+        )
+        return responses, committed
+
+    def pipeline_stats(self) -> Optional[Dict[str, Any]]:
+        """Pipeline observables for benches and reports: in-flight
+        table counters, per-device served-lane counts, the fold-order
+        guard's deferral total, and the placement stanza. ``None``
+        when the scheduler was built without ``pipeline=True``."""
+        if self._inflight is None:
+            return None
+        st: Dict[str, Any] = dict(self._inflight.stats())
+        st["n_devices"] = self._placement.n_devices
+        st["per_device_served"] = {
+            str(d): int(self._dev_served.get(d, 0))
+            for d in range(self._placement.n_devices)
+        }
+        st["deferred_ticks"] = int(self._deferred_inflight)
+        st["placement"] = self._placement.stanza()
+        return st
 
     def _maybe_profile_flush(self) -> None:
         """Sampled flush profiling (the kernel cost plane's serving
@@ -1944,7 +2479,10 @@ class MicroBatchScheduler:
         entry per distinct traced signature) when available, else the
         host-side signature set."""
         n = 0
-        for f in (self._init_j, self._update_j, self._replay_j, self._unpack_j):
+        jits = [self._init_j, self._update_j, self._replay_j, self._unpack_j]
+        if self._update_async_j is not None:
+            jits.append(self._update_async_j)
+        for f in jits:
             cache_size = getattr(f, "_cache_size", None)
             if callable(cache_size):
                 n += cache_size()

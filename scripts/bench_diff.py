@@ -92,6 +92,15 @@ Further gate rules:
   near-zero spread is cross-tenant scheduling jitter, and relative
   growth on jitter would false-fail CI (both cases report as the
   request-plane baseline instead).
+- **The async-pipeline duel gates within the record**: a ``pipeline``
+  stanza carrying the sync-vs-async overlap duel fields
+  (``sync_queue_share`` / ``async_queue_share``, from ``bench.py
+  --pipeline``, `hhmm_tpu/pipeline/`) fails the gate unless the async
+  arm's queue share sits STRICTLY below the sync baseline's with zero
+  parity mismatches — like the FIFO-vs-DRR duel, the stanza ships its
+  own baseline arm, so no prior record is needed. Equality means the
+  double-buffered dispatch/harvest split hid nothing; a parity
+  mismatch means it hid latency by serving different posteriors.
 - **Kernel device time gates inverted**: a record whose manifest
   stanza carries a ``kernel_costs`` table (`bench.py
   --profile-kernels`, `hhmm_tpu/obs/profile.py`) fails the gate when
@@ -520,6 +529,44 @@ def diff(
                         {l: v for l, v in cur.items() if v is not None}
                     )
                     last_request_by_key[key] = merged
+            # the async-pipeline duel gates within the record, like the
+            # FIFO-vs-DRR duel: the stanza ships its own sync baseline
+            # arm, so the async arm's queue share must sit strictly
+            # below it (equality = the overlap bought nothing) and the
+            # posterior stream must match bitwise (a mismatch = it hid
+            # latency by serving different answers)
+            pipe = (rec.get("manifest") or {}).get("pipeline")
+            if isinstance(pipe, dict) and "async_queue_share" in pipe:
+                sync_q = pipe.get("sync_queue_share")
+                async_q = pipe.get("async_queue_share")
+                try:
+                    mismatches = int(pipe.get("parity_mismatches") or 0)
+                except (TypeError, ValueError):
+                    mismatches = -1  # malformed: visible, never clean
+                if (
+                    not isinstance(sync_q, (int, float))
+                    or not isinstance(async_q, (int, float))
+                    or async_q >= sync_q
+                ):
+                    failures += 1
+                    row["gated"] = True
+                    row["status"] += (
+                        "; PIPELINE REGRESSION: async queue share not "
+                        f"strictly below sync (sync={sync_q}, "
+                        f"async={async_q})"
+                    )
+                elif mismatches != 0:
+                    failures += 1
+                    row["gated"] = True
+                    row["status"] += (
+                        f"; PIPELINE REGRESSION: {mismatches} parity "
+                        "mismatch(es) between the sync and async arms"
+                    )
+                else:
+                    row["status"] += (
+                        f"; pipeline overlap holds (queue {sync_q:g} "
+                        f"-> {async_q:g})"
+                    )
             # kernel device time rides the same key, gated INVERTED:
             # a measured row whose p50 grew past the threshold against
             # the previous comparable record's same row is a device-

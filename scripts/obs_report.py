@@ -28,6 +28,12 @@ SLO attainment). This script folds all of it into one readable report:
                      observables (p99 spread, queue age, interleaving),
                      and the scheduler's flush-order attribution table
                      (per-tenant share/served/stranded/credit)
+  == pipeline ==     the `hhmm_tpu/pipeline/` async flush plane
+                     (`bench.py --pipeline`): in-flight dispatch/harvest
+                     depth, the sync-vs-async overlap duel verdict
+                     (queue share, hidden device time, bitwise parity),
+                     consistent-hash placement and the per-device
+                     served table
   == storm ==        the `bench.py --serve-storm` verdict: faults
                      injected/escaped + survival gates, fairness arms
                      incl. the FIFO-vs-DRR duel, warm page-in parity
@@ -446,6 +452,63 @@ def render_kernel_costs(man: Dict[str, Any], out) -> None:
         print(f"  cost DB: {kc['db_path']}", file=out)
 
 
+def render_pipeline(man: Dict[str, Any], out) -> None:
+    """The async flush pipeline (`hhmm_tpu/pipeline/`): in-flight
+    dispatch/harvest depth from the request stanza, the sync-vs-async
+    overlap duel verdict (``bench.py --pipeline``), consistent-hash
+    placement and the per-device fan-out table."""
+    pipe = man.get("pipeline") or _record_manifest(man).get("pipeline")
+    req = man.get("request") or _record_manifest(man).get("request")
+    flight = req.get("pipeline") if isinstance(req, dict) else None
+    if not isinstance(pipe, dict) and not isinstance(flight, dict):
+        return  # no async pipeline in this run: no section
+    _section("pipeline", out)
+    if isinstance(flight, dict):
+        print(
+            f"  in-flight: depth {_fmt(flight.get('in_flight_depth'))} "
+            f"(peak {_fmt(flight.get('in_flight_peak'))}), "
+            f"{_fmt(flight.get('harvested_flights'))} flight(s) harvested",
+            file=out,
+        )
+    if not isinstance(pipe, dict):
+        return
+    if "async_queue_share" in pipe:
+        print(
+            "  overlap duel: queue share sync "
+            f"{_pct(pipe.get('sync_queue_share'))} -> async "
+            f"{_pct(pipe.get('async_queue_share'))}, hidden "
+            f"{_pct(pipe.get('overlap_share'))} of device time, "
+            f"{_fmt(pipe.get('parity_mismatches'))} parity mismatch(es) — "
+            + ("OK" if pipe.get("ok") else "REGRESSED"),
+            file=out,
+        )
+    # prefer the serving fleet's own counters (the main replay) over
+    # the duel's synthetic cohort when both are present
+    fleet = pipe.get("fleet")
+    src = fleet if isinstance(fleet, dict) else pipe
+    if src is fleet and "overlap_share" in src:
+        print(
+            f"  replay overlap share: {_pct(src.get('overlap_share'))}",
+            file=out,
+        )
+    placement = src.get("placement")
+    if isinstance(placement, dict) and placement:
+        print(
+            f"  placement: {_fmt(placement.get('algo'))} over "
+            f"{_fmt(src.get('n_devices'))} device(s), "
+            f"{_fmt(src.get('deferred_ticks'))} tick(s) deferred by the "
+            "fold-order guard",
+            file=out,
+        )
+    served = src.get("per_device_served")
+    if isinstance(served, dict) and served:
+        rows = [
+            (str(d), _fmt(n))
+            for d, n in sorted(served.items(), key=lambda kv: str(kv[0]))
+        ]
+        _table(("device", "served"), rows, out)
+
+
 def render_storm(man: Dict[str, Any], out) -> None:
     """The ``--serve-storm`` stanza (`bench.py`): injected-fault plan,
     escaped-fault count, the survival gates — the section this
@@ -814,6 +877,7 @@ def render(
     render_convergence(metrics, out)
     render_serving(metrics, out)
     render_request(man, out)
+    render_pipeline(man, out)
     render_storm(man, out)
     render_maint(man, out)
     render_adapt(man, out)

@@ -649,6 +649,41 @@ class TestLayering:
         hits = _fires(rep, "layer-import")
         assert len(hits) == 1 and "unmapped" in hits[0].message
 
+    def test_pipeline_to_serve_back_edge_fires(self, tmp_path):
+        """The PR 18 contract: ``pipeline`` ranks BELOW ``serve`` —
+        a pipeline module importing the serving layer is a back-edge
+        (flights must carry opaque groups; commits live in serve)."""
+        src = "from hhmm_tpu.serve.scheduler import MicroBatchScheduler\n"
+        rep = _run(tmp_path, {"hhmm_tpu/pipeline/toy.py": src}, ["layer-import"])
+        hits = _fires(rep, "layer-import")
+        assert len(hits) == 1 and "back-edge" in hits[0].message
+
+    def test_serve_to_pipeline_import_silent(self, tmp_path):
+        src = (
+            "from hhmm_tpu.pipeline import InFlightTable\n"
+            "from hhmm_tpu.pipeline.place import DevicePlacement\n"
+        )
+        rep = _run(tmp_path, {"hhmm_tpu/serve/toy.py": src}, ["layer-import"])
+        assert not _fires(rep, "layer-import"), _ids(rep)
+
+    def test_pipeline_sibling_and_plan_imports(self, tmp_path):
+        # pipeline shares rank 4 with models/batch (sibling: fires)
+        # and sits above plan/obs (downward: silent)
+        bad = "from hhmm_tpu.models import TayalHHMM\n"
+        rep = _run(
+            tmp_path / "bad", {"hhmm_tpu/pipeline/toy.py": bad}, ["layer-import"]
+        )
+        hits = _fires(rep, "layer-import")
+        assert len(hits) == 1 and "same-rank sibling" in hits[0].message
+        good = (
+            "from hhmm_tpu.plan import make_plan\n"
+            "from hhmm_tpu.obs import manifest\n"
+        )
+        rep = _run(
+            tmp_path / "good", {"hhmm_tpu/pipeline/ok.py": good}, ["layer-import"]
+        )
+        assert not _fires(rep, "layer-import"), _ids(rep)
+
     def test_pragma_audits_lazy_cycle_breaker(self, tmp_path):
         src = (
             "def f():\n"

@@ -892,7 +892,7 @@ def _run_bench_diff(*argv):
 
 def _write_fixture_rounds(
     d, values, stamped=True, traced=None, slo=None, escaped=None, request=None,
-    duel=None, parity=None, adapt=None,
+    duel=None, parity=None, adapt=None, pipeline=None,
 ):
     for n, v in enumerate(values, start=1):
         rec = {
@@ -929,6 +929,20 @@ def _write_fixture_rounds(
             if parity is not None and parity[n - 1] is not None:
                 rec["manifest"].setdefault("storm", {})["warm_page_in"] = {
                     "parity": bool(parity[n - 1])
+                }
+            if pipeline is not None and pipeline[n - 1] is not None:
+                sync_q, async_q, mism = pipeline[n - 1]
+                rec["manifest"]["pipeline"] = {
+                    "sync_queue_share": sync_q,
+                    "async_queue_share": async_q,
+                    "overlap_share": 0.99,
+                    "parity_mismatches": mism,
+                    "ok": bool(
+                        isinstance(sync_q, (int, float))
+                        and isinstance(async_q, (int, float))
+                        and async_q < sync_q
+                        and mism == 0
+                    ),
                 }
             if adapt is not None and adapt[n - 1] is not None:
                 tracking, breaches = adapt[n - 1]
@@ -1136,6 +1150,50 @@ class TestBenchDiffFairnessDuel:
         assert "warm page-in parity" in proc.stdout
 
 
+class TestBenchDiffPipeline:
+    """The `bench.py --pipeline` ``pipeline`` stanza gates within the
+    record like the FIFO-vs-DRR duel: the stanza ships its own sync
+    baseline arm, so the async arm's queue share must sit strictly
+    below it with zero parity mismatches — no prior record needed."""
+
+    def test_overlap_holds_passes(self, tmp_path):
+        _write_fixture_rounds(
+            tmp_path, [100.0, 99.0],
+            pipeline=[(0.34, 0.01, 0), (0.33, 0.008, 0)],
+        )
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 0, proc.stdout
+        assert "pipeline overlap holds" in proc.stdout
+
+    def test_equality_fails_even_on_first_record(self, tmp_path):
+        # strictly below: equal queue share means the double-buffered
+        # split hid nothing, and no prior record is needed to see it
+        _write_fixture_rounds(tmp_path, [100.0], pipeline=[(0.2, 0.2, 0)])
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 1, proc.stdout
+        assert "PIPELINE REGRESSION" in proc.stdout
+
+    def test_inversion_fails(self, tmp_path):
+        _write_fixture_rounds(tmp_path, [100.0], pipeline=[(0.1, 0.3, 0)])
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 1, proc.stdout
+        assert "PIPELINE REGRESSION" in proc.stdout
+
+    def test_parity_mismatch_fails(self, tmp_path):
+        # a queue-share win bought by serving different posteriors is
+        # not a win
+        _write_fixture_rounds(tmp_path, [100.0], pipeline=[(0.3, 0.01, 2)])
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 1, proc.stdout
+        assert "PIPELINE REGRESSION" in proc.stdout
+        assert "parity mismatch" in proc.stdout
+
+    def test_unmeasured_arm_fails(self, tmp_path):
+        _write_fixture_rounds(tmp_path, [100.0], pipeline=[(None, 0.01, 0)])
+        proc = _run_bench_diff("--dir", str(tmp_path))
+        assert proc.returncode == 1, proc.stdout
+
+
 class TestBenchDiffAdaptation:
     """The `bench.py --adapt` ``adapt`` stanza gates like resilience:
     a tracking baseline -> tracking lost, or a clean ESS baseline ->
@@ -1314,6 +1372,14 @@ class TestObsReport:
         assert "p99 spread 1.9875 ms" in out
         assert "(+1 tenant(s) omitted" in out
         assert "warm device re-time update/b128" in out
+        # the async flush pipeline: in-flight depth, the overlap duel
+        # verdict, and the per-device fan-out table
+        assert "== pipeline ==" in out
+        assert "in-flight: depth 0 (peak 2), 14 flight(s) harvested" in out
+        assert "queue share sync 33.6% -> async 0.9%" in out
+        assert "0 parity mismatch(es) — OK" in out
+        assert "replay overlap share: 77.9%" in out
+        assert "blake2b8-mod over 2 device(s), 1 tick(s) deferred" in out
         # the storm fairness arms
         assert "skewed p99 spread 66.8182 ms vs balanced 2.3868 ms" in out
         # the adaptation plane: ladder counters, ESS table, verdict

@@ -295,6 +295,80 @@ class TestRecorder:
         assert total == 8  # folded, never dropped
 
 
+class TestFlightHarvest:
+    def test_interleaved_flights_stamp_their_own_traces(self):
+        """PR 18 regression: two overlapped in-flight flushes — each
+        ``note_harvest`` stamps ONLY its own flight's traces, from the
+        harvest site. (The naive wiring stamped harvest from the
+        dispatch site, so overlapping flights shared one clock read
+        and the hidden/stall split collapsed to zero.)"""
+        clock = _Clock()
+        rec = RequestRecorder(enabled=True, clock=clock)
+
+        def dispatched(tenant):
+            tr = rec.enqueue("s-" + tenant, tenant)
+            clock.t += 0.1
+            rec.admit([tr])
+            rec.stage([tr], "bucket")
+            clock.t += 0.01
+            rec.stage([tr], "dispatch")
+            return tr
+
+        tr1 = dispatched("a")          # dispatch at t=0.11
+        rec.begin_flight(1, [tr1])
+        clock.t += 0.05                # flight 1 airborne while 2 forms
+        tr2 = dispatched("b")          # dispatch at t=0.27
+        rec.begin_flight(2, [tr2])
+        assert rec.in_flight_depth() == 2
+        clock.t += 0.2
+        rec.note_harvest(1)            # t=0.47
+        clock.t += 0.3
+        rec.note_harvest(2)            # t=0.77
+        assert rec.in_flight_depth() == 0
+        assert tr1.t_harvest == pytest.approx(0.47)
+        assert tr2.t_harvest == pytest.approx(0.77)
+        clock.t += 0.1                 # both sync-complete at t=0.87
+        rec.stage([tr1, tr2], "device")
+        clock.t += 0.001
+        rec.complete_group([tr1, tr2], kernel="update", bucket=8)
+        d1, d2 = tr1.decompose(), tr2.decompose()
+        # hidden = dispatch->harvest (latency the pipeline hid behind
+        # host work); stall = harvest->device (residual true wait)
+        assert d1["hidden_s"] == pytest.approx(0.36)
+        assert d1["stall_s"] == pytest.approx(0.40)
+        assert d2["hidden_s"] == pytest.approx(0.50)
+        assert d2["stall_s"] == pytest.approx(0.10)
+        rec.flush_done()
+        st = rec.stanza()
+        assert st["pipeline"]["in_flight_depth"] == 0
+        assert st["pipeline"]["in_flight_peak"] == 2
+        assert st["pipeline"]["harvested_flights"] == 2
+        assert st["overall"]["overlap_share"] == pytest.approx(
+            (0.36 + 0.50) / (0.76 + 0.60), abs=1e-4
+        )
+
+    def test_unknown_flight_harvest_is_noop(self):
+        clock = _Clock()
+        rec = RequestRecorder(enabled=True, clock=clock)
+        rec.note_harvest(999)  # never registered: must not raise
+        assert rec.in_flight_depth() == 0
+
+    def test_reset_window_carries_live_flights(self):
+        """Live flights survive a window reset exactly like queue
+        occupancy: the peak restarts at the carried depth."""
+        clock = _Clock()
+        rec = RequestRecorder(enabled=True, clock=clock)
+        tr = rec.enqueue("s", "a")
+        rec.begin_flight(7, [tr])
+        rec.reset_window()
+        assert rec.in_flight_depth() == 1
+        st = rec.stanza()
+        assert st["pipeline"]["in_flight_peak"] == 1
+        assert st["pipeline"]["harvested_flights"] == 0
+        rec.note_harvest(7)
+        assert tr.t_harvest is not None
+
+
 class TestSchedulerIntegration:
     def _sched(self, **kw):
         model = MultinomialHMM(K=2, L=3)
