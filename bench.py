@@ -425,6 +425,174 @@ def _pipeline_overlap_duel(model, obs_fn, quick: bool) -> dict:
     }
 
 
+def _carry_residency_duel(model, obs_fn, quick: bool) -> dict:
+    """Staged-vs-resident scheduler duel for the ``--serve`` bench
+    (`hhmm_tpu/serve/lanes.py`, docs/serving.md "Device-resident
+    carry"): identical traffic through a host-staged scheduler and a
+    ``resident=True`` one, fresh scheduler/metrics/recorder per arm —
+    the fairness-duel pattern. The staged arm re-stacks every lane's
+    ``(alpha, ll, ok)`` carry on the host and re-uploads it each
+    flush; the resident arm keeps the carry banked on device, so a
+    stable-membership flush transfers ONLY the folded observations up
+    and the response surface down (a bank hit stages zero carry
+    bytes). Both arms replay the same churn event mid-window — a
+    detach followed by a warm page-in through the retained tail — so
+    the parity claim covers the commit boundary where a stale device
+    bank would silently serve pre-detach state.
+
+    The ``ok`` verdict requires: the resident arm's h2d byte counter
+    STRICTLY below the staged arm's (the transfer win — carry bytes
+    left the per-flush upload), d2h bytes EQUAL (the response surface
+    is identical traffic), the resident arm's form+post latency share
+    (``other_share``) strictly below the staged arm's (the host-side
+    restack left the tick path), bitwise response parity on the FULL
+    surface — probs, loglik, per-draw logliks, draw-ok mask — keyed
+    ``(round, series)``, zero sheds, a live carry-residency gauge in
+    the resident arm only, and a flat post-warmup compile count in
+    BOTH arms (residency must not introduce shape churn).
+    `scripts/bench_diff.py` re-checks the byte inequality and parity
+    within the record, and gates the resident arm's bytes-per-tick
+    against prior comparable records like a kernel-cost regression."""
+    from hhmm_tpu.obs.request import RequestRecorder
+    from hhmm_tpu.serve import (
+        MicroBatchScheduler,
+        PosteriorSnapshot,
+        ServeMetrics,
+        model_spec,
+    )
+
+    n_series, n_draws = 64, 8
+    rounds = 4 if quick else 8
+    snap = PosteriorSnapshot(
+        spec=model_spec(model),
+        draws=(
+            np.random.default_rng(29).normal(size=(n_draws, model.n_free))
+            * 0.3
+        ).astype(np.float32),
+    )
+    arms: dict = {}
+    parity: dict = {}
+    sheds = 0
+    for arm in ("staged", "resident"):
+        rec = RequestRecorder(enabled=True, window_s=600.0)
+        met = ServeMetrics()
+        sched = MicroBatchScheduler(
+            model,
+            buckets=(n_series,),
+            metrics=met,
+            recorder=rec,
+            resident=arm == "resident",
+            history_tail=8,
+        )
+        sched.attach_many(
+            [(f"c{i:03d}", snap, None, f"tenant{i % 4}") for i in range(n_series)]
+        )
+        got: list = []
+
+        def drive(r: int) -> None:
+            for i in range(n_series):
+                sched.submit(
+                    f"c{i:03d}", obs_fn(i, r), tenant=f"tenant{i % 4}"
+                )
+            got.extend(sched.flush())
+
+        def churn() -> None:
+            # detach -> warm page-in through the retained tail: the
+            # resident arm must drop the lane, replay into a fresh
+            # bank, and regroup the next flush from mixed sources
+            tail = sched.history_tail_of("c005")
+            assert sched.detach("c005")
+            sched.attach("c005", snap, history=tail, tenant="tenant1")
+
+        # warmup lands every dispatch shape: init, the stable-
+        # membership update (bank hit in the resident arm), the warm
+        # replay, and the post-churn mixed regroup
+        drive(0)
+        drive(1)
+        churn()
+        drive(2)
+        drive(3)
+        compiles_warm = met.compile_count
+        met.reset_throughput_window()
+        rec.reset_window()
+        got = []
+        for k, r in enumerate(range(4, 4 + rounds)):
+            if k == rounds // 2:
+                churn()  # parity must hold ACROSS the commit boundary
+            drive(r)
+        stz = rec.stanza()
+        overall = stz["overall"]
+        seen: dict = {}
+        counters: dict = {}
+        for rsp in got:
+            k = counters.get(rsp.series_id, 0)
+            counters[rsp.series_id] = k + 1
+            seen[(k, rsp.series_id)] = (
+                None
+                if rsp.shed
+                else (
+                    np.asarray(rsp.probs).tobytes(),
+                    np.float64(rsp.loglik).tobytes(),
+                    None
+                    if rsp.per_draw_loglik is None
+                    else np.asarray(rsp.per_draw_loglik).tobytes(),
+                    None
+                    if rsp.draw_ok is None
+                    else np.asarray(rsp.draw_ok).tobytes(),
+                )
+            )
+            sheds += int(rsp.shed)
+        parity[arm] = seen
+        n_ticks = rounds * n_series
+        arms[arm] = {
+            "other_share": overall.get("other_share"),
+            "queue_share": overall.get("queue_share"),
+            "device_share": overall.get("device_share"),
+            "ticks": overall.get("ticks"),
+            "h2d_bytes": met.h2d_bytes,
+            "d2h_bytes": met.d2h_bytes,
+            "h2d_bytes_per_tick": round(met.h2d_bytes / n_ticks, 1),
+            "d2h_bytes_per_tick": round(met.d2h_bytes / n_ticks, 1),
+            "carry_resident_bytes": met.carry_resident_bytes,
+            "compiles_after_warmup": met.compile_count - compiles_warm,
+        }
+    keys = set(parity["staged"]) | set(parity["resident"])
+    mismatches = sum(
+        1 for k in keys if parity["staged"].get(k) != parity["resident"].get(k)
+    )
+    staged_o = arms["staged"]["other_share"]
+    res_o = arms["resident"]["other_share"]
+    ok = (
+        arms["resident"]["h2d_bytes"] < arms["staged"]["h2d_bytes"]
+        and arms["resident"]["d2h_bytes"] == arms["staged"]["d2h_bytes"]
+        and isinstance(staged_o, (int, float))
+        and isinstance(res_o, (int, float))
+        and res_o < staged_o
+        and mismatches == 0
+        and sheds == 0
+        and arms["resident"]["carry_resident_bytes"] > 0
+        and arms["staged"]["carry_resident_bytes"] == 0
+        and arms["staged"]["compiles_after_warmup"] == 0
+        and arms["resident"]["compiles_after_warmup"] == 0
+    )
+    return {
+        "series": n_series,
+        "rounds": rounds,
+        "draws": n_draws,
+        "staged": arms["staged"],
+        "resident": arms["resident"],
+        "staged_h2d_bytes": arms["staged"]["h2d_bytes"],
+        "resident_h2d_bytes": arms["resident"]["h2d_bytes"],
+        "staged_other_share": staged_o,
+        "resident_other_share": res_o,
+        "resident_h2d_bytes_per_tick": arms["resident"]["h2d_bytes_per_tick"],
+        "resident_d2h_bytes_per_tick": arms["resident"]["d2h_bytes_per_tick"],
+        "parity_mismatches": mismatches,
+        "sheds": sheds,
+        "ok": ok,
+    }
+
+
 def serve_bench(args, backend, degraded) -> None:
     """``--serve``: streaming-inference service bench (`hhmm_tpu/serve/`).
 
@@ -588,6 +756,16 @@ def serve_bench(args, backend, degraded) -> None:
             overlap_share=req_overall.get("overlap_share"),
             **(request_stanza.get("pipeline") or {}),
         )
+    # always-on: the staged-vs-resident transfer duel (the perf claim
+    # of the device-resident carry plane, gated like the overlap duel)
+    carry_stanza = _carry_residency_duel(
+        model,
+        lambda i, r: {
+            "x": int(x_np[i % B, r % T]),
+            "sign": int(s_np[i % B, r % T]),
+        },
+        args.quick,
+    )
     # SLO attainment (serve/metrics.py): the explicit serving objectives
     # — p99 tick latency, snapshot staleness, recompile budget — judged
     # over the steady-state window and embedded in the manifest stanza
@@ -650,6 +828,8 @@ def serve_bench(args, backend, degraded) -> None:
     # spread growth gate, scripts/bench_diff.py)
     serve_record["manifest"]["slo"] = slo
     serve_record["manifest"]["request"] = request_stanza
+    serve_record["carry_residency_ok"] = carry_stanza["ok"]
+    serve_record["manifest"]["carry"] = carry_stanza
     if pipeline_stanza is not None:
         serve_record["pipeline_overlap_ok"] = pipeline_stanza["ok"]
         serve_record["manifest"]["pipeline"] = pipeline_stanza
@@ -687,6 +867,26 @@ def serve_bench(args, backend, degraded) -> None:
         print(
             "# serve bench FAILED: request-plane latency decomposition "
             f"missing (tenants without shares: {bad or ['<overall>']})",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+    print(
+        "# serve carry duel "
+        + ("OK" if carry_stanza["ok"] else "FAILED")
+        + f": h2d bytes staged={carry_stanza['staged_h2d_bytes']}"
+        f" -> resident={carry_stanza['resident_h2d_bytes']}, other share "
+        f"{carry_stanza['staged_other_share']} -> "
+        f"{carry_stanza['resident_other_share']}, parity mismatches "
+        f"{carry_stanza['parity_mismatches']}, resident carry bytes "
+        f"{carry_stanza['resident']['carry_resident_bytes']}",
+        file=sys.stderr,
+    )
+    if not carry_stanza["ok"]:
+        print(
+            "# serve bench FAILED: carry-residency gate (the resident "
+            "arm must transfer strictly fewer h2d bytes and spend a "
+            "strictly lower form+post share with bitwise response "
+            "parity and a flat compile count)",
             file=sys.stderr,
         )
         sys.exit(1)

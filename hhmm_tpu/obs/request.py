@@ -337,6 +337,13 @@ class RequestRecorder:
         self._flight_order: deque = deque()
         self._inflight_peak = 0
         self._harvested_flights = 0
+        # transfer attribution (note_transfers): bytes staged up /
+        # pulled down per dispatch, so the stanza can attribute the
+        # form/post shares to actual host<->device traffic (the
+        # device-resident carry duel reads the delta between arms)
+        self._h2d_bytes = 0
+        self._d2h_bytes = 0
+        self._transfer_dispatches = 0
 
     # ---- enablement (the obs/trace.py discipline) ----
 
@@ -555,6 +562,19 @@ class RequestRecorder:
         with self._lock:
             return len(self._flights)
 
+    def note_transfers(self, h2d_bytes: int, d2h_bytes: int) -> None:
+        """One dispatch's host<->device traffic: bytes newly staged
+        into its input buffers and bytes pulled down as its batched
+        response surface. The stanza's ``transfers`` block is what
+        lets a reader attribute the form/post shares to traffic (the
+        device-resident carry arm drops h2d while shares shrink)."""
+        if not self.enabled():
+            return
+        with self._lock:
+            self._h2d_bytes += int(h2d_bytes)
+            self._d2h_bytes += int(d2h_bytes)
+            self._transfer_dispatches += 1
+
     def note_device_time(self, kernel: str, bucket: int, p50_s: float) -> None:
         """PR 8's sampled warm re-timing landed: the pure device
         re-execution p50 for this (kernel, bucket) — the refinement of
@@ -670,6 +690,9 @@ class RequestRecorder:
             # peak restarts from the live depth
             self._inflight_peak = len(self._flights)
             self._harvested_flights = 0
+            self._h2d_bytes = 0
+            self._d2h_bytes = 0
+            self._transfer_dispatches = 0
 
     def stanza(self, top: Optional[int] = 16) -> Dict[str, Any]:
         """JSON-ready request-plane stanza for the run manifest /
@@ -738,6 +761,11 @@ class RequestRecorder:
                 "in_flight_peak": self._inflight_peak,
                 "harvested_flights": self._harvested_flights,
             }
+            transfers = {
+                "h2d_bytes": int(self._h2d_bytes),
+                "d2h_bytes": int(self._d2h_bytes),
+                "dispatches": int(self._transfer_dispatches),
+            }
         spread = self.p99_spread_ms()
         return {
             "window_s": self.window_s,
@@ -755,4 +783,5 @@ class RequestRecorder:
             "profiled_device_ms": profiled,
             "scheduler": sched,
             "pipeline": pipeline,
+            "transfers": transfers,
         }

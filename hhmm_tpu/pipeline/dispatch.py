@@ -60,6 +60,8 @@ class Flight:
         "fn",
         "fargs",
         "t_dispatch",
+        "lane_key",
+        "h2d_bytes",
     )
 
     def __init__(
@@ -75,6 +77,8 @@ class Flight:
         fn: Any,
         fargs: tuple,
         t_dispatch: float,
+        lane_key: Tuple[str, ...] = (),
+        h2d_bytes: int = 0,
     ):
         self.flush_id = flush_id
         self.kernel = kernel
@@ -87,6 +91,12 @@ class Flight:
         self.fn = fn
         self.fargs = fargs
         self.t_dispatch = t_dispatch
+        # padded lane membership this flight's outputs were computed
+        # for (the resident carry bank's slot layout) + the staged
+        # input bytes its formation materialized (transfer telemetry,
+        # accounted at harvest so shed flights never count)
+        self.lane_key = tuple(lane_key)
+        self.h2d_bytes = int(h2d_bytes)
 
     @property
     def series(self) -> List[str]:

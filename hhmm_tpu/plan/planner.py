@@ -147,6 +147,7 @@ class Plan:
         credit_factor: int = 1,
         ess_floor_frac: float = 0.5,
         rejuv_factor: int = 1,
+        carry_factor: int = 8,
     ) -> Dict[str, Any]:
         """Shed-aware admission caps derived from the planner-owned
         serve bucket ladder (the scheduler's
@@ -169,7 +170,15 @@ class Plan:
         ``max_rejuv_per_flush`` bounds how many series one flush may
         rejuvenate — ``rejuv_factor`` largest-buckets' worth, so the
         batched Liu–West move also always lands in already-compiled
-        bucket shapes."""
+        bucket shapes.
+
+        ``carry_slots_cap`` (``carry_factor`` largest-buckets' worth;
+        dropped by ``AdmissionPolicy.from_plan`` like the adapt knobs)
+        budgets the device-resident carry plane: how many lane slots
+        of ``(alpha, ll, ok)`` state the scheduler's lane table may
+        keep live on device before spilling the oldest banks back to
+        host records — the device-byte analog of the history tails'
+        ``tail_budget_bytes`` discipline."""
         top = int(self.buckets[-1])
         if not (0.0 < float(ess_floor_frac) <= 1.0):
             raise ValueError(
@@ -182,6 +191,7 @@ class Plan:
             "credit_cap_ticks": max(1, int(credit_factor)) * top,
             "ess_floor_frac": float(ess_floor_frac),
             "max_rejuv_per_flush": max(1, int(rejuv_factor)) * top,
+            "carry_slots_cap": max(1, int(carry_factor)) * top,
         }
 
     # ---- placement objects (the ONLY construction site outside

@@ -84,6 +84,16 @@ class ServeMetrics:
         self._tail_evictions = obs_metrics.Counter()
         self._warm_page_ins = obs_metrics.Counter()
         self._tail_bytes = obs_metrics.Gauge()
+        # transfer telemetry (docs/serving.md "Device-resident carry"):
+        # bytes newly materialized into staged dispatch inputs (obs
+        # staging + any carry restack — a resident bank hit stages 0)
+        # and bytes pulled back as the batched response surface; the
+        # gauge is the lane table's live device-byte footprint. Always
+        # on: the staged-vs-resident duel PROVES its transfer win from
+        # these counters, not from inference.
+        self._h2d_bytes = obs_metrics.Counter()
+        self._d2h_bytes = obs_metrics.Counter()
+        self._carry_bytes = obs_metrics.Gauge()
         # sampled flush profiling (obs/profile.py device_time through
         # the scheduler's profile_every knob): how many flushes were
         # re-timed; the per-(kernel, bucket) device-time gauges go to
@@ -124,6 +134,9 @@ class ServeMetrics:
             ("serve.warm_page_ins", self._warm_page_ins),
             ("serve.tail_resident_bytes", self._tail_bytes),
             ("serve.pipeline_deferred_ticks", self._inflight_deferred),
+            ("serve.h2d_bytes", self._h2d_bytes),
+            ("serve.d2h_bytes", self._d2h_bytes),
+            ("serve.carry_resident_bytes", self._carry_bytes),
         ):
             obs_metrics.attach(name, inst)
         # tenant label values this instance has already created on the
@@ -204,6 +217,11 @@ class ServeMetrics:
         self._ticks.reset()
         self._flushes.reset()
         self._busy.reset()
+        # per-window like the throughput counters: the duel compares
+        # bytes-per-window across arms. The residency GAUGE survives —
+        # it is a live footprint, not window activity.
+        self._h2d_bytes.reset()
+        self._d2h_bytes.reset()
         self._staleness_peak = float("nan")
 
     def observe_latency(self, latency_s: float, n: int = 1) -> None:
@@ -299,6 +317,37 @@ class ServeMetrics:
         """A pager page-in replayed the series' retained history tail
         through the attach machinery instead of cold filtering."""
         self._warm_page_ins.inc()
+
+    def note_h2d_bytes(self, nbytes: int) -> None:
+        """``nbytes`` newly materialized into one dispatch's staged
+        input buffers (folded observations + any carry restack; a
+        resident bank hit contributes 0 for the carry)."""
+        if nbytes:
+            self._h2d_bytes.inc(int(nbytes))
+
+    def note_d2h_bytes(self, nbytes: int) -> None:
+        """``nbytes`` pulled back to host as one dispatch's batched
+        response surface (probs / loglik / per-draw increments / ok)."""
+        if nbytes:
+            self._d2h_bytes.inc(int(nbytes))
+
+    def note_carry_bytes(self, nbytes: int) -> None:
+        """Current device bytes held by resident carry banks (the lane
+        table's incremental accounting; 0 with residency off)."""
+        self._carry_bytes.set(float(nbytes))
+
+    @property
+    def h2d_bytes(self) -> int:
+        return int(self._h2d_bytes.get())
+
+    @property
+    def d2h_bytes(self) -> int:
+        return int(self._d2h_bytes.get())
+
+    @property
+    def carry_resident_bytes(self) -> int:
+        v = self._carry_bytes.get()
+        return 0 if v != v else int(v)  # NaN-safe: gauge unset = 0
 
     def note_inflight_deferred(self, n: int = 1) -> None:
         """An async dispatch generation deferred ``n`` queued ticks
@@ -402,6 +451,9 @@ class ServeMetrics:
             "dispatch_errors": self.dispatch_errors,
             "device_loss_events": self.device_loss_events,
             "compile_count": int(self.compile_count),
+            "h2d_bytes": self.h2d_bytes,
+            "d2h_bytes": self.d2h_bytes,
+            "carry_resident_bytes": self.carry_resident_bytes,
         }
 
 

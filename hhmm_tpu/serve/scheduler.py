@@ -94,6 +94,7 @@ from hhmm_tpu.pipeline import (
 )
 from hhmm_tpu.robust import faults
 from hhmm_tpu.robust.guards import finite_mask, guard_update
+from hhmm_tpu.serve.lanes import CarryBank, LaneTable
 from hhmm_tpu.serve.metrics import ServeMetrics
 from hhmm_tpu.serve.online import StreamState, filter_scan, stream_init, stream_step
 from hhmm_tpu.serve.registry import (
@@ -122,6 +123,13 @@ CREDIT_TABLE_CAP = 4096
 # pass one: tails survive pager eviction (warm page-ins), so without a
 # cap a fleet of evicted series would grow host memory without bound
 DEFAULT_TAIL_BUDGET_BYTES = 32 << 20  # 32 MiB
+
+# sentinel stored in a series record's alpha/ll/ok fields while the
+# authoritative carry lives in a device-resident CarryBank
+# (serve/lanes.py): distinct from None (= fresh, needs tick_init);
+# every read site routes through _carry_of, which materializes the
+# bank row lazily at the commit boundaries that need record state
+_RESIDENT = object()
 
 
 def _obs_nbytes(obs: Dict[str, Any]) -> int:
@@ -243,12 +251,16 @@ class AdmissionPolicy:
         keyword args (weights are deployment policy, not topology).
         The adaptation-plane caps that ``admission_caps`` also derives
         (``ess_floor_frac``, ``max_rejuv_per_flush``) belong to
-        `hhmm_tpu/adapt/`, not to admission — dropped here."""
+        `hhmm_tpu/adapt/`, not to admission — dropped here, as is the
+        resident-carry budget ``carry_slots_cap`` (consumed by the
+        scheduler's lane-state plane, not by queue admission)."""
         shares = kw.pop("tenant_shares", None)
         order = kw.pop("flush_order", "drr")
         caps = dict(plan.admission_caps(**kw))
-        for adapt_key in ("ess_floor_frac", "max_rejuv_per_flush"):
-            caps.pop(adapt_key, None)
+        for other_key in (
+            "ess_floor_frac", "max_rejuv_per_flush", "carry_slots_cap"
+        ):
+            caps.pop(other_key, None)
         return cls(
             max_series=max_series,
             tenant_shares=shares,
@@ -288,6 +300,8 @@ class MicroBatchScheduler:
         tail_budget_bytes: Optional[int] = None,
         pipeline: bool = False,
         placement: Optional[DevicePlacement] = None,
+        resident: bool = False,
+        carry_slots_cap: Optional[int] = None,
     ):
         """``plan``: an optional :class:`hhmm_tpu.plan.Plan` — the
         topology-aware placement decision (`docs/sharding.md`). When
@@ -339,7 +353,22 @@ class MicroBatchScheduler:
         filtering) and is released only by :meth:`unregister` or
         host-byte pressure: ``tail_budget_bytes`` (default 32 MiB)
         caps total host bytes across all retained tails, evicting the
-        least-recently-folded series' tail first."""
+        least-recently-folded series' tail first.
+
+        ``resident``: the device-resident carry plane
+        (`serve/lanes.py`, docs/serving.md "Device-resident carry").
+        ``False`` (the default) keeps the host-staged path — every
+        flush restacks alpha/ll/ok into fresh dispatch buffers.
+        ``True`` keeps the carry in per-dispatch :class:`CarryBank`\\ s
+        (live device arrays addressed by a lane table): a flush with
+        stable lane membership transfers ONLY the folded observations
+        up and the response surface down, bitwise identical to the
+        staged path (the ``bench.py --serve`` duel gate).
+        ``carry_slots_cap`` bounds total resident carry slots (lane
+        rows) across banks — overflow spills the oldest banks' rows
+        back to the per-series records; ``None`` defers to the plan's
+        ``admission_caps()['carry_slots_cap']`` when a plan is given,
+        else unbounded."""
         if buckets is None:
             buckets = plan.buckets if plan is not None else (8, 32, 128)
         if not buckets or any(b <= 0 for b in buckets):
@@ -504,6 +533,40 @@ class MicroBatchScheduler:
                 "serve.tick_update_async",
                 jax.jit(self._update_impl, donate_argnums=(1, 2, 3)),
             )
+        # ---- device-resident carry plane (serve/lanes.py) ----
+        self.resident = bool(resident)
+        self._lanes: Optional[LaneTable] = None
+        self._gather_j = None
+        self._carry_slots_cap: Optional[int] = None
+        self._carry_spills = 0
+        if self.resident:
+            self._lanes = LaneTable()
+            # lane regroup: one jitted gather per bucket shape turns
+            # membership churn (attach/detach/eviction/bucket
+            # promotion) into device-side slot moves instead of host
+            # restacking. Registered like every serve jit (invariant
+            # 5) and counted by _refresh_compile_count.
+            self._gather_j = register_jit(
+                "serve.lane_gather", jax.jit(self._gather_impl)
+            )
+            if self._update_async_j is None:
+                # the donated update for freshly-gathered regroup
+                # copies (NEVER a live bank — a dispatch can still die
+                # at its sync, and the bank may be the only copy of
+                # the carry; see docs/serving.md donation rules)
+                self._update_async_j = register_jit(
+                    "serve.tick_update_async",
+                    jax.jit(self._update_impl, donate_argnums=(1, 2, 3)),
+                )
+            if carry_slots_cap is None and plan is not None:
+                carry_slots_cap = plan.admission_caps()["carry_slots_cap"]
+            if carry_slots_cap is not None:
+                if int(carry_slots_cap) <= 0:
+                    raise ValueError(
+                        "carry_slots_cap must be positive or None, got "
+                        f"{carry_slots_cap}"
+                    )
+                self._carry_slots_cap = int(carry_slots_cap)
 
     # ---- jitted kernels (one specialization per bucket shape) ----
 
@@ -584,6 +647,148 @@ class MicroBatchScheduler:
             return st.log_alpha, st.loglik, okd
 
         return jax.vmap(one_series)(draws, data_b)
+
+    def _gather_impl(self, alpha, ll, ok, idx):
+        """Regroup a carry bank onto a new lane order: one gather per
+        array, entirely on device. ``idx`` is a [B'] int32 slot vector
+        — its shape is the bucket size, so the compile count per
+        bucket stays flat exactly like the tick kernels."""
+        return (
+            jnp.take(alpha, idx, axis=0),
+            jnp.take(ll, idx, axis=0),
+            jnp.take(ok, idx, axis=0),
+        )
+
+    # ---- device-resident carry plane (serve/lanes.py) ----
+
+    def _carry_of(self, series_id: str):
+        """``(alpha [D, K], ll [D], ok [D])`` for one attached, ticked
+        series, materialized from its resident bank row when the
+        record holds the ``_RESIDENT`` sentinel — the lazily-pulled
+        host mirror every commit boundary reads through. ``None`` for
+        a never-ticked (or unattached) series. The bank-row slices are
+        device ops issued OUTSIDE the lane-table lock."""
+        rec = self._series.get(series_id)
+        if rec is None or rec["alpha"] is None:
+            return None
+        if rec["alpha"] is not _RESIDENT:
+            return rec["alpha"], rec["ll"], rec["ok"]
+        ref = self._lanes.lookup(series_id) if self._lanes else None
+        if ref is None:
+            # the mapping vanished without a record reset (cannot
+            # happen through the public surface; degrade, don't raise)
+            return None
+        bank, slot = ref
+        return bank.alpha[slot], bank.ll[slot], bank.ok[slot]
+
+    def _lane_drop(self, series_id: str) -> None:
+        """Forget a series' resident carry mapping (detach /
+        re-attach / rejuvenation): the record's fields are the
+        authority again. Refreshes the residency gauge."""
+        if self._lanes is not None and self._lanes.drop(series_id):
+            self.metrics.note_carry_bytes(self._lanes.resident_bytes())
+
+    def _spill_carry(self, series_id: str) -> None:
+        """Materialize one series' bank row into its record (device
+        slices — the staged-mode state shape) and drop the mapping:
+        the commit boundaries that replace record state wholesale
+        (``replace_draw_bank``) run through here first."""
+        carry = self._carry_of(series_id)
+        rec = self._series.get(series_id)
+        if rec is None or carry is None:
+            return
+        if rec["alpha"] is _RESIDENT:
+            rec["alpha"], rec["ll"], rec["ok"] = carry
+            self._lane_drop(series_id)
+
+    def _commit_carry(
+        self, alpha, ll, okd, lane_key: Tuple[str, ...], group,
+        device_index: int = 0,
+    ) -> None:
+        """Adopt one successful dispatch's padded outputs as the new
+        resident bank for its real lanes (slot i = group[i]; padded
+        duplicate slots hold bitwise the tail series' carry and are
+        never mapped). Records flip to the ``_RESIDENT`` sentinel;
+        superseded banks free as the table remaps. Enforces the
+        planner-derived slot budget afterwards (spill-to-record,
+        oldest bank first)."""
+        bank = CarryBank(alpha, ll, okd, lane_key, device_index)
+        mapping: Dict[str, int] = {}
+        for i, p in enumerate(group):
+            sid = p[0]
+            if sid not in mapping and sid in self._series:
+                mapping[sid] = i
+        self._lanes.commit(bank, mapping)
+        for sid in mapping:
+            rec = self._series[sid]
+            rec["alpha"] = rec["ll"] = rec["ok"] = _RESIDENT
+        if self._carry_slots_cap is not None:
+            self._enforce_carry_budget(bank)
+        self.metrics.note_carry_bytes(self._lanes.resident_bytes())
+
+    def _enforce_carry_budget(self, protect: CarryBank) -> None:
+        """Spill the oldest banks' rows back to their records until
+        total resident slots fit ``carry_slots_cap`` (the bank just
+        committed is protected — spilling it would undo the flush).
+        Row materialization happens outside the lane-table lock;
+        ``release`` then drops only mappings still pointing at the
+        victim (a racing commit wins)."""
+        victims = self._lanes.spill_candidates(
+            self._carry_slots_cap, protect=protect
+        )
+        for bank, rows in victims:
+            staged = []
+            for sid, slot in rows:
+                rec = self._series.get(sid)
+                if rec is None or rec["alpha"] is not _RESIDENT:
+                    continue
+                staged.append(
+                    (sid, (bank.alpha[slot], bank.ll[slot], bank.ok[slot]))
+                )
+            dropped = set(
+                self._lanes.release(bank, [sid for sid, _ in staged])
+            )
+            for sid, (a, l, o) in staged:
+                if sid in dropped:
+                    rec = self._series[sid]
+                    rec["alpha"], rec["ll"], rec["ok"] = a, l, o
+            if dropped:
+                self._carry_spills += 1
+
+    def _form_carry(self, lanes, place):
+        """Resident-mode carry formation for one update dispatch.
+        Returns ``(alpha_b, ll_b, ok_b, staged_bytes, donatable)``:
+
+        - **bank hit** (stable membership): the live bank's arrays
+          pass straight through — zero staging, NOT donatable (the
+          bank must survive a dispatch that dies at its sync);
+        - **single-source regroup**: one jitted gather builds fresh
+          [B, ...] buffers from the old bank's slots — donatable;
+        - **mixed sources** (bank rows + record state after churn):
+          a device-side stack of per-lane rows — donatable, and still
+          no host restaging (every row is already a device array).
+
+        ``staged_bytes`` is what this formation newly materialized
+        (the transfer-telemetry convention; a bank hit stages 0)."""
+        lane_key = tuple(p[0] for p in lanes)
+        bank = self._lanes.bank_for(lane_key)
+        if bank is not None:
+            return bank.alpha, bank.ll, bank.ok, 0, False
+        refs = self._lanes.lookup_many(lane_key)
+        src = {r[0].seq: r[0] for r in refs if r is not None}
+        if len(src) == 1 and all(r is not None for r in refs):
+            (bank,) = src.values()
+            idx = jnp.asarray([r[1] for r in refs], dtype=jnp.int32)
+            alpha_b, ll_b, ok_b = self._gather_j(
+                bank.alpha, bank.ll, bank.ok, idx
+            )
+        else:
+            rows = [self._carry_of(sid) for sid in lane_key]
+            alpha_b = place(jnp.stack([r[0] for r in rows]))
+            ll_b = place(jnp.stack([r[1] for r in rows]))
+            ok_b = place(jnp.stack([r[2] for r in rows]))
+        staged = int(alpha_b.nbytes + ll_b.nbytes + ok_b.nbytes)
+        return alpha_b, ll_b, ok_b, staged, True
 
     # ---- attach ----
 
@@ -763,6 +968,25 @@ class MicroBatchScheduler:
             rec = self._series[series_id]
             rec["rejected_fits"] = rec.get("rejected_fits", 0) + 1
         self._series.update(new_recs)
+        if self._lanes is not None and new_recs:
+            # a committed attach replaces filter state wholesale: stale
+            # resident mappings die with it, and warm replays' stashed
+            # banks commit as the new resident carry (the page-in's
+            # state never leaves the device). Fresh records simply lose
+            # any old mapping — their first tick runs the init kernel.
+            by_bank: Dict[int, Tuple[CarryBank, Dict[str, int]]] = {}
+            for series_id, rec in new_recs.items():
+                self._lanes.drop(series_id)
+                stash = rec.pop("_bank", None)
+                if stash is not None:
+                    bank, slot = stash
+                    ent = by_bank.setdefault(id(bank), (bank, {}))
+                    ent[1][series_id] = slot
+            for bank, mapping in by_bank.values():
+                self._lanes.commit(bank, mapping)
+                if self._carry_slots_cap is not None:
+                    self._enforce_carry_budget(bank)
+            self.metrics.note_carry_bytes(self._lanes.resident_bytes())
         # request-plane tenant binding: an explicit tenant commits with
         # its series (keeps re-bind too — the keep IS the commit of the
         # keep decision); absent stays the default tenant = series
@@ -903,8 +1127,16 @@ class MicroBatchScheduler:
             (T_pad,) + tuple(str(data_b[k].dtype) for k in keys),
         )
         out: Dict[str, Dict[str, Any]] = {}
+        bank = None
+        if self._lanes is not None:
+            # resident mode: the replay's padded outputs are already
+            # the carry this page-in warms — stash the bank on the
+            # records; attach_many's COMMIT section maps it into the
+            # lane table (never here: a later attach-batch failure
+            # must not leave half-committed mappings)
+            bank = CarryBank(alpha, ll, okd, tuple(s for s, _, _, _ in lanes))
         for i, (series_id, draws, degraded, _) in enumerate(chunk):
-            out[series_id] = {
+            rec = {
                 "draws": draws,
                 "alpha": alpha[i],
                 "ll": ll[i],
@@ -912,6 +1144,10 @@ class MicroBatchScheduler:
                 "degraded_attach": degraded,
                 "rejected_fits": 0,
             }
+            if bank is not None:
+                rec["alpha"] = rec["ll"] = rec["ok"] = _RESIDENT
+                rec["_bank"] = (bank, i)
+            out[series_id] = rec
         return out
 
     # ---- detach / paging ----
@@ -936,6 +1172,11 @@ class MicroBatchScheduler:
             self.pager.discard(series_id)  # no-op if the pager evicted us
         if rec is None:
             return False
+        # the resident carry dies with the record: its bank slot was
+        # the only copy of this series' stream state, exactly like the
+        # popped record's fields in staged mode (warm re-attach replays
+        # the retained tail either way)
+        self._lane_drop(series_id)
         if rec.get("rejuvenated"):
             # a rejuvenated bank lives only in memory — a later page-in
             # restores the ORIGINAL snapshot draws, so weights learned
@@ -1039,9 +1280,11 @@ class MicroBatchScheduler:
         K = getattr(self.model, "K", None)
         if K:
             return int(K)
-        for rec in self._series.values():
+        for sid, rec in self._series.items():
             if rec["alpha"] is not None:
-                return int(np.asarray(rec["alpha"]).shape[-1])
+                carry = self._carry_of(sid)
+                if carry is not None:
+                    return int(carry[0].shape[-1])
         return 1
 
     def _make_shed(
@@ -1919,19 +2162,26 @@ class MicroBatchScheduler:
         obs_keys = sorted(group[0][1].keys())
         obs_b = {}
         dtype_locks: Dict[str, Any] = {}
+        h2d = 0
         for k in obs_keys:
-            arr = jnp.asarray(np.stack([np.asarray(p[1][k]) for p in lanes]))
+            # stack once on host, transfer once to the owning device
+            # (the sync path's single-materialization discipline)
+            host = np.stack([np.asarray(p[1][k]) for p in lanes])
+            dt = jax.dtypes.canonicalize_dtype(host.dtype)
             # same dtype-lock discipline as the sync path; the lock
             # COMMITS at harvest (after the flight's sync succeeds)
             locked = self._obs_dtypes.get(k)
             if locked is None:
-                dtype_locks[k] = arr.dtype
+                dtype_locks[k] = dt
             else:
-                promoted = jnp.promote_types(locked, arr.dtype)
+                promoted = jnp.promote_types(locked, dt)
                 if promoted != locked:
                     dtype_locks[k] = promoted
-                arr = arr.astype(dtype_locks.get(k, locked))
-            obs_b[k] = arr
+                dt = dtype_locks.get(k, locked)
+            if host.dtype != dt:
+                host = host.astype(dt)
+            h2d += host.nbytes
+            obs_b[k] = host
         device = (
             self._pipe_devices[device_index]
             if device_index < len(self._pipe_devices)
@@ -1939,9 +2189,11 @@ class MicroBatchScheduler:
         )
         if device is not None:
             place = lambda a: jax.device_put(a, device)  # noqa: E731
+            to_dev = place
         else:
             place = lambda a: a  # noqa: E731
-        obs_b = {k: place(v) for k, v in obs_b.items()}
+            to_dev = jnp.asarray
+        obs_b = {k: to_dev(v) for k, v in obs_b.items()}
         lane_key = tuple(p[0] for p in lanes)
         draws_b = self._draws_cache.get(lane_key)
         if draws_b is None:
@@ -1956,6 +2208,17 @@ class MicroBatchScheduler:
             sp.annotate(bucket=bn, device=device_index, pipelined=True)
             if kernel == "init":
                 fn, fargs = self._init_j, (draws_b, obs_b)
+            elif self._lanes is not None:
+                # resident: bank hit → NON-donating kernel on the live
+                # bank (it may be the only copy of this carry, and the
+                # flight can still die at harvest); regrouped fresh
+                # copies → the donating async kernel as usual
+                alpha_b, ll_b, ok_b, staged, donatable = self._form_carry(
+                    lanes, place
+                )
+                h2d += staged
+                fn = self._update_async_j if donatable else self._update_j
+                fargs = (draws_b, alpha_b, ll_b, ok_b, obs_b)
             else:
                 alpha_b = place(
                     jnp.stack([self._series[p[0]]["alpha"] for p in lanes])
@@ -1966,6 +2229,7 @@ class MicroBatchScheduler:
                 ok_b = place(
                     jnp.stack([self._series[p[0]]["ok"] for p in lanes])
                 )
+                h2d += int(alpha_b.nbytes + ll_b.nbytes + ok_b.nbytes)
                 fn = self._update_async_j
                 fargs = (draws_b, alpha_b, ll_b, ok_b, obs_b)
             self.recorder.stage(traces, "dispatch")
@@ -1982,6 +2246,8 @@ class MicroBatchScheduler:
             fn=fn,
             fargs=fargs,
             t_dispatch=obs_request.now(),
+            lane_key=lane_key,
+            h2d_bytes=h2d,
         )
 
     def _commit_flight(
@@ -2005,6 +2271,24 @@ class MicroBatchScheduler:
         )
         done = obs_request.now()
         self.recorder.stage(flight.traces, "device", t=done)
+        n = len(flight.group)
+        # batched response surface, exactly like the sync path: one
+        # D2H pull per group array, host-side slicing per lane
+        probs_h = np.asarray(probs[:n])
+        mean_ll_h = np.asarray(mean_ll[:n])
+        inc_h = np.asarray(inc[:n])
+        okd_h = np.asarray(okd[:n])
+        d2h = int(
+            probs_h.nbytes + mean_ll_h.nbytes + inc_h.nbytes + okd_h.nbytes
+        )
+        if self._lanes is not None:
+            # the flight's padded outputs become the new resident bank;
+            # series detached in flight are filtered by _commit_carry
+            # (their records are gone), so a stale mapping cannot form
+            self._commit_carry(
+                alpha, ll, okd, flight.lane_key, flight.group,
+                device_index=flight.device_index,
+            )
         responses: List[TickResponse] = []
         committed: list = []
         committed_traces: list = []
@@ -2023,27 +2307,31 @@ class MicroBatchScheduler:
                     )
                 )
                 continue
-            rec["alpha"], rec["ll"], rec["ok"] = alpha[i], ll[i], okd[i]
+            if self._lanes is None:
+                rec["alpha"], rec["ll"], rec["ok"] = alpha[i], ll[i], okd[i]
             if self.history_tail:
                 self._tail_append(series_id, obs_i)
-            n_ok = int(np.asarray(okd[i]).sum())
+            n_ok = int(okd_h[i].sum())
             degraded = bool(rec["degraded_attach"]) or n_ok == 0
             if degraded:
                 self.metrics.note_degraded_response()
             responses.append(
                 TickResponse(
                     series_id=series_id,
-                    probs=np.asarray(probs[i]),
-                    loglik=float(mean_ll[i]),
+                    probs=probs_h[i],
+                    loglik=float(mean_ll_h[i]),
                     healthy_draws=n_ok,
                     degraded=degraded,
                     latency_s=done - t_submit,
-                    per_draw_loglik=np.asarray(inc[i]),
-                    draw_ok=np.asarray(okd[i]),
+                    per_draw_loglik=inc_h[i],
+                    draw_ok=okd_h[i],
                 )
             )
             committed.append(flight.group[i])
             committed_traces.append(trace)
+        self.metrics.note_h2d_bytes(flight.h2d_bytes)
+        self.metrics.note_d2h_bytes(d2h)
+        self.recorder.note_transfers(flight.h2d_bytes, d2h)
         self._dev_served[flight.device_index] = self._dev_served.get(
             flight.device_index, 0
         ) + len(committed)
@@ -2123,8 +2411,14 @@ class MicroBatchScheduler:
         obs_keys = sorted(group[0][1].keys())  # validated by flush()
         obs_b = {}
         dtype_locks: Dict[str, Any] = {}
+        h2d = d2h = 0
         for k in obs_keys:
-            arr = jnp.asarray(np.stack([np.asarray(p[1][k]) for p in lanes]))
+            # stack ONCE on host and hand the result to the device a
+            # single time below — the historical jnp.asarray(np.stack)
+            # staged an unsharded device copy that a sharded flush
+            # then re-placed, materializing the batch twice
+            host = np.stack([np.asarray(p[1][k]) for p in lanes])
+            dt = jax.dtypes.canonicalize_dtype(host.dtype)
             # canonical per-key dtype: a producer oscillating between
             # numpy and Python scalars (same value domain) must not
             # change the jit signature and retrace the warm kernel.
@@ -2137,13 +2431,16 @@ class MicroBatchScheduler:
             # polluted lock forcing spurious retraces forever after.
             locked = self._obs_dtypes.get(k)
             if locked is None:
-                dtype_locks[k] = arr.dtype
+                dtype_locks[k] = dt
             else:
-                promoted = jnp.promote_types(locked, arr.dtype)
+                promoted = jnp.promote_types(locked, dt)
                 if promoted != locked:
                     dtype_locks[k] = promoted
-                arr = arr.astype(dtype_locks.get(k, locked))
-            obs_b[k] = arr
+                dt = dtype_locks.get(k, locked)
+            if host.dtype != dt:
+                host = host.astype(dt)
+            h2d += host.nbytes
+            obs_b[k] = host
         # the draw bank is immutable between attaches: cache the stacked
         # [bucket, D, dim] array per lane membership so the per-tick hot
         # path ships only the arrays that actually change (alpha/ll/ok)
@@ -2154,8 +2451,8 @@ class MicroBatchScheduler:
         # per bucket is stable (compile count stays flat after warmup)
         sharded = self.plan is not None and self.plan.shard_bucket(bn)
         place = self.plan.place if sharded else (lambda a: a)
-        if sharded:
-            obs_b = {k: place(v) for k, v in obs_b.items()}
+        to_dev = self.plan.place if sharded else jnp.asarray
+        obs_b = {k: to_dev(v) for k, v in obs_b.items()}
         draws_b = self._draws_cache.get(lane_key)
         if draws_b is None:
             if len(self._draws_cache) >= 64:  # bound churny memberships
@@ -2175,12 +2472,29 @@ class MicroBatchScheduler:
             # keep measuring the same region across refactors
             if kernel == "init":
                 fn, fargs = self._init_j, (draws_b, obs_b)
+            elif self._lanes is not None:
+                # resident: the carry is already on device. A bank hit
+                # (same lane membership as the last commit) passes the
+                # live bank arrays straight to the NON-donating kernel
+                # — zero carry staging; membership churn regroups on
+                # device (jitted gather / row stack) into fresh copies
+                # the donating kernel may consume in place.
+                alpha_b, ll_b, ok_b, staged, donatable = self._form_carry(
+                    lanes, place
+                )
+                h2d += staged
+                if donatable:
+                    fn = self._update_async_j
+                else:
+                    fn = self._update_j
+                fargs = (draws_b, alpha_b, ll_b, ok_b, obs_b)
             else:
                 alpha_b = place(
                     jnp.stack([self._series[p[0]]["alpha"] for p in lanes])
                 )
                 ll_b = place(jnp.stack([self._series[p[0]]["ll"] for p in lanes]))
                 ok_b = place(jnp.stack([self._series[p[0]]["ok"] for p in lanes]))
+                h2d += int(alpha_b.nbytes + ll_b.nbytes + ok_b.nbytes)
                 fn, fargs = self._update_j, (draws_b, alpha_b, ll_b, ok_b, obs_b)
             # batch formation ends here: everything before this stamp
             # (lane padding, dtype locks, state stacking) is the
@@ -2191,13 +2505,19 @@ class MicroBatchScheduler:
                 fn(*fargs)
             )
         self._obs_dtypes.update(dtype_locks)  # dispatch succeeded
-        if self.profile_every and trace_enabled():
+        if (
+            self.profile_every
+            and trace_enabled()
+            and fn is not self._update_async_j
+        ):
             # the sampled-flush profile target: this exact warm
             # signature with these exact staged inputs (re-timing it
             # cannot compile). Held ONLY when profiling can actually
             # fire (knob set AND tracer on) — otherwise a production
             # scheduler would pin a flush's device arrays for a
-            # profiler that will never run.
+            # profiler that will never run. A DONATING dispatch is
+            # never held: its carry args just handed their buffers to
+            # the kernel, and re-timing them would read freed memory.
             self._last_dispatch = (kernel, bn, fn, fargs)
         # dtype-aware signature: the fallback compile audit (no
         # _cache_size on the jitted fn) must see dtype-promotion
@@ -2208,31 +2528,52 @@ class MicroBatchScheduler:
         done = obs_request.now()
         # device-complete: reuse the post-sync read (no second clock)
         self.recorder.stage(traces, "device", t=done)
+        n = len(group)
+        # response surface comes down BATCHED: one transfer per group
+        # array + host-side slicing (a 128-lane bucket costs 4 D2H
+        # pulls instead of ~512). np.asarray(x)[i] is bitwise
+        # np.asarray(x[i]) — the per-lane views below are unchanged.
+        probs_h = np.asarray(probs[:n])
+        mean_ll_h = np.asarray(mean_ll[:n])
+        inc_h = np.asarray(inc[:n])
+        okd_h = np.asarray(okd[:n])
+        d2h += int(
+            probs_h.nbytes + mean_ll_h.nbytes + inc_h.nbytes + okd_h.nbytes
+        )
+        if self._lanes is not None:
+            # the padded outputs BECOME the new carry bank — the carry
+            # never leaves the device. Host recs flip to the resident
+            # sentinel; commit boundaries materialize rows on demand.
+            self._commit_carry(alpha, ll, okd, lane_key, group)
         responses = []
         for i, (series_id, obs_i, t_submit, _, _) in enumerate(group):
             rec = self._series[series_id]
-            rec["alpha"], rec["ll"], rec["ok"] = alpha[i], ll[i], okd[i]
+            if self._lanes is None:
+                rec["alpha"], rec["ll"], rec["ok"] = alpha[i], ll[i], okd[i]
             if self.history_tail:
                 # the maintenance plane's sliding refit window AND the
                 # warm page-in replay source: only FOLDED observations
                 # enter (this loop runs after the dispatch committed)
                 self._tail_append(series_id, obs_i)
-            n_ok = int(np.asarray(okd[i]).sum())
+            n_ok = int(okd_h[i].sum())
             degraded = bool(rec["degraded_attach"]) or n_ok == 0
             if degraded:
                 self.metrics.note_degraded_response()
             responses.append(
                 TickResponse(
                     series_id=series_id,
-                    probs=np.asarray(probs[i]),
-                    loglik=float(mean_ll[i]),
+                    probs=probs_h[i],
+                    loglik=float(mean_ll_h[i]),
                     healthy_draws=n_ok,
                     degraded=degraded,
                     latency_s=done - t_submit,
-                    per_draw_loglik=np.asarray(inc[i]),
-                    draw_ok=np.asarray(okd[i]),
+                    per_draw_loglik=inc_h[i],
+                    draw_ok=okd_h[i],
                 )
             )
+        self.metrics.note_h2d_bytes(h2d)
+        self.metrics.note_d2h_bytes(d2h)
+        self.recorder.note_transfers(h2d, d2h)
         # respond: the post-process share ends with the built responses
         self.recorder.complete_group(traces, kernel=kernel, bucket=bn)
         return responses
@@ -2372,11 +2713,10 @@ class MicroBatchScheduler:
         """``(log_alpha [D, K], loglik [D], ok [D])`` of one attached,
         ticked series, or ``None`` — :meth:`state` minus the unpacked
         constrained params (whose lazy jitted unpack the adaptation
-        plane's resample does not need and must not pay for)."""
-        rec = self._series.get(series_id)
-        if rec is None or rec["alpha"] is None:
-            return None
-        return rec["alpha"], rec["ll"], rec["ok"]
+        plane's resample does not need and must not pay for). In
+        resident mode this is a commit boundary: the carry
+        materializes lazily from the series' bank row."""
+        return self._carry_of(series_id)
 
     def replace_draw_bank(
         self, series_id: str, draws, alpha, ll, ok
@@ -2405,6 +2745,13 @@ class MicroBatchScheduler:
             return f"series {series_id!r} is not attached"
         if rec["alpha"] is None:
             return f"series {series_id!r} has not received a tick yet"
+        # commit boundary: validate against the ACTUAL serving carry
+        # (materialized from the bank row in resident mode — the host
+        # record may hold only the sentinel)
+        carry = self._carry_of(series_id)
+        if carry is None:
+            return f"series {series_id!r} has not received a tick yet"
+        cur_alpha, cur_ll, cur_ok = carry
         cur = rec["draws"]
         draws = jnp.asarray(draws)
         if draws.shape != cur.shape or draws.dtype != cur.dtype:
@@ -2413,16 +2760,19 @@ class MicroBatchScheduler:
                 f"{draws.shape}/{draws.dtype}, serving "
                 f"{cur.shape}/{cur.dtype} (fixed-D contract)"
             )
-        alpha = jnp.asarray(alpha, dtype=rec["alpha"].dtype)
-        ll = jnp.asarray(ll, dtype=rec["ll"].dtype)
-        ok = jnp.asarray(ok, dtype=rec["ok"].dtype)
+        alpha = jnp.asarray(alpha, dtype=cur_alpha.dtype)
+        ll = jnp.asarray(ll, dtype=cur_ll.dtype)
+        ok = jnp.asarray(ok, dtype=cur_ok.dtype)
         if (
-            alpha.shape != rec["alpha"].shape
-            or ll.shape != rec["ll"].shape
-            or ok.shape != rec["ok"].shape
+            alpha.shape != cur_alpha.shape
+            or ll.shape != cur_ll.shape
+            or ok.shape != cur_ok.shape
         ):
             return f"filter state shape mismatch for {series_id!r}"
         rec["draws"], rec["alpha"], rec["ll"], rec["ok"] = draws, alpha, ll, ok
+        # the record is the authority again until the next flush
+        # commits a bank (rejuvenated state supersedes the bank row)
+        self._lane_drop(series_id)
         rec["params"] = None
         # the bank now diverges from the snapshot at rest: an eviction
         # would page the ORIGINAL snapshot back in, so the saved weight
@@ -2447,11 +2797,12 @@ class MicroBatchScheduler:
         the series record: the draw bank is immutable between attaches,
         and this accessor sits on the per-tick forecast hot path)."""
         rec = self._series[series_id]
-        if rec["alpha"] is None:
+        carry = self._carry_of(series_id)
+        if carry is None:
             raise ValueError(f"series {series_id!r} has not received a tick yet")
         if rec.get("params") is None:
             rec["params"] = self._unpack_j(rec["draws"])
-        return rec["alpha"], rec["ll"], rec["ok"], rec["params"]
+        return carry[0], carry[1], carry[2], rec["params"]
 
     def series_ids(self) -> List[str]:
         return sorted(self._series)
@@ -2482,6 +2833,8 @@ class MicroBatchScheduler:
         jits = [self._init_j, self._update_j, self._replay_j, self._unpack_j]
         if self._update_async_j is not None:
             jits.append(self._update_async_j)
+        if self._gather_j is not None:
+            jits.append(self._gather_j)
         for f in jits:
             cache_size = getattr(f, "_cache_size", None)
             if callable(cache_size):

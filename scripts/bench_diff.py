@@ -235,6 +235,7 @@ def diff(
     last_breaches_by_key: Dict[Tuple, int] = {}
     last_costs_by_key: Dict[Tuple, Dict[str, float]] = {}
     last_request_by_key: Dict[Tuple, Dict[str, Optional[float]]] = {}
+    last_transfer_by_key: Dict[Tuple, Dict[str, float]] = {}
     failures = 0
     for rnd in rounds:
         rec = rnd["record"]
@@ -567,6 +568,83 @@ def diff(
                         f"; pipeline overlap holds (queue {sync_q:g} "
                         f"-> {async_q:g})"
                     )
+            # the carry-residency duel gates within the record the same
+            # way: the stanza ships its own staged baseline arm, so the
+            # resident arm must transfer STRICTLY fewer h2d bytes
+            # (equality = the banks bought nothing) with bitwise
+            # response parity (a byte win that changes answers is a
+            # correctness bug wearing a perf hat)
+            carry = (rec.get("manifest") or {}).get("carry")
+            if isinstance(carry, dict) and "resident_h2d_bytes" in carry:
+                staged_b = carry.get("staged_h2d_bytes")
+                res_b = carry.get("resident_h2d_bytes")
+                try:
+                    mismatches = int(carry.get("parity_mismatches") or 0)
+                except (TypeError, ValueError):
+                    mismatches = -1  # malformed: visible, never clean
+                if (
+                    not isinstance(staged_b, (int, float))
+                    or not isinstance(res_b, (int, float))
+                    or res_b >= staged_b
+                ):
+                    failures += 1
+                    row["gated"] = True
+                    row["status"] += (
+                        "; CARRY REGRESSION: resident h2d bytes not "
+                        f"strictly below staged (staged={staged_b}, "
+                        f"resident={res_b})"
+                    )
+                elif mismatches != 0:
+                    failures += 1
+                    row["gated"] = True
+                    row["status"] += (
+                        f"; CARRY REGRESSION: {mismatches} parity "
+                        "mismatch(es) between the staged and resident arms"
+                    )
+                else:
+                    row["status"] += (
+                        f"; carry residency holds (h2d {staged_b:g} "
+                        f"-> {res_b:g})"
+                    )
+                # transferred bytes per tick ride the same key, gated
+                # INVERTED against prior comparable records: growth in
+                # the resident arm's per-tick h2d/d2h past the
+                # threshold means carry bytes crept back into the
+                # per-flush transfer (e.g. a bank-hit path lost)
+                prev_tx = last_transfer_by_key.get(key) or {}
+                cur_tx: Dict[str, float] = {}
+                tx_regr = []
+                n_tx_gated = 0
+                for label in (
+                    "resident_h2d_bytes_per_tick",
+                    "resident_d2h_bytes_per_tick",
+                ):
+                    v = carry.get(label)
+                    if not isinstance(v, (int, float)) or v <= 0:
+                        continue
+                    cur_tx[label] = float(v)
+                    pv = prev_tx.get(label)
+                    if pv:
+                        n_tx_gated += 1
+                        delta = 100.0 * (v - pv) / pv
+                        if delta > threshold_pct:
+                            tx_regr.append(f"{label} {delta:+.1f}%")
+                if tx_regr:
+                    failures += 1
+                    row["gated"] = True
+                    row["status"] += (
+                        "; TRANSFER REGRESSION: "
+                        + ", ".join(tx_regr)
+                        + f" (threshold +{threshold_pct:g}%)"
+                    )
+                elif n_tx_gated:
+                    row["status"] += (
+                        f"; transfer bytes ok ({n_tx_gated} observable(s))"
+                    )
+                elif cur_tx:
+                    row["status"] += "; transfer-bytes baseline"
+                if cur_tx:
+                    last_transfer_by_key[key] = cur_tx
             # kernel device time rides the same key, gated INVERTED:
             # a measured row whose p50 grew past the threshold against
             # the previous comparable record's same row is a device-

@@ -748,6 +748,11 @@ def render_serving(metrics: Dict[str, Dict[str, Any]], out) -> None:
         ("serve.pager_reloads", "pager reloads"),
         ("serve.pager_resident_bytes", "pager resident bytes"),
         ("serve.profiled_flushes", "profiled flushes"),
+        # transfer telemetry (device-resident carry plane): what the
+        # serving path actually moved across the host/device boundary
+        ("serve.h2d_bytes", "h2d bytes"),
+        ("serve.d2h_bytes", "d2h bytes"),
+        ("serve.carry_resident_bytes", "carry resident bytes"),
     ]
     seen = False
     for key, label in simple:

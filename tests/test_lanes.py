@@ -1,0 +1,470 @@
+"""Device-resident carry plane suite (`hhmm_tpu/serve/lanes.py` + the
+scheduler's ``resident=True`` mode — docs/serving.md "Device-resident
+carry", tier-1, fast).
+
+Pins the plane's contracts:
+
+- **lane table semantics**: refcounted bank lifetimes (commit
+  supersedes and frees), the full-lane-key ``bank_for`` fast path,
+  spill candidacy oldest-first with the fresh bank protected, and
+  ``release`` dropping only mappings still pointing at the victim;
+- **bitwise parity**: a 256-series replay with mid-stream
+  detach→warm-page-in, bucket promotion, ``swap_snapshot`` and
+  ``replace_draw_bank`` interleaved produces responses AND final
+  ``state()`` bitwise identical to the host-staged path — the commit
+  boundaries are exactly where a stale host mirror would silently
+  serve old state;
+- **slot budget**: ``carry_slots_cap`` spills the oldest banks' rows
+  back to records without breaking parity;
+- **compile flatness**: with residency on, a warmup that lands every
+  kernel (init, bank-hit update, gathered regroup, warm replay) is
+  followed by ZERO new XLA compiles over sustained churny replay;
+- **thread safety**: the lane-table lock stays a leaf (two-thread
+  table churn and a resident submit/harvest pipeline churn both drain
+  clean — the `hhmm_tpu.analysis` concurrency lint covers the static
+  side).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hhmm_tpu.models import GaussianHMM, MultinomialHMM
+from hhmm_tpu.serve import (
+    CarryBank,
+    LaneTable,
+    MicroBatchScheduler,
+    PosteriorSnapshot,
+    model_spec,
+)
+
+
+def _fake_snapshot(model, n_draws=3, scale=0.3, seed=0, healthy=True):
+    rng = np.random.default_rng(seed)
+    draws = (rng.normal(size=(n_draws, model.n_free)) * scale).astype(
+        np.float32
+    )
+    return PosteriorSnapshot(
+        spec=model_spec(model), draws=draws, healthy=healthy
+    )
+
+
+def _resp_key(r):
+    return (
+        r.probs.tobytes(),
+        np.float64(r.loglik).tobytes(),
+        None if r.per_draw_loglik is None else r.per_draw_loglik.tobytes(),
+        None if r.draw_ok is None else np.asarray(r.draw_ok).tobytes(),
+        r.healthy_draws,
+        r.degraded,
+        r.shed,
+    )
+
+
+def _bank(sids, K=2, D=3, fill=0.0):
+    """A host-array carry bank (the table never touches jax)."""
+    lane_key = tuple(sids)
+    B = len(lane_key)
+    return CarryBank(
+        np.full((B, D, K), fill, np.float32),
+        np.full((B, D), fill, np.float32),
+        np.ones((B, D), bool),
+        lane_key,
+    )
+
+
+class TestLaneTable:
+    def test_commit_lookup_drop_refcount(self):
+        lt = LaneTable()
+        b = _bank(["a", "b"])
+        lt.commit(b, {"a": 0, "b": 1})
+        assert lt.lookup("a") == (b, 0) and lt.lookup("b") == (b, 1)
+        assert lt.resident_bytes() == b.nbytes
+        assert lt.stats()["slots"] == 2 and lt.stats()["banks"] == 1
+        assert lt.drop("a") and not lt.drop("a")
+        # the bank survives while any slot still maps into it
+        assert lt.resident_bytes() == b.nbytes
+        assert lt.drop("b")
+        assert lt.resident_bytes() == 0
+        assert lt.stats() == {
+            "series": 0, "banks": 0, "slots": 0, "resident_bytes": 0,
+            "commits": 1, "spills": 0,
+        }
+
+    def test_commit_supersedes_and_frees(self):
+        lt = LaneTable()
+        b1, b2 = _bank(["a", "b"]), _bank(["a", "b"], fill=1.0)
+        lt.commit(b1, {"a": 0, "b": 1})
+        lt.commit(b2, {"a": 0, "b": 1})
+        assert lt.lookup("a") == (b2, 0)
+        # b1's last slot was remapped: freed, not leaked
+        assert lt.resident_bytes() == b2.nbytes
+        assert lt.stats()["banks"] == 1 and lt.stats()["commits"] == 2
+
+    def test_bank_for_requires_exact_padded_membership(self):
+        lt = LaneTable()
+        # padded lane_key: the tail repeats the last real series
+        b = _bank(["a", "b", "b", "b"])
+        lt.commit(b, {"a": 0, "b": 1})
+        assert lt.bank_for(("a", "b", "b", "b")) is b
+        # different order / membership / padding: regroup, not reuse
+        assert lt.bank_for(("b", "a", "a", "a")) is None
+        assert lt.bank_for(("a", "c", "c", "c")) is None
+        assert lt.bank_for(("a", "b")) is None
+        assert lt.bank_for(()) is None
+        # a series remapped elsewhere breaks the hit
+        b2 = _bank(["b", "b"])
+        lt.commit(b2, {"b": 0})
+        assert lt.bank_for(("a", "b", "b", "b")) is None
+
+    def test_release_respects_racing_commit(self):
+        lt = LaneTable()
+        b1 = _bank(["a", "b"])
+        lt.commit(b1, {"a": 0, "b": 1})
+        # a racing commit remapped "b" after spill victims were picked
+        b2 = _bank(["b", "b"])
+        lt.commit(b2, {"b": 0})
+        dropped = lt.release(b1, ["a", "b"])
+        assert dropped == ["a"]  # "b" now lives in b2: untouched
+        assert lt.lookup("a") is None and lt.lookup("b") == (b2, 0)
+        assert lt.stats()["spills"] == 1
+
+    def test_spill_candidates_oldest_first_and_protect(self):
+        lt = LaneTable()
+        banks = []
+        for i in range(3):
+            b = _bank([f"x{i}", f"y{i}"], fill=float(i))
+            lt.commit(b, {f"x{i}": 0, f"y{i}": 1})
+            banks.append(b)
+        assert lt.stats()["slots"] == 6
+        # fit 6 slots into 2: evict the two oldest, never the newest
+        victims = lt.spill_candidates(2, protect=banks[2])
+        assert [v[0] for v in victims] == banks[:2]
+        assert sorted(s for _, rows in victims for s, _ in rows) == [
+            "x0", "x1", "y0", "y1",
+        ]
+        # under cap: nothing to spill
+        assert lt.spill_candidates(6) == []
+
+
+class TestResidentParity:
+    """The acceptance criterion: bitwise sync-vs-resident parity over a
+    256-series replay with every commit boundary interleaved."""
+
+    N = 256
+
+    def _run(self, resident):
+        model = GaussianHMM(K=2)
+        sched = MicroBatchScheduler(
+            model, buckets=(8, 32, 128), resident=resident, history_tail=8
+        )
+        sids = [f"s{i}" for i in range(self.N)]
+        sched.attach_many(
+            [(s, _fake_snapshot(model, seed=i), None)
+             for i, s in enumerate(sids)]
+        )
+        rng = np.random.default_rng(11)
+        out = {}
+
+        def tick_round(t, subset):
+            for s in subset:
+                sched.submit(s, {"x": float(rng.normal())})
+            for r in sched.flush():
+                assert not r.shed, (t, r.series_id, r.error)
+                out[(t, r.series_id)] = _resp_key(r)
+
+        tick_round(0, sids)          # init, full buckets
+        tick_round(1, sids)          # update, stable membership
+        tick_round(2, sids[:20])     # bucket promotion: 128 -> 32 shapes
+        # detach -> warm page-in through the retained tail
+        tail = sched.history_tail_of("s7")
+        assert tail is not None and sched.detach("s7")
+        sched.attach("s7", _fake_snapshot(model, seed=7), history=tail)
+        tick_round(3, sids)
+        # promotion swap: new draws, filter warmed from the tail
+        err = sched.swap_snapshot(
+            "s11", snapshot=_fake_snapshot(model, seed=1011)
+        )
+        assert err is None, err
+        # rejuvenation commit: jittered bank over the live carry
+        a, l, o = sched.filter_state_of("s13")
+        new_draws = np.asarray(sched.draw_bank_of("s13")) * np.float32(1.01)
+        err = sched.replace_draw_bank("s13", new_draws, a, l, o)
+        assert err is None, err
+        tick_round(4, sids)
+        tick_round(5, sids)
+        states = {
+            s: tuple(np.asarray(v).tobytes() for v in sched.state(s)[:3])
+            for s in sids
+        }
+        return out, states, sched
+
+    def test_bitwise_parity_with_commit_boundaries(self):
+        staged, st_staged, sched_s = self._run(False)
+        resident, st_res, sched_r = self._run(True)
+        assert set(staged) == set(resident) and len(staged) > 0
+        for k in staged:
+            assert staged[k] == resident[k], k
+        assert st_staged == st_res
+        # the resident arm really ran resident (and the staged one
+        # really didn't): the gauge + lane-table stats prove it
+        assert sched_s.metrics.carry_resident_bytes == 0
+        assert sched_r.metrics.carry_resident_bytes > 0
+        assert sched_r._lanes.stats()["commits"] > 0
+        # identical traffic, strictly less staged into dispatch inputs,
+        # identical response surface down
+        assert sched_r.metrics.h2d_bytes < sched_s.metrics.h2d_bytes
+        assert sched_r.metrics.d2h_bytes == sched_s.metrics.d2h_bytes
+
+    def test_slot_budget_spills_without_breaking_parity(self):
+        model = MultinomialHMM(K=2, L=3)
+        snap = _fake_snapshot(model)
+        rng_obs = [
+            [int(v) for v in np.random.default_rng(t).integers(0, 3, 16)]
+            for t in range(8)
+        ]
+
+        def run(resident, cap=None):
+            sched = MicroBatchScheduler(
+                model, buckets=(8,), resident=resident, carry_slots_cap=cap
+            )
+            for i in range(16):
+                sched.attach(f"s{i}", snap)
+            out = {}
+            for t in range(8):
+                # alternate two disjoint 8-lane cohorts: two live banks,
+                # 16 slots -- over an 8-slot cap the older bank spills
+                half = range(8) if t % 2 == 0 else range(8, 16)
+                for i in half:
+                    sched.submit(f"s{i}", {"x": rng_obs[t][i]})
+                for r in sched.flush():
+                    assert not r.shed
+                    out[(t, r.series_id)] = _resp_key(r)
+            return out, sched
+
+        base, _ = run(False)
+        capped, sched = run(True, cap=8)
+        assert base == capped
+        assert sched._carry_spills > 0
+        assert sched._lanes.stats()["slots"] <= 8
+
+    def test_resident_rejects_nonpositive_cap(self):
+        model = MultinomialHMM(K=2, L=3)
+        with pytest.raises(ValueError, match="carry_slots_cap"):
+            MicroBatchScheduler(
+                model, buckets=(4,), resident=True, carry_slots_cap=0
+            )
+
+
+class TestResidentPipeline:
+    def test_async_drive_matches_staged_sync_bitwise(self):
+        model = MultinomialHMM(K=2, L=3)
+        snap = _fake_snapshot(model)
+        B, T = 12, 6
+
+        def run(resident, use_async):
+            sched = MicroBatchScheduler(
+                model, buckets=(4, 16), resident=resident, pipeline=True,
+                history_tail=6,
+            )
+            for i in range(B):
+                sched.attach(f"s{i}", snap)
+            out = {}
+            for t in range(T):
+                if t == 3:  # membership churn while flights cycle
+                    tail = sched.history_tail_of("s3")
+                    assert sched.detach("s3")
+                    sched.attach("s3", snap, history=tail)
+                for i in range(B):
+                    sched.submit(f"s{i}", {"x": (t + i) % 3})
+                if use_async:
+                    assert sched.dispatch_async() >= 1
+                    resps = sched.harvest()
+                else:
+                    resps = sched.flush()
+                for r in resps:
+                    assert not r.shed, (t, r.series_id, r.error)
+                    out[(t, r.series_id)] = _resp_key(r)
+            return out
+
+        base = run(False, False)
+        for resident, use_async in (
+            (False, True), (True, False), (True, True)
+        ):
+            assert run(resident, use_async) == base, (resident, use_async)
+
+    def test_detached_in_flight_never_commits_a_stale_lane(self):
+        """A series detached between dispatch and harvest sheds; its
+        lane slot must NOT enter the table (a re-attach would read
+        carry from a tick that officially never happened)."""
+        model = MultinomialHMM(K=2, L=3)
+        snap = _fake_snapshot(model)
+        sched = MicroBatchScheduler(
+            model, buckets=(4,), resident=True, pipeline=True
+        )
+        for i in range(3):
+            sched.attach(f"s{i}", snap)
+            sched.submit(f"s{i}", {"x": i % 3})
+        assert len(sched.flush()) == 3
+        for i in range(3):
+            sched.submit(f"s{i}", {"x": (i + 1) % 3})
+        assert sched.dispatch_async() == 1
+        assert sched.detach("s1")
+        out = sched.harvest()
+        sheds = [r for r in out if r.shed]
+        assert len(out) == 3 and len(sheds) == 1
+        assert sheds[0].series_id == "s1"
+        assert sched._lanes.lookup("s1") is None
+
+
+class TestResidentCompileFlat:
+    def test_zero_compiles_after_churny_warmup(self):
+        """With residency on, a warmup that exercises every dispatch
+        shape — init, bank-hit update, subset regroup (the jitted
+        gather), and a warm replay re-attach — is followed by a
+        sustained replay with the same churn kinds at ZERO new XLA
+        compiles."""
+        model = MultinomialHMM(K=2, L=3)
+        snap = _fake_snapshot(model)
+        B = 12
+        sched = MicroBatchScheduler(
+            model, buckets=(8, 16), resident=True, history_tail=8
+        )
+        for i in range(B):
+            sched.attach(f"s{i}", snap)
+
+        def cycle(t0):
+            full = [f"s{i}" for i in range(B)]
+            for t, subset in (
+                (t0, full),          # bucket 16 (init or bank-hit)
+                (t0 + 1, full),      # bank-hit update
+                (t0 + 2, full[:8]),  # subset: gathered regroup, bucket 8
+                (t0 + 3, full),      # mixed regroup back to bucket 16
+            ):
+                for s in subset:
+                    sched.submit(s, {"x": (t + hash(s)) % 3})
+                out = sched.flush()
+                assert len(out) == len(subset)
+                assert not any(r.shed for r in out)
+            # churn: detach + warm re-attach (replay kernel), then a
+            # full flush whose carry regroups from mixed sources
+            tail = sched.history_tail_of("s5")
+            assert sched.detach("s5")
+            sched.attach("s5", snap, history=tail)
+            for s in full:
+                sched.submit(s, {"x": 1})
+            assert len(sched.flush()) == B
+
+        cycle(0)   # warmup: every signature compiles here
+        warm = sched.metrics.compile_count
+        assert warm > 0
+        for rep in range(2):
+            cycle(10 * (rep + 1))
+        assert sched.metrics.compile_count == warm
+
+
+class TestLaneThreadSmoke:
+    def test_two_thread_table_churn(self):
+        """Raw table churn: one thread commits/supersedes banks while
+        another looks up, spills, and releases. The lock is a leaf (no
+        jax, no callbacks under it) so nothing can deadlock; byte/slot
+        accounting must stay coherent when the dust settles."""
+        lt = LaneTable()
+        sids = [f"s{i}" for i in range(8)]
+        errors = []
+        stop = threading.Event()
+
+        def committer():
+            try:
+                for n in range(200):
+                    b = _bank(sids, fill=float(n))
+                    lt.commit(b, {s: i for i, s in enumerate(sids)})
+            except Exception as e:  # pragma: no cover - failure path
+                errors.append(e)
+            finally:
+                stop.set()
+
+        def reader():
+            try:
+                while not stop.is_set():
+                    for s in sids:
+                        ref = lt.lookup(s)
+                        if ref is not None:
+                            bank, slot = ref
+                            assert bank.lane_key[slot] == s
+                    lt.bank_for(tuple(sids))
+                    for bank, rows in lt.spill_candidates(4):
+                        lt.release(bank, [s for s, _ in rows])
+                    lt.resident_bytes()
+            except Exception as e:  # pragma: no cover - failure path
+                errors.append(e)
+
+        t1 = threading.Thread(target=committer)
+        t2 = threading.Thread(target=reader)
+        t1.start(); t2.start()
+        t1.join(60); t2.join(60)
+        assert not t1.is_alive() and not t2.is_alive(), "table deadlocked"
+        assert not errors, errors
+        st = lt.stats()
+        # accounting coherent: slots/bytes describe exactly the live
+        # mappings, and dropping them all returns the table to zero
+        assert st["series"] <= len(sids)
+        for s in sids:
+            lt.drop(s)
+        st = lt.stats()
+        assert st["slots"] == 0 and st["resident_bytes"] == 0
+        assert st["banks"] == 0
+
+    def test_two_thread_submit_harvest_churn_resident(self):
+        """The pipeline churn smoke (test_pipeline.py) extended to the
+        lane table: a harvest thread reaps flights (committing carry
+        banks) while the main thread submits, dispatches, and
+        periodically re-attaches a series (dropping + re-creating its
+        lane). Every tick delivered exactly once, nothing shed, and
+        the table ends byte-coherent."""
+        model = MultinomialHMM(K=2, L=3)
+        snap = _fake_snapshot(model)
+        sched = MicroBatchScheduler(
+            model, buckets=(4, 8), resident=True, pipeline=True
+        )
+        B, rounds = 8, 12
+        for i in range(B):
+            sched.attach(f"s{i}", snap)
+        got, errs = [], []
+        stop = threading.Event()
+
+        def harvester():
+            try:
+                while not stop.is_set():
+                    got.extend(sched.harvest())
+                    time.sleep(0.001)
+            except Exception as e:  # pragma: no cover - failure path
+                errs.append(e)
+
+        th = threading.Thread(target=harvester)
+        th.start()
+        try:
+            for t in range(rounds):
+                if t and t % 4 == 0:
+                    # membership churn between generations (the queue
+                    # is drained, nothing in flight for this series)
+                    assert sched.detach("s0")
+                    sched.attach("s0", snap)
+                for i in range(B):
+                    sched.submit(f"s{i}", {"x": (t + i) % 3})
+                sched.dispatch_async()
+                while sched._inflight.depth() > 0:
+                    time.sleep(0.001)
+        finally:
+            stop.set()
+            th.join(timeout=30)
+        got.extend(sched.flush())
+        assert not errs, errs
+        assert len(got) == B * rounds
+        assert not any(r.shed for r in got)
+        stats = sched._lanes.stats()
+        assert stats["series"] == B
+        assert stats["resident_bytes"] > 0
+        assert sched.metrics.carry_resident_bytes == stats["resident_bytes"]

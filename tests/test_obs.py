@@ -312,6 +312,9 @@ class TestCompileTelemetry:
             "dispatch_errors",
             "device_loss_events",
             "compile_count",
+            "h2d_bytes",
+            "d2h_bytes",
+            "carry_resident_bytes",
         }
 
     def test_sample_memory_tolerant(self):
