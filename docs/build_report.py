@@ -29,6 +29,7 @@ OUT = os.path.join(DOCS, "_build", "report.html")
 PAGES = [
     ("README.md", "Overview & index"),
     ("architecture.md", "Architecture"),
+    ("models.md", "The model zoo"),
     ("serving.md", "Streaming inference service"),
     ("robustness.md", "Fault tolerance"),
     ("static_analysis.md", "Static analysis"),

@@ -25,6 +25,10 @@ __all__ = ["neighbouring_forecast", "forecast_errors", "online_forecast_mean"]
 # (all snapshots share [D, dim]) — the per-tick forecast path must not
 # pay eager per-call dispatch overhead
 _FORECAST_J = None
+# the explicit-duration variant: (log_alpha [D, K*Dmax], A_ij, dur_kd,
+# mu_k, ok) — expands the regime transition to the count-down operator
+# and collapses the predictive back to regime space before the mean dot
+_FORECAST_HSMM_J = None
 
 
 def neighbouring_forecast(
@@ -77,7 +81,43 @@ def online_forecast_mean(scheduler, series_id: str) -> float:
     (the scheduler's per-draw health mask) are excluded from the
     average, matching the tick response.
     """
-    global _FORECAST_J
+    global _FORECAST_J, _FORECAST_HSMM_J
+    log_alpha, _, ok, params = scheduler.state(series_id)
+    if "mu_k" not in params or "A_ij" not in params:
+        raise ValueError(
+            "online_forecast_mean needs a Gaussian-emission HMM posterior "
+            f"(mu_k, A_ij); got parameters {sorted(params)}"
+        )
+    if "dur_kd" in params:
+        # explicit-duration posterior (models/hsmm.py): the served
+        # filter lives on the K*Dmax count-down expansion, but the
+        # snapshot's A_ij/mu_k are REGIME-level — pushing the filter
+        # through the regime A would silently mis-normalize. Expand
+        # the operator, collapse the predictive (the audit fix this
+        # second path exists for).
+        if _FORECAST_HSMM_J is None:
+            import jax
+
+            from hhmm_tpu.core.lmath import safe_log
+            from hhmm_tpu.kernels.duration import expand_transition
+            from hhmm_tpu.serve.online import posterior_predictive_mean
+
+            def _forecast_hsmm(log_alpha, A_ij, dur_kd, mu_k, ok):
+                log_A = jax.vmap(
+                    lambda a, d: expand_transition(safe_log(a), safe_log(d))
+                )(A_ij, dur_kd)
+                dmax = dur_kd.shape[-1]
+                return posterior_predictive_mean(
+                    log_alpha, log_A, mu_k, weights=ok, dmax=dmax
+                )
+
+            _FORECAST_HSMM_J = jax.jit(_forecast_hsmm)
+        return float(
+            _FORECAST_HSMM_J(
+                log_alpha, params["A_ij"], params["dur_kd"],
+                params["mu_k"], ok,
+            )
+        )
     if _FORECAST_J is None:
         import jax
 
@@ -90,13 +130,6 @@ def online_forecast_mean(scheduler, series_id: str) -> float:
             )
 
         _FORECAST_J = jax.jit(_forecast)
-
-    log_alpha, _, ok, params = scheduler.state(series_id)
-    if "mu_k" not in params or "A_ij" not in params:
-        raise ValueError(
-            "online_forecast_mean needs a Gaussian-emission HMM posterior "
-            f"(mu_k, A_ij); got parameters {sorted(params)}"
-        )
     return float(_FORECAST_J(log_alpha, params["A_ij"], params["mu_k"], ok))
 
 

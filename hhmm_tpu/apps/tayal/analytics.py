@@ -40,7 +40,9 @@ def map_to_topstate(state: np.ndarray, pairs=((0, 1), (2, 3))) -> np.ndarray:
     return out
 
 
-def topstate_probs(probs: np.ndarray, pairs=((0, 1), (2, 3))) -> np.ndarray:
+def topstate_probs(
+    probs: np.ndarray, pairs=((0, 1), (2, 3)), dmax: int = 1
+) -> np.ndarray:
     """Filtered bottom-state probabilities [..., K] → top-state
     (bear, bull) probabilities [..., 2].
 
@@ -49,8 +51,28 @@ def topstate_probs(probs: np.ndarray, pairs=((0, 1), (2, 3))) -> np.ndarray:
     summed mass of its production-state pair. Output order is (bear,
     bull), matching the ``(STATE_BEAR, STATE_BULL)`` code order. Feed
     the per-tick draw-averaged ``TickResponse.probs`` of the serving
-    scheduler into this, then into an online flip detector."""
+    scheduler into this, then into an online flip detector.
+
+    ``dmax``: duration-expansion factor for explicit-duration serving
+    (`models/hsmm.py`): ``TickResponse.probs`` is then ``[..., K*dmax]``
+    on the count-down expansion and is collapsed to regime space
+    (`kernels/duration.py::collapse_probs`) before pairing — pairing
+    expanded lanes directly would sum the WRONG mass silently. The
+    pair indices are validated against the collapsed width, so an
+    un-collapsed expanded vector fails loud, not quiet."""
     p = np.asarray(probs)
+    if dmax > 1:
+        from hhmm_tpu.kernels.duration import collapse_probs
+
+        p = collapse_probs(p, dmax)
+    width = p.shape[-1]
+    flat = [i for pair in pairs for i in pair]
+    if flat and max(flat) >= width:
+        raise ValueError(
+            f"pairs {pairs} index past the regime width {width} — "
+            "expanded-state probs need the matching dmax "
+            "(models/hsmm.py: dmax = Dmax)"
+        )
     return np.stack([p[..., list(pair)].sum(axis=-1) for pair in pairs], axis=-1)
 
 

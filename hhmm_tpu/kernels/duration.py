@@ -1,0 +1,144 @@
+"""Explicit-duration (HSMM) state-space expansion.
+
+An explicit-duration HSMM (Yu 2010, "Hidden semi-Markov models") over K
+regimes with per-regime duration pmfs supported on {1..Dmax} is exactly
+an ordinary HMM on the expanded chain of ``K * Dmax`` states under the
+**count-down encoding**
+
+    expanded state s = k * Dmax + c,   c = remaining steps AFTER this one
+
+with the structured transition law
+
+    (k, c > 0)  ->  (k, c - 1)              deterministically,
+    (k, c == 0) ->  (j, d - 1)   w.p.  A[k, j] * p_j(d),
+
+i.e. a regime holds for exactly the drawn duration, then transitions by
+the regime-level ``A`` and draws the successor's duration from its pmf.
+Everything downstream — forward filter, smoother, Viterbi, FFBS, the
+``{seq, assoc, pallas}`` dispatch (`kernels/dispatch.py`), the gibbs
+z-update and the serve tick kernels — runs UNCHANGED on the expanded
+chain: this module only builds the expanded ``(log_pi, log_A, log_obs)``
+triple and collapses expanded posteriors back.
+
+Structure is expressed through the log-domain the semiring engine
+already guards: off-structure cells get :data:`~hhmm_tpu.core.lmath`'s
+finite ``MASK_NEG`` (exactly 0 at f32 precision, finite gradients), and
+genuinely forbidden durations may arrive as ``-inf`` cells in the
+duration log-pmf — both degrade through ``safe_logsumexp`` /
+``safe_log_normalize`` without NaNs. The expanded operator stays a
+dense, homogeneous 2-D f32 matrix, so ``_pallas_decode_ok`` and the
+planner's branch pin see the same shape class as any plain HMM with
+``K' = K * Dmax`` states.
+
+Degeneracy contract (pinned by tests): at ``Dmax == 1`` with the
+all-mass-on-1 duration pmf (``log_dur == 0.0``), every expansion below
+is the BITWISE identity — ``x + 0.0`` is exact for probability logs
+(no ``-0.0`` arises from logs of values in (0, 1]), the continue block
+is empty, and the reshapes are no-ops — so a ``Dmax=1`` HSMM IS the
+plain HMM, draw for draw.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from hhmm_tpu.core.lmath import MASK_NEG, safe_logsumexp
+
+__all__ = [
+    "expand_transition",
+    "expand_initial",
+    "expand_obs",
+    "regime_log_marginals",
+    "collapse_probs",
+    "duration_posterior",
+    "regime_path",
+]
+
+
+def expand_transition(log_A: jnp.ndarray, log_dur: jnp.ndarray) -> jnp.ndarray:
+    """Expanded transition operator ``[K*Dmax, K*Dmax]`` from regime
+    transitions ``log_A [K, K]`` and duration log-pmf ``log_dur
+    [K, Dmax]`` (``log_dur[k, d-1]`` = log P(duration = d | regime k)).
+
+    Row ``(k, c)``: for ``c > 0`` the count-down continues to
+    ``(k, c-1)`` at log-probability 0; for ``c == 0`` the chain enters
+    ``(j, d-1)`` at ``log_A[k, j] + log_dur[j, d-1]``. Off-structure
+    cells sit at the finite ``MASK_NEG`` floor (gradient-safe zero);
+    ``-inf`` duration cells (forbidden durations) pass through the
+    entry block untouched and are handled by the guarded reductions
+    downstream. The result keeps ``log_A``'s dtype (f32 in every serve
+    path — Pallas decode eligibility is preserved)."""
+    K, Dmax = log_dur.shape
+    if log_A.shape != (K, K):
+        raise ValueError(
+            f"log_A {log_A.shape} inconsistent with log_dur {log_dur.shape}"
+        )
+    if Dmax == 1:
+        # bitwise degeneracy fast path: entry block only, no reshape
+        return log_A + log_dur.T  # [K, K] + [1, K]
+    c = jnp.arange(Dmax)
+    # grid[k, c, j, c'] over the expanded row/column index pairs
+    cont = (c[:, None] == c[None, :] + 1)[None, :, None, :] & (
+        jnp.eye(K, dtype=bool)[:, None, :, None]
+    )  # (k, c) -> (k, c-1)
+    entry = log_A[:, None, :, None] + log_dur[None, None, :, :]  # c == 0 rows
+    floor = jnp.asarray(MASK_NEG, dtype=log_A.dtype)
+    grid = jnp.where(
+        cont,
+        jnp.zeros((), log_A.dtype),
+        jnp.where((c == 0)[None, :, None, None], entry, floor),
+    )
+    return grid.reshape(K * Dmax, K * Dmax)
+
+
+def expand_initial(log_pi: jnp.ndarray, log_dur: jnp.ndarray) -> jnp.ndarray:
+    """Expanded initial distribution ``[K*Dmax]``: regime from
+    ``log_pi [K]``, remaining count from its duration pmf —
+    ``log p(s_1 = (k, d-1)) = log_pi[k] + log_dur[k, d-1]``."""
+    return (log_pi[:, None] + log_dur).reshape(-1)
+
+
+def expand_obs(log_obs: jnp.ndarray, Dmax: int) -> jnp.ndarray:
+    """Expanded emissions ``[T, K*Dmax]`` from per-regime emissions
+    ``[T, K]``: the observation law depends on the regime only, so each
+    regime's column is repeated across its ``Dmax`` count-down lanes."""
+    T, K = log_obs.shape
+    return jnp.repeat(log_obs, Dmax, axis=-1) if Dmax > 1 else log_obs
+
+
+def regime_log_marginals(log_post: jnp.ndarray, Dmax: int) -> jnp.ndarray:
+    """Collapse expanded log-posteriors ``[..., K*Dmax]`` to regime
+    log-marginals ``[..., K]`` (guarded logsumexp over the count-down
+    axis: an all-masked regime stays at the floor, no NaNs)."""
+    if Dmax == 1:
+        return log_post
+    shp = log_post.shape
+    grid = log_post.reshape(shp[:-1] + (shp[-1] // Dmax, Dmax))
+    return safe_logsumexp(grid, axis=-1, floor=MASK_NEG)
+
+
+def collapse_probs(probs, Dmax: int):
+    """Collapse expanded probability vectors ``[..., K*Dmax]`` to
+    regime probabilities ``[..., K]`` — plain reshape + sum, valid for
+    any normalized (or NaN-degraded) posterior. Works on numpy and jax
+    arrays alike (the serve host path hands numpy in)."""
+    if Dmax == 1:
+        return probs
+    shp = probs.shape
+    return probs.reshape(shp[:-1] + (shp[-1] // Dmax, Dmax)).sum(axis=-1)
+
+
+def duration_posterior(log_post: jnp.ndarray, Dmax: int) -> jnp.ndarray:
+    """Remaining-duration posterior ``[..., K, Dmax]`` from expanded
+    log-posteriors ``[..., K*Dmax]``: cell ``[k, c]`` is the posterior
+    probability of sitting in regime ``k`` with ``c`` steps remaining
+    (normalized jointly — rows sum to the regime marginals)."""
+    shp = log_post.shape
+    grid = log_post.reshape(shp[:-1] + (shp[-1] // Dmax, Dmax))
+    return jnp.exp(grid)
+
+
+def regime_path(z: jnp.ndarray, Dmax: int) -> jnp.ndarray:
+    """Collapse expanded state paths (Viterbi/FFBS draws) to regime
+    paths: ``s = k * Dmax + c  ->  k``."""
+    return z if Dmax == 1 else z // Dmax

@@ -1,5 +1,6 @@
 from hhmm_tpu.models.base import BaseHMMModel
 from hhmm_tpu.models.gaussian_hmm import GaussianHMM, NIGPrior
+from hhmm_tpu.models.hsmm import GaussianHSMM, MultinomialHSMM
 from hhmm_tpu.models.multinomial_hmm import MultinomialHMM, SemisupMultinomialHMM
 from hhmm_tpu.models.iohmm import IOHMMReg, IOHMMMix, IOHMMHMix, IOHMMHMixLite
 from hhmm_tpu.models.tayal import TayalHHMM, TayalHHMMLite
@@ -9,6 +10,8 @@ __all__ = [
     "TreeHMM",
     "BaseHMMModel",
     "GaussianHMM",
+    "GaussianHSMM",
+    "MultinomialHSMM",
     "NIGPrior",
     "MultinomialHMM",
     "SemisupMultinomialHMM",

@@ -32,7 +32,40 @@ from hhmm_tpu.core.lmath import safe_log
 from hhmm_tpu.core.bijectors import Bijector, Ordered, Positive, Simplex
 from hhmm_tpu.models.base import BaseHMMModel
 
-__all__ = ["GaussianHMM", "NIGPrior"]
+__all__ = ["GaussianHMM", "NIGPrior", "nig_emission_draw"]
+
+
+def nig_emission_draw(pr, k_v, k_mu, x, zoh, mu_cur, sigma_cur):
+    """Joint NIG emission draw with the exact ordered-cone MH step
+    (see :meth:`GaussianHMM.gibbs_update`): sufficient statistics from
+    the (mask-weighted) one-hot assignment ``zoh [T, K]``, a joint
+    ``(sigma^2, mu)`` posterior draw per state, accept iff ordered,
+    keep ``(mu_cur, sigma_cur)`` otherwise. Shared by the plain HMM
+    and the explicit-duration HSMM (whose ``zoh`` is the collapsed
+    regime assignment) — same keys, same op order, same draws."""
+    K = zoh.shape[-1]
+    n_k = zoh.sum(axis=0)  # [K]
+    sum_x = x @ zoh  # [K]
+    sum_x2 = (x * x) @ zoh  # [K]
+
+    xbar = jnp.where(n_k > 0, sum_x / jnp.maximum(n_k, 1.0), pr.m0)
+    scatter = jnp.maximum(sum_x2 - n_k * xbar * xbar, 0.0)
+    kappa_n = pr.kappa0 + n_k
+    m_n = (pr.kappa0 * pr.m0 + sum_x) / kappa_n
+    a_n = pr.a0 + 0.5 * n_k
+    b_n = (
+        pr.b0
+        + 0.5 * scatter
+        + 0.5 * pr.kappa0 * n_k * (xbar - pr.m0) ** 2 / kappa_n
+    )
+    v = b_n / jax.random.gamma(k_v, a_n)
+    sigma = jnp.sqrt(v)
+    mu = m_n + sigma / jnp.sqrt(kappa_n) * jax.random.normal(k_mu, (K,))
+
+    ordered = jnp.all(mu[1:] > mu[:-1])
+    mu = jnp.where(ordered, mu, mu_cur)
+    sigma = jnp.where(ordered, sigma, sigma_cur)
+    return mu, jnp.maximum(sigma, 2e-4)
 
 
 @dataclass(frozen=True)
@@ -73,9 +106,25 @@ class NIGPrior:
 
 
 class GaussianHMM(BaseHMMModel):
-    def __init__(self, K: int, nig_prior: Optional[NIGPrior] = None):
+    def __init__(
+        self,
+        K: int,
+        nig_prior: Optional[NIGPrior] = None,
+        sticky_kappa: float = 0.0,
+    ):
+        """``sticky_kappa``: sticky-transition concentration (Fox et
+        al. 2011's kappa, as a plain Dirichlet pseudo-count): the
+        transition prior becomes ``A_k· ~ Dir(1 + kappa * e_k)`` —
+        kappa extra prior mass on self-transitions. One knob on the
+        existing Dirichlet machinery: it adds ``kappa * log A_kk`` to
+        the HMC target and ``kappa`` to the Gibbs posterior's diagonal
+        concentration, so both samplers keep targeting the identical
+        posterior. ``0.0`` (default) is the exact flat-prior model."""
+        if sticky_kappa < 0.0:
+            raise ValueError("sticky_kappa must be >= 0")
         self.K = K
         self.nig_prior = nig_prior
+        self.sticky_kappa = float(sticky_kappa)
 
     def specs(self) -> List[Tuple[str, Bijector]]:
         K = self.K
@@ -99,9 +148,16 @@ class GaussianHMM(BaseHMMModel):
         )
 
     def log_prior(self, params):
-        if self.nig_prior is None:
-            return jnp.zeros(())
-        return self.nig_prior.log_density(params["mu_k"], params["sigma_k"])
+        lp = jnp.zeros(())
+        if self.nig_prior is not None:
+            lp = lp + self.nig_prior.log_density(
+                params["mu_k"], params["sigma_k"]
+            )
+        if self.sticky_kappa:
+            lp = lp + self.sticky_kappa * jnp.sum(
+                safe_log(jnp.diagonal(params["A_ij"]))
+            )
+        return lp
 
     def gibbs_update(self, key, z, data, params):
         """Conjugate parameter block for blocked Gibbs (`infer/gibbs.py`).
@@ -136,33 +192,17 @@ class GaussianHMM(BaseHMMModel):
         zoh = jax.nn.one_hot(z, K, dtype=jnp.float32)  # [T, K]
         if mask is not None:
             zoh = zoh * mask[:, None]
-        n_k = zoh.sum(axis=0)  # [K]
-        sum_x = x @ zoh  # [K]
-        sum_x2 = (x * x) @ zoh  # [K]
-
-        xbar = jnp.where(n_k > 0, sum_x / jnp.maximum(n_k, 1.0), pr.m0)
-        scatter = jnp.maximum(sum_x2 - n_k * xbar * xbar, 0.0)
-        kappa_n = pr.kappa0 + n_k
-        m_n = (pr.kappa0 * pr.m0 + sum_x) / kappa_n
-        a_n = pr.a0 + 0.5 * n_k
-        b_n = (
-            pr.b0
-            + 0.5 * scatter
-            + 0.5 * pr.kappa0 * n_k * (xbar - pr.m0) ** 2 / kappa_n
+        mu, sigma = nig_emission_draw(
+            pr, k_v, k_mu, x, zoh, params["mu_k"], params["sigma_k"]
         )
-        v = b_n / jax.random.gamma(k_v, a_n)
-        sigma = jnp.sqrt(v)
-        mu = m_n + sigma / jnp.sqrt(kappa_n) * jax.random.normal(k_mu, (K,))
-
-        ordered = jnp.all(mu[1:] > mu[:-1])
-        mu = jnp.where(ordered, mu, params["mu_k"])
-        sigma = jnp.where(ordered, sigma, params["sigma_k"])
-
+        conc_A = 1.0 + transition_counts(z, K, mask)
+        if self.sticky_kappa:
+            conc_A = conc_A + self.sticky_kappa * jnp.eye(K, dtype=conc_A.dtype)
         return {
             "p_1k": jax.random.dirichlet(k_p1, 1.0 + zoh[0]),
-            "A_ij": jax.random.dirichlet(k_A, 1.0 + transition_counts(z, K, mask)),
+            "A_ij": jax.random.dirichlet(k_A, conc_A),
             "mu_k": mu,
-            "sigma_k": jnp.maximum(sigma, 2e-4),
+            "sigma_k": sigma,
         }
 
     def init_unconstrained(self, key, data):

@@ -1,0 +1,298 @@
+"""Explicit-duration hidden semi-Markov models (HSMM) via state-space
+expansion — the duration-aware members of the model zoo.
+
+The Tayal regime model's natural successor (ROADMAP item 5): a
+geometric-duration HMM forces regime dwell times to decay
+geometrically, while financial regimes empirically hold for
+characteristic windows. :class:`GaussianHSMM` / :class:`MultinomialHSMM`
+put an explicit duration pmf ``dur_kd [K, Dmax]`` on every regime and
+realize the semi-Markov chain as an ORDINARY HMM on the ``K * Dmax``
+count-down expansion (`kernels/duration.py`, Yu 2010) — so the whole
+existing stack (forward/smooth/Viterbi/FFBS kernels, the
+``{seq, assoc, pallas}`` dispatch, NUTS/ChEES via ``make_vg``, blocked
+Gibbs via ``gibbs_update``, and the serve tick kernels through
+``tick_init``/``tick_terms``) runs UNCHANGED on the expanded chain.
+
+Degeneracy contract: at ``Dmax=1`` the duration simplex has zero free
+parameters and the expansions are bitwise identities, so a ``Dmax=1``
+:class:`GaussianHSMM` IS :class:`~hhmm_tpu.models.GaussianHMM` — same
+logliks, same smoothed posteriors, same FFBS streams draw for draw
+(pinned in `tests/test_hsmm.py`).
+
+Sticky transitions (Fox et al. 2011): ``sticky_kappa`` adds kappa
+pseudo-count mass to the Dirichlet transition prior's diagonal — in
+the HSMM the self-transition means "re-enter the same regime with a
+freshly drawn duration". Both models expose it; the plain
+:class:`GaussianHMM` grew the same knob.
+
+Serve integration: the models expose ``K`` (regimes — what consumers
+reason about) AND ``n_states = K * Dmax`` (the served filter width);
+`serve/scheduler.py` sizes shed responses by ``n_states`` and the
+regime-event feed collapses expanded probabilities through
+`kernels/duration.py::collapse_probs` before flip detection.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hhmm_tpu.core import dists
+from hhmm_tpu.core.bijectors import Bijector, Ordered, Positive, Simplex
+from hhmm_tpu.core.lmath import safe_log
+from hhmm_tpu.kernels import duration
+from hhmm_tpu.models.base import BaseHMMModel
+from hhmm_tpu.models.gaussian_hmm import NIGPrior, nig_emission_draw
+
+__all__ = ["GaussianHSMM", "MultinomialHSMM"]
+
+
+class _HSMMBase(BaseHMMModel):
+    """Shared expansion + duration/transition Gibbs machinery.
+
+    Subclasses supply the per-regime emission term (``_log_obs_k``,
+    ``[T, K]``) and the emission parameter blocks; this base owns the
+    count-down expansion and the regime/duration sufficient statistics
+    derived from expanded FFBS paths."""
+
+    def __init__(self, K: int, Dmax: int, sticky_kappa: float = 0.0):
+        if K < 1 or Dmax < 1:
+            raise ValueError(f"need K >= 1 and Dmax >= 1, got ({K}, {Dmax})")
+        if sticky_kappa < 0.0:
+            raise ValueError("sticky_kappa must be >= 0")
+        self.K = K
+        self.Dmax = Dmax
+        self.sticky_kappa = float(sticky_kappa)
+
+    @property
+    def n_states(self) -> int:
+        """Width of the expanded chain the kernels/serve actually run
+        — the ``K`` every ``[K]``-shaped kernel output has."""
+        return self.K * self.Dmax
+
+    # ---- expansion ----
+
+    def _log_obs_k(self, params, data) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def build(self, params, data):
+        log_dur = safe_log(params["dur_kd"])
+        return (
+            duration.expand_initial(safe_log(params["p_1k"]), log_dur),
+            duration.expand_transition(safe_log(params["A_ij"]), log_dur),
+            duration.expand_obs(self._log_obs_k(params, data), self.Dmax),
+            data.get("mask"),
+        )
+
+    def log_prior(self, params):
+        lp = jnp.zeros(())
+        if self.sticky_kappa:
+            lp = lp + self.sticky_kappa * jnp.sum(
+                safe_log(jnp.diagonal(params["A_ij"]))
+            )
+        return lp
+
+    # ---- posterior collapse conveniences ----
+
+    def regime_probs(self, probs):
+        """Collapse expanded posterior probabilities ``[..., K*Dmax]``
+        to ``[..., K]`` regime probabilities."""
+        return duration.collapse_probs(probs, self.Dmax)
+
+    def regime_path(self, z):
+        """Collapse expanded state paths to ``[..., T]`` regime paths."""
+        return duration.regime_path(z, self.Dmax)
+
+    # ---- Gibbs sufficient statistics on the expanded path ----
+
+    def _hsmm_counts(self, z, mask):
+        """Regime/duration sufficient statistics from an expanded path.
+
+        Count-down semantics: ``t`` is an ENTRY step iff ``t == 0`` or
+        the previous count hit 0 (the regime had to re-draw). Returns
+        ``(zoh [T, K] mask-weighted regime one-hots, n_trans [K, K]
+        regime transition counts over entry steps, n_dur [K, Dmax]
+        duration-choice counts over entries)`` — one-hot matmuls, no
+        scatters, mirroring `infer/gibbs.py`."""
+        K, Dmax = self.K, self.Dmax
+        zk = duration.regime_path(z, Dmax)
+        zc = z % Dmax  # remaining count at each step
+        zoh = jax.nn.one_hot(zk, K, dtype=jnp.float32)  # [T, K]
+        entry = jnp.concatenate(
+            [jnp.ones((1,), jnp.float32), (zc[:-1] == 0).astype(jnp.float32)]
+        )
+        w_pair = entry[1:]
+        w_entry = entry
+        if mask is not None:
+            w_pair = w_pair * mask[1:]
+            w_entry = w_entry * mask
+            zoh_m = zoh * mask[:, None]
+        else:
+            zoh_m = zoh
+        n_trans = (zoh[:-1] * w_pair[:, None]).T @ zoh[1:]  # [K, K]
+        coh = jax.nn.one_hot(zc, Dmax, dtype=jnp.float32)  # [T, Dmax]
+        n_dur = (zoh * w_entry[:, None]).T @ coh  # [K, Dmax]
+        return zoh_m, n_trans, n_dur
+
+    def _draw_chain_params(self, k_p1, k_A, k_dur, zoh0, n_trans, n_dur):
+        conc_A = 1.0 + n_trans
+        if self.sticky_kappa:
+            conc_A = conc_A + self.sticky_kappa * jnp.eye(
+                self.K, dtype=conc_A.dtype
+            )
+        return {
+            "p_1k": jax.random.dirichlet(k_p1, 1.0 + zoh0),
+            "A_ij": jax.random.dirichlet(k_A, conc_A),
+            "dur_kd": jax.random.dirichlet(k_dur, 1.0 + n_dur),
+        }
+
+
+class GaussianHSMM(_HSMMBase):
+    """Gaussian-emission explicit-duration HSMM.
+
+    Parameters: initial regime simplex ``p_1k [K]``, regime transition
+    simplex rows ``A_ij [K, K]``, duration simplex rows ``dur_kd
+    [K, Dmax]`` (``dur_kd[k, d-1]`` = P(duration = d | regime k)),
+    ``ordered[K] mu_k``, ``sigma_k > 1e-4`` — the
+    :class:`~hhmm_tpu.models.GaussianHMM` emission block verbatim, so
+    the NIG conjugate Gibbs block is shared bit-for-bit."""
+
+    def __init__(
+        self,
+        K: int,
+        Dmax: int,
+        nig_prior: Optional[NIGPrior] = None,
+        sticky_kappa: float = 0.0,
+    ):
+        super().__init__(K, Dmax, sticky_kappa)
+        self.nig_prior = nig_prior
+
+    def specs(self) -> List[Tuple[str, Bijector]]:
+        K, Dmax = self.K, self.Dmax
+        return [
+            ("p_1k", Simplex(shape=(K,))),
+            ("A_ij", Simplex(shape=(K, K))),
+            ("dur_kd", Simplex(shape=(K, Dmax))),
+            ("mu_k", Ordered(shape=(K,))),
+            ("sigma_k", Positive(shape=(K,), lower=1e-4)),
+        ]
+
+    def _log_obs_k(self, params, data):
+        x = data["x"]
+        return dists.normal_logpdf(
+            x[:, None], params["mu_k"][None, :], params["sigma_k"][None, :]
+        )
+
+    def log_prior(self, params):
+        lp = super().log_prior(params)
+        if self.nig_prior is not None:
+            lp = lp + self.nig_prior.log_density(
+                params["mu_k"], params["sigma_k"]
+            )
+        return lp
+
+    def gibbs_update(self, key, z, data, params):
+        """Conjugate parameter block on the EXPANDED path ``z`` (the
+        FFBS draw `infer/gibbs.py` hands in): regime/duration/initial
+        sufficient statistics via :meth:`_hsmm_counts`, Dirichlet rows
+        for ``A_ij``/``dur_kd``/``p_1k`` (sticky kappa on the
+        transition diagonal), and the joint NIG emission draw with the
+        exact ordered-cone MH step — shared verbatim with
+        :class:`GaussianHMM` (`models/gaussian_hmm.py::nig_emission_draw`),
+        applied to the collapsed regime assignment."""
+        if self.nig_prior is None:
+            raise ValueError(
+                "GaussianHSMM Gibbs needs a proper conjugate prior: construct "
+                "with GaussianHSMM(K, Dmax, nig_prior=NIGPrior(...))"
+            )
+        x = data["x"].astype(jnp.float32)
+        mask = data.get("mask")
+        k_p1, k_A, k_dur, k_v, k_mu = jax.random.split(key, 5)
+        zoh_m, n_trans, n_dur = self._hsmm_counts(z, mask)
+        mu, sigma = nig_emission_draw(
+            self.nig_prior, k_v, k_mu, x, zoh_m,
+            params["mu_k"], params["sigma_k"],
+        )
+        out = self._draw_chain_params(
+            k_p1, k_A, k_dur, zoh_m[0], n_trans, n_dur
+        )
+        out["mu_k"] = mu
+        out["sigma_k"] = sigma
+        return out
+
+    def init_unconstrained(self, key, data):
+        """k-means emission init (the `models/gaussian_hmm.py` /
+        `hmm/main.R:37-47` recipe) with uniform chain/duration
+        simplices."""
+        x = np.asarray(data["x"])
+        mask = data.get("mask")
+        if mask is not None:
+            x = x[np.asarray(mask) > 0]
+        K, Dmax = self.K, self.Dmax
+        from scipy.cluster.vq import kmeans2
+
+        centers, labels = kmeans2(x.astype(np.float64), K, minit="++", seed=0)
+        order = np.argsort(centers)
+        mu = np.sort(centers)
+        sigma = np.array(
+            [max(x[labels == order[k]].std(), 1e-2)
+             if (labels == order[k]).any() else x.std()
+             for k in range(K)]
+        )
+        jitter = 0.1 * np.asarray(jax.random.normal(key, (K,)))
+        params = {
+            "p_1k": np.full(K, 1.0 / K),
+            "A_ij": np.full((K, K), 1.0 / K),
+            "dur_kd": np.full((K, Dmax), 1.0 / Dmax),
+            "mu_k": np.sort(mu + jitter * sigma),
+            "sigma_k": sigma,
+        }
+        return self.pack(params)
+
+
+class MultinomialHSMM(_HSMMBase):
+    """Discrete-emission explicit-duration HSMM: ``simplex[L] phi_k``
+    per regime (the `models/multinomial_hmm.py` emission block) on the
+    count-down expansion."""
+
+    def __init__(
+        self, K: int, Dmax: int, L: int, sticky_kappa: float = 0.0
+    ):
+        super().__init__(K, Dmax, sticky_kappa)
+        self.L = L
+
+    def specs(self) -> List[Tuple[str, Bijector]]:
+        K, Dmax, L = self.K, self.Dmax, self.L
+        return [
+            ("p_1k", Simplex(shape=(K,))),
+            ("A_ij", Simplex(shape=(K, K))),
+            ("dur_kd", Simplex(shape=(K, Dmax))),
+            ("phi_k", Simplex(shape=(K, L))),
+        ]
+
+    def _log_obs_k(self, params, data):
+        x = data["x"].astype(jnp.int32)
+        log_phi = safe_log(params["phi_k"])  # [K, L]
+        # one-hot matmul, not a gather (MXU VJP — models/tayal.py)
+        return jax.nn.one_hot(x, self.L, dtype=log_phi.dtype) @ log_phi.T
+
+    def gibbs_update(self, key, z, data, params=None):
+        """Flat-Dirichlet conjugate block on the expanded path:
+        emission counts over the collapsed regime assignment,
+        transition/duration counts over entry steps."""
+        from hhmm_tpu.infer.gibbs import emission_counts
+
+        x = data["x"].astype(jnp.int32)
+        mask = data.get("mask")
+        k_p1, k_A, k_dur, k_phi = jax.random.split(key, 4)
+        zoh_m, n_trans, n_dur = self._hsmm_counts(z, mask)
+        zk = self.regime_path(z)
+        c_emis = emission_counts(zk, x, self.K, self.L, mask)
+        out = self._draw_chain_params(
+            k_p1, k_A, k_dur, zoh_m[0], n_trans, n_dur
+        )
+        out["phi_k"] = jax.random.dirichlet(k_phi, 1.0 + c_emis)
+        return out

@@ -329,6 +329,12 @@ class RequestRecorder:
         self._sched_credit_cap = 0.0
         self._sched_tenants: Dict[str, Dict[str, Any]] = {}
         self._sched_last_order: List[str] = []
+        # regime-event attribution (note_event): per-folded-tenant
+        # flip/drift counts published by the serve event feed
+        # (serve/events.py) — change-point detection is a product, so
+        # its volume belongs in the same windowed stanza the rest of
+        # the request plane reports in
+        self._event_tenants: Dict[str, Dict[str, int]] = {}
         # async-pipeline flight registrations (begin_flight /
         # note_harvest): flush_id -> the flight's traces, so the
         # harvest-site stamp lands on the RIGHT in-flight flush even
@@ -633,6 +639,30 @@ class RequestRecorder:
                 if c > row["credit_max"]:
                     row["credit_max"] = c
 
+    def note_event(self, tenant, kind: str) -> None:
+        """One published regime event (`serve/events.py`): a
+        hysteresis-committed regime ``"flip"`` or a CUSUM ``"drift"``
+        alarm, attributed to its (folded) tenant. The stanza's
+        ``events`` block is the per-window product-volume view; the
+        lifetime view lives on the feed itself (``serve.events_*``
+        counters + ``RegimeEventFeed.stanza``)."""
+        if not self.enabled():
+            return
+        key = "drifts" if kind == "drift" else "flips"
+        with self._lock:
+            label = self._fold(str(tenant))
+            row = self._event_tenants.get(label)
+            if row is None:
+                if len(self._event_tenants) >= self._max_tenants:
+                    label = OVERFLOW_TENANT
+                    row = self._event_tenants.get(label)
+                if row is None:
+                    row = self._event_tenants[label] = {
+                        "flips": 0,
+                        "drifts": 0,
+                    }
+            row[key] += 1
+
     # ---- reading ----
 
     def p99_spread_ms(self) -> Optional[float]:
@@ -685,6 +715,7 @@ class RequestRecorder:
             self._sched_credit_cap = 0.0
             self._sched_tenants = {}
             self._sched_last_order = []
+            self._event_tenants = {}
             # LIVE in-flight flights carry over exactly like queue
             # occupancy (their harvest lands in the new window); the
             # peak restarts from the live depth
@@ -720,6 +751,20 @@ class RequestRecorder:
                         for t, row in self._sched_tenants.items()
                     },
                     "last_flush_order": list(self._sched_last_order),
+                }
+            events = None
+            if self._event_tenants:
+                events = {
+                    "tenants": {
+                        t: dict(row)
+                        for t, row in self._event_tenants.items()
+                    },
+                    "flips": sum(
+                        r["flips"] for r in self._event_tenants.values()
+                    ),
+                    "drifts": sum(
+                        r["drifts"] for r in self._event_tenants.values()
+                    ),
                 }
             tenants: Dict[str, Any] = {}
             shown = items if top is None else items[:top]
@@ -782,6 +827,7 @@ class RequestRecorder:
             },
             "profiled_device_ms": profiled,
             "scheduler": sched,
+            "events": events,
             "pipeline": pipeline,
             "transfers": transfers,
         }

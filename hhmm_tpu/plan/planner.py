@@ -67,15 +67,32 @@ MIN_SP_CHUNK = 8
 
 @dataclass(frozen=True)
 class WorkloadShape:
-    """The four numbers every placement decision is a function of."""
+    """The four numbers every placement decision is a function of.
+
+    ``duration``: the explicit-duration expansion factor
+    (`models/hsmm.py` ``Dmax``; 1 for plain HMMs). The kernels run on
+    the EXPANDED chain, so every width-sensitive decision (the
+    time-parallel crossover, admission byte estimates) is a function
+    of :attr:`state_width` = ``K * duration``, while ``K`` stays the
+    regime count consumers reason about. Emitted into stanzas/digests
+    only when > 1, so every pre-HSMM manifest digest is unchanged."""
 
     B: int  # independent series
     T: int  # time steps per series
     C: int = 1  # chains per series
-    K: int = 4  # hidden states
+    K: int = 4  # hidden states (regimes)
+    duration: int = 1  # duration-expansion factor (Dmax; 1 = plain HMM)
+
+    @property
+    def state_width(self) -> int:
+        """The served/kerneled chain width: ``K * duration``."""
+        return int(self.K) * int(self.duration)
 
     def as_dict(self) -> Dict[str, int]:
-        return {"B": int(self.B), "T": int(self.T), "C": int(self.C), "K": int(self.K)}
+        d = {"B": int(self.B), "T": int(self.T), "C": int(self.C), "K": int(self.K)}
+        if int(self.duration) > 1:
+            d["duration"] = int(self.duration)
+        return d
 
 
 def _largest_divisor_leq(n: int, cap: int) -> int:
@@ -375,7 +392,9 @@ def _resolve_branch(shape: WorkloadShape, sp_ways: int, time_parallel, platform)
     from hhmm_tpu.kernels.dispatch import resolve_branch
 
     branches = {
-        resolve_branch(shape.K, shape.T, time_parallel, platform, kernel=k)
+        resolve_branch(
+            shape.state_width, shape.T, time_parallel, platform, kernel=k
+        )
         for k in ("filter", "viterbi", "ffbs")
     }
     if branches == {"assoc"}:

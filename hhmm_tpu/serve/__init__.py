@@ -14,6 +14,7 @@ and sheds (degraded responses, never exceptions), and the
 device-memory byte budget. See `docs/serving.md`.
 """
 
+from hhmm_tpu.serve.events import RegimeEvent, RegimeEventFeed
 from hhmm_tpu.serve.lanes import CarryBank, LaneTable
 from hhmm_tpu.serve.metrics import ServeMetrics, SLOSpec, evaluate_slo
 from hhmm_tpu.serve.pager import (
@@ -48,6 +49,8 @@ from hhmm_tpu.serve.scheduler import (
 __all__ = [
     "CarryBank",
     "LaneTable",
+    "RegimeEvent",
+    "RegimeEventFeed",
     "ServeMetrics",
     "SLOSpec",
     "evaluate_slo",

@@ -53,6 +53,7 @@ from hhmm_tpu.core.lmath import (
     safe_log_normalize,
     safe_logsumexp,
 )
+from hhmm_tpu.kernels.duration import collapse_probs
 from hhmm_tpu.kernels.filtering import _split_A, filter_step
 
 __all__ = [
@@ -183,6 +184,7 @@ def posterior_predictive_mean(
     log_A: jnp.ndarray,
     state_means: jnp.ndarray,
     weights: Optional[jnp.ndarray] = None,
+    dmax: int = 1,
 ) -> jnp.ndarray:
     """Posterior-predictive mean of the next observation, averaged over
     thinned posterior draws — the Hassan-style next-close point forecast
@@ -201,10 +203,28 @@ def posterior_predictive_mean(
     surviving mass falls back to averaging whatever per-draw forecasts
     are still FINITE — stricter than the tick response's
     all-frozen-draws average, because a frozen filter can be finite
-    while its NaN parameters still poison the forecast side."""
+    while its NaN parameters still poison the forecast side.
+
+    ``dmax``: the duration-expansion factor for explicit-duration
+    models (`models/hsmm.py`): with ``dmax > 1``, ``log_alpha`` /
+    ``log_A`` live on the expanded ``K * dmax`` chain while
+    ``state_means`` stays the per-REGIME ``[D, K]`` — the expanded
+    one-step predictive is collapsed to regime space
+    (`kernels/duration.py::collapse_probs`) before the mean dot.
+    Without the collapse a broadcast against ``[K]`` means would
+    silently mis-normalize; asserting the widths makes the mismatch
+    loud instead."""
     pred = jax.vmap(
         lambda a, lA: jnp.exp(predictive_state_logprobs(a, lA))
     )(log_alpha, log_A)
+    if dmax > 1:
+        pred = collapse_probs(pred, dmax)
+    if pred.shape[-1] != jnp.shape(state_means)[-1]:
+        raise ValueError(
+            f"predictive width {pred.shape[-1]} != state_means width "
+            f"{jnp.shape(state_means)[-1]} — expanded-state filter needs "
+            f"the matching dmax (models/hsmm.py: dmax = Dmax)"
+        )
     per_draw = jnp.sum(pred * state_means, axis=-1)  # [D]
     if weights is None:
         return jnp.mean(per_draw)

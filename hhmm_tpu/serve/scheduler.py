@@ -81,6 +81,8 @@ import jax.numpy as jnp
 
 from hhmm_tpu.batch.pad import pad_ragged
 from hhmm_tpu.core.lmath import safe_log_normalize
+from hhmm_tpu.kernels.duration import collapse_probs
+from hhmm_tpu.obs import metrics as obs_metrics
 from hhmm_tpu.obs import profile as obs_profile
 from hhmm_tpu.obs import request as obs_request
 from hhmm_tpu.obs.telemetry import register_jit
@@ -302,6 +304,7 @@ class MicroBatchScheduler:
         placement: Optional[DevicePlacement] = None,
         resident: bool = False,
         carry_slots_cap: Optional[int] = None,
+        events=None,
     ):
         """``plan``: an optional :class:`hhmm_tpu.plan.Plan` — the
         topology-aware placement decision (`docs/sharding.md`). When
@@ -377,6 +380,18 @@ class MicroBatchScheduler:
         self.plan = plan
         if plan is not None:
             plan.note()  # record the serving layout in run manifests
+        # optional regime-event feed (serve/events.py): every committed
+        # (non-shed) response is observed — flips/drift alarms become
+        # drainable per-tenant RegimeEvent records. Expanded-state
+        # models (models/hsmm.py, n_states = K * Dmax) are collapsed
+        # to regime space before observation; the feed and this hook
+        # both shed-never-raise, so a subscription cannot break ticks.
+        self.events = events
+        self._event_dmax = max(
+            1,
+            (int(getattr(model, "n_states", 0) or 0)
+             // max(1, int(getattr(model, "K", 1) or 1))),
+        )
         self.buckets = tuple(sorted(set(int(b) for b in buckets)))
         self.registry = registry
         self.metrics = metrics if metrics is not None else ServeMetrics()
@@ -1213,6 +1228,11 @@ class MicroBatchScheduler:
                 else:
                     keep.append(p)
             self._pending = keep
+        if self.events is not None:
+            # detector state is filter state — it leaves with the
+            # series (queued events survive; they happened). forget()
+            # sheds internally, never raises.
+            self.events.forget(series_id)
         return True
 
     def unregister(self, series_id: str) -> bool:
@@ -1276,8 +1296,14 @@ class MicroBatchScheduler:
     # ---- ticking ----
 
     def _resp_K(self) -> int:
-        """State dimension for synthesized (shed) responses."""
-        K = getattr(self.model, "K", None)
+        """State dimension for synthesized (shed) responses — the
+        SERVED filter width: expanded-state models (`models/hsmm.py`)
+        expose ``n_states = K * Dmax`` distinct from their regime
+        count ``K``, and a shed response must match the healthy
+        responses' probs width."""
+        K = getattr(self.model, "n_states", None) or getattr(
+            self.model, "K", None
+        )
         if K:
             return int(K)
         for sid, rec in self._series.items():
@@ -1303,6 +1329,32 @@ class MicroBatchScheduler:
             shed=True,
             error=error,
         )
+
+    def _note_event(self, series_id: str, tenant: str, resp) -> None:
+        """Feed one COMMITTED (non-shed, non-degraded) response to the
+        regime-event feed. Expanded-state models are collapsed to
+        regime probabilities first (`kernels/duration.py`), so flip
+        events are regime flips, not count-down lane flips. Degrade
+        rule: any failure here is counted and swallowed — an analytics
+        subscription must never break the tick path."""
+        if self.events is None or resp.degraded:
+            return
+        try:
+            probs = np.asarray(resp.probs, dtype=np.float64)
+            if self._event_dmax > 1 and probs.shape[-1] % self._event_dmax == 0:
+                probs = collapse_probs(probs, self._event_dmax)
+            evs = self.events.observe(
+                series_id,
+                tenant,
+                probs,
+                resp.loglik,
+                generation=self._attach_gen.get(series_id, 0),
+            )
+            if evs and self.recorder.enabled():
+                for ev in evs:
+                    self.recorder.note_event(ev.tenant, ev.kind)
+        except Exception:
+            obs_metrics.counter("serve.events_errors").inc()
 
     def _shed_now(
         self,
@@ -2327,6 +2379,7 @@ class MicroBatchScheduler:
                     draw_ok=okd_h[i],
                 )
             )
+            self._note_event(series_id, tenant, responses[-1])
             committed.append(flight.group[i])
             committed_traces.append(trace)
         self.metrics.note_h2d_bytes(flight.h2d_bytes)
@@ -2546,7 +2599,7 @@ class MicroBatchScheduler:
             # sentinel; commit boundaries materialize rows on demand.
             self._commit_carry(alpha, ll, okd, lane_key, group)
         responses = []
-        for i, (series_id, obs_i, t_submit, _, _) in enumerate(group):
+        for i, (series_id, obs_i, t_submit, tenant, _) in enumerate(group):
             rec = self._series[series_id]
             if self._lanes is None:
                 rec["alpha"], rec["ll"], rec["ok"] = alpha[i], ll[i], okd[i]
@@ -2571,6 +2624,7 @@ class MicroBatchScheduler:
                     draw_ok=okd_h[i],
                 )
             )
+            self._note_event(series_id, tenant, responses[-1])
         self.metrics.note_h2d_bytes(h2d)
         self.metrics.note_d2h_bytes(d2h)
         self.recorder.note_transfers(h2d, d2h)

@@ -1,8 +1,14 @@
-from hhmm_tpu.sim.hmm import hmm_sim, obsmodel_gaussian, obsmodel_categorical
+from hhmm_tpu.sim.hmm import (
+    hmm_sim,
+    hsmm_sim,
+    obsmodel_gaussian,
+    obsmodel_categorical,
+)
 from hhmm_tpu.sim.iohmm import iohmm_sim, obsmodel_reg, obsmodel_mix
 
 __all__ = [
     "hmm_sim",
+    "hsmm_sim",
     "obsmodel_gaussian",
     "obsmodel_categorical",
     "iohmm_sim",

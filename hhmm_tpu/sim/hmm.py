@@ -20,7 +20,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["hmm_sim", "markov_chain_sim", "obsmodel_gaussian", "obsmodel_categorical"]
+__all__ = [
+    "hmm_sim",
+    "hsmm_sim",
+    "markov_chain_sim",
+    "obsmodel_gaussian",
+    "obsmodel_categorical",
+]
 
 
 def _validate(A: np.ndarray, p_init: np.ndarray) -> None:
@@ -74,6 +80,64 @@ def obsmodel_categorical(phi) -> Callable:
         return jax.random.categorical(key, log_phi[z], axis=-1).astype(jnp.int32)
 
     return sample
+
+
+def hsmm_sim(
+    key: jax.Array,
+    T: int,
+    A,
+    dur,
+    p_init,
+    obs_model: Callable,
+    validate: bool = True,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Simulate ``(z [T], x [T])`` from an explicit-duration semi-Markov
+    chain (`models/hsmm.py`): on regime entry a dwell length d ∈
+    {1..Dmax} is drawn from the regime's duration pmf ``dur[k]``
+    ([K, Dmax] rows, ``dur[k, d-1]`` = P(duration = d | k)), the regime
+    holds for exactly d steps, then hands off through ``A[k]``.
+
+    ``z`` is the REGIME path (already collapsed — what
+    `kernels/duration.py::regime_path` recovers from expanded decodes).
+    The generator is the count-down chain itself, so a fitted
+    :class:`~hhmm_tpu.models.GaussianHSMM` is exactly well-specified
+    for this data; a geometric-duration HMM is not unless every
+    ``dur[k]`` happens to be geometric.
+    """
+    A = jnp.asarray(A)
+    dur = jnp.asarray(dur)
+    if validate:
+        _validate(np.asarray(A), np.asarray(p_init))
+        d_np = np.asarray(dur)
+        if d_np.ndim != 2 or d_np.shape[0] != np.asarray(p_init).shape[0]:
+            raise ValueError(
+                f"dur must be [K, Dmax] with K = {np.asarray(p_init).shape[0]}, "
+                f"got {d_np.shape}"
+            )
+        if np.any(d_np < 0) or not np.allclose(d_np.sum(axis=1), 1.0, atol=1e-6):
+            raise ValueError("rows of dur must be a pmf over {1..Dmax}")
+    log_A = jnp.log(A)
+    log_dur = jnp.log(dur)
+    log_p = jnp.log(jnp.asarray(p_init))
+    key_z, key_x = jax.random.split(key)
+    k0, k_d0, k_rest = jax.random.split(key_z, 3)
+    z0 = jax.random.categorical(k0, log_p)
+    c0 = jax.random.categorical(k_d0, log_dur[z0])  # remaining AFTER entry
+    keys = jax.random.split(k_rest, T - 1)
+
+    def step(carry, k):
+        z_prev, c_prev = carry
+        k_j, k_d = jax.random.split(k)
+        j = jax.random.categorical(k_j, log_A[z_prev])
+        d = jax.random.categorical(k_d, log_dur[j])
+        z = jnp.where(c_prev > 0, z_prev, j)
+        c = jnp.where(c_prev > 0, c_prev - 1, d)
+        return (z, c), z
+
+    _, z_rest = jax.lax.scan(step, (z0, c0), keys)
+    z = jnp.concatenate([z0[None], z_rest]).astype(jnp.int32)
+    x = obs_model(key_x, z)
+    return z, x
 
 
 def hmm_sim(

@@ -388,6 +388,20 @@ def render_request(man: Dict[str, Any], out) -> None:
             rows,
             out,
         )
+    events = req.get("events")
+    if isinstance(events, dict):
+        print(
+            f"  regime events: {_fmt(events.get('flips'))} flips, "
+            f"{_fmt(events.get('drifts'))} drift alarms "
+            f"(serve/events.py feed)",
+            file=out,
+        )
+        rows = []
+        for tenant, t in sorted((events.get("tenants") or {}).items()):
+            if not isinstance(t, dict):
+                continue
+            rows.append((tenant, _fmt(t.get("flips")), _fmt(t.get("drifts"))))
+        _table(("tenant", "flips", "drifts"), rows, out)
 
 
 def render_kernel_costs(man: Dict[str, Any], out) -> None:

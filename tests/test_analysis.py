@@ -649,6 +649,47 @@ class TestLayering:
         hits = _fires(rep, "layer-import")
         assert len(hits) == 1 and "unmapped" in hits[0].message
 
+    def test_duration_kernel_rank_pair(self, tmp_path):
+        """The HSMM expansion module (`kernels/duration.py`) lives at
+        kernel rank: importing core's guarded lmath is a down-edge
+        (good fixture, silent); importing the model zoo that CONSUMES
+        the expansion is a back-edge (bad fixture, fires) — the
+        expansion must stay model-agnostic."""
+        good = (
+            "from hhmm_tpu.core.lmath import MASK_NEG, safe_logsumexp\n\n"
+            "def expand(a):\n"
+            "    return a + MASK_NEG\n"
+        )
+        rep = _run(tmp_path, {"hhmm_tpu/kernels/toy_duration.py": good},
+                   ["layer-import"])
+        assert not _fires(rep, "layer-import"), _ids(rep)
+        bad = "from hhmm_tpu.models.hsmm import GaussianHSMM\n"
+        rep = _run(tmp_path, {"hhmm_tpu/kernels/toy_duration.py": bad},
+                   ["layer-import"])
+        hits = _fires(rep, "layer-import")
+        assert len(hits) == 1 and "back-edge" in hits[0].message
+
+    def test_events_serve_rank_pair(self, tmp_path):
+        """The regime-event feed (`serve/events.py`) lives at serve
+        rank: consuming obs metrics and kernels' collapse is downward
+        (good fixture, silent); reaching UP into the adaptation or
+        maintenance planes that subscribe to it fires — subscribers
+        poll the feed, the feed must not know them."""
+        good = (
+            "from hhmm_tpu.obs import metrics as m\n"
+            "from hhmm_tpu.kernels.duration import collapse_probs\n\n"
+            "def observe(p):\n"
+            "    return collapse_probs(p, 1)\n"
+        )
+        rep = _run(tmp_path, {"hhmm_tpu/serve/toy_events.py": good},
+                   ["layer-import"])
+        assert not _fires(rep, "layer-import"), _ids(rep)
+        bad = "from hhmm_tpu.adapt import anything\n"
+        rep = _run(tmp_path, {"hhmm_tpu/serve/toy_events.py": bad},
+                   ["layer-import"])
+        hits = _fires(rep, "layer-import")
+        assert len(hits) == 1 and "back-edge" in hits[0].message
+
     def test_pipeline_to_serve_back_edge_fires(self, tmp_path):
         """The PR 18 contract: ``pipeline`` ranks BELOW ``serve`` —
         a pipeline module importing the serving layer is a back-edge

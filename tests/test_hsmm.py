@@ -1,0 +1,342 @@
+"""Explicit-duration HSMM tests (`models/hsmm.py`, `kernels/duration.py`).
+
+Three contracts pinned here:
+
+1. **Bitwise degeneracy** — a ``Dmax=1`` :class:`GaussianHSMM` IS
+   :class:`GaussianHMM`: same expanded operators bit for bit, same
+   filter logliks, same smoothed posteriors, same FFBS streams draw for
+   draw (the duration simplex has zero free parameters at ``Dmax=1``,
+   so the two models share the unconstrained coordinate space too).
+2. **Structure through the guarded semiring** — off-structure cells sit
+   at the finite ``MASK_NEG`` floor, forbidden durations may arrive as
+   ``-inf`` and must degrade (no NaNs) through filter/smooth/FFBS, and
+   ragged masks behave exactly as on any plain HMM of width K*Dmax.
+3. **Duration recovery beats the geometric chain** — on simulated
+   peaked-dwell data (`sim/hmm.py::hsmm_sim`) the fitted HSMM's
+   held-out one-step predictive loglik beats a geometric-duration
+   GaussianHMM fitted on the same series (paired per series, pooled
+   over held-out steps) — the reason the model family exists.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hhmm_tpu.core.lmath import MASK_NEG, safe_log
+from hhmm_tpu.infer import GibbsConfig, sample_gibbs
+from hhmm_tpu.kernels import (
+    duration,
+    ffbs_sample,
+    forward_filter,
+    backward_pass,
+    smooth,
+)
+from hhmm_tpu.models import GaussianHMM, GaussianHSMM, MultinomialHSMM, NIGPrior
+from hhmm_tpu.sim import hmm_sim, hsmm_sim, obsmodel_gaussian
+
+
+def _gauss_data(T=60, seed=0):
+    rng = np.random.default_rng(seed)
+    x = np.concatenate(
+        [rng.normal(-1.0, 0.5, T // 2), rng.normal(1.0, 0.5, T - T // 2)]
+    ).astype(np.float32)
+    return {"x": jnp.asarray(x)}
+
+
+class TestDmax1Degeneracy:
+    """The bitwise pin: Dmax=1 HSMM == GaussianHMM."""
+
+    def test_filter_smooth_ffbs_bitwise(self):
+        data = _gauss_data()
+        hmm = GaussianHMM(K=3)
+        hsmm = GaussianHSMM(K=3, Dmax=1)
+        # identical free-parameter space at Dmax=1 (0-param simplex)
+        assert hsmm.n_free == hmm.n_free
+        q = hmm.init_unconstrained(jax.random.PRNGKey(0), data)
+        p_hmm, _ = hmm.unpack(q)
+        p_hsmm, _ = hsmm.unpack(q)
+        b_hmm = hmm.build(p_hmm, data)
+        b_hsmm = hsmm.build(p_hsmm, data)
+        for a, b in zip(b_hmm[:3], b_hsmm[:3]):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        la1, ll1 = forward_filter(*b_hmm[:3])
+        la2, ll2 = forward_filter(*b_hsmm[:3])
+        np.testing.assert_array_equal(np.asarray(ll1), np.asarray(ll2))
+        lb1 = backward_pass(b_hmm[1], b_hmm[2])
+        lb2 = backward_pass(b_hsmm[1], b_hsmm[2])
+        np.testing.assert_array_equal(
+            np.asarray(smooth(la1, lb1)), np.asarray(smooth(la2, lb2))
+        )
+        k = jax.random.PRNGKey(7)
+        z1 = ffbs_sample(k, *b_hmm[:3])
+        z2 = ffbs_sample(k, *b_hsmm[:3])
+        np.testing.assert_array_equal(np.asarray(z1), np.asarray(z2))
+
+    def test_gibbs_runs_with_degenerate_duration(self):
+        """sample_gibbs on a Dmax=1 HSMM: the duration simplex has zero
+        free parameters, so the chain runs, logp stays finite, and
+        every constrained duration draw is exactly the all-mass-on-1
+        pmf. (Full-chain draw-for-draw parity with GaussianHMM is NOT
+        the contract — the HSMM conjugate block consumes one extra
+        subkey for its duration Dirichlet; FFBS parity given the same
+        build is pinned above.)"""
+        data = _gauss_data(T=40)
+        prior = NIGPrior(m0=0.0, kappa0=0.1, a0=2.0, b0=1.0)
+        model = GaussianHSMM(K=2, Dmax=1, nig_prior=prior)
+        cfg = GibbsConfig(num_warmup=3, num_samples=5, num_chains=1)
+        init = model.init_unconstrained(jax.random.PRNGKey(3), {
+            k: np.asarray(v) for k, v in data.items()})
+        qs, stats = sample_gibbs(
+            model, data, jax.random.PRNGKey(11), cfg, init_q=init[None]
+        )
+        assert np.isfinite(np.asarray(stats["logp"])).all()
+        dur = np.asarray(model.constrained_draws(qs)["dur_kd"])
+        np.testing.assert_array_equal(dur, np.ones_like(dur))
+
+    def test_expansions_are_identity_at_dmax1(self):
+        log_A = safe_log(jnp.asarray([[0.9, 0.1], [0.2, 0.8]], jnp.float32))
+        log_dur = jnp.zeros((2, 1), jnp.float32)  # all mass on d=1
+        np.testing.assert_array_equal(
+            np.asarray(duration.expand_transition(log_A, log_dur)),
+            np.asarray(log_A),
+        )
+        log_obs = jnp.asarray(np.random.default_rng(0).normal(size=(5, 2)),
+                              jnp.float32)
+        np.testing.assert_array_equal(
+            np.asarray(duration.expand_obs(log_obs, 1)), np.asarray(log_obs)
+        )
+
+
+class TestExpansionStructure:
+    def test_count_down_rows_and_mask_neg_floor(self):
+        K, Dmax = 2, 3
+        A = jnp.asarray([[0.1, 0.9], [0.6, 0.4]], jnp.float32)
+        dur = jnp.asarray([[0.2, 0.3, 0.5], [0.7, 0.2, 0.1]], jnp.float32)
+        L = np.asarray(duration.expand_transition(safe_log(A), safe_log(dur)))
+        assert L.shape == (K * Dmax, K * Dmax)
+        for k in range(K):
+            for c in range(1, Dmax):
+                row = L[k * Dmax + c]
+                tgt = k * Dmax + (c - 1)
+                assert row[tgt] == 0.0  # deterministic continue
+                off = np.delete(row, tgt)
+                np.testing.assert_array_equal(off, MASK_NEG)
+            # entry row normalizes: sum_j A[k,j] * dur[j,:] == 1
+            entry = L[k * Dmax + 0]
+            assert np.isclose(np.exp(entry).sum(), 1.0, atol=1e-5)
+
+    def test_forbidden_inf_duration_cells_degrade(self):
+        """-inf duration cells (hard-forbidden dwells) must flow
+        through filter/smooth/FFBS without NaNs, and the forbidden
+        dwell must never be visited by decoded paths."""
+        K, Dmax, T = 2, 3, 40
+        A = jnp.asarray([[0.0, 1.0], [1.0, 0.0]], jnp.float32)
+        # regime 0 forbids d=1: log(0) = -inf through plain jnp.log
+        dur = jnp.asarray([[0.0, 0.5, 0.5], [0.5, 0.5, 0.0]], jnp.float32)
+        log_dur = jnp.log(dur)
+        assert not np.isfinite(np.asarray(log_dur)).all()
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=T), jnp.float32)
+        model = GaussianHSMM(K=K, Dmax=Dmax)
+        params = {
+            "p_1k": jnp.asarray([0.5, 0.5], jnp.float32),
+            "A_ij": A,
+            "dur_kd": dur,
+            "mu_k": jnp.asarray([-1.0, 1.0], jnp.float32),
+            "sigma_k": jnp.asarray([1.0, 1.0], jnp.float32),
+        }
+        log_pi, log_A, log_obs, _ = model.build(params, {"x": x})
+        la, ll = forward_filter(log_pi, log_A, log_obs)
+        assert np.isfinite(float(ll))
+        gamma = smooth(la, backward_pass(log_A, log_obs))
+        assert np.isfinite(np.asarray(gamma)).all()
+        z = ffbs_sample(jax.random.PRNGKey(0), log_pi, log_A, log_obs)
+        zk = np.asarray(model.regime_path(z))
+        assert set(np.unique(zk)) <= {0, 1}
+        # no dwell of length 1 in regime 0 (its d=1 mass is zero):
+        # every maximal run of regime 0 must span >= 2 steps (ignore a
+        # possibly-truncated final run)
+        runs, cur, n = [], zk[0], 1
+        for v in zk[1:]:
+            if v == cur:
+                n += 1
+            else:
+                runs.append((cur, n))
+                cur, n = v, 1
+        assert all(n >= 2 for k, n in runs if k == 0)
+
+    def test_ragged_mask_matches_truncation(self):
+        """Mask semantics on the expanded chain are the plain-HMM
+        contract: loglik under a tail mask == loglik of the truncated
+        series."""
+        model = GaussianHSMM(K=2, Dmax=4)
+        T, T_valid = 50, 31
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=T).astype(np.float32)
+        params = {
+            "p_1k": jnp.asarray([0.6, 0.4], jnp.float32),
+            "A_ij": jnp.asarray([[0.1, 0.9], [0.8, 0.2]], jnp.float32),
+            "dur_kd": jnp.asarray(
+                np.full((2, 4), 0.25), jnp.float32
+            ),
+            "mu_k": jnp.asarray([-0.5, 0.5], jnp.float32),
+            "sigma_k": jnp.asarray([0.8, 0.8], jnp.float32),
+        }
+        mask = jnp.asarray((np.arange(T) < T_valid).astype(np.float32))
+        b = model.build(params, {"x": jnp.asarray(x), "mask": mask})
+        _, ll_masked = forward_filter(b[0], b[1], b[2], mask)
+        b_trunc = model.build(params, {"x": jnp.asarray(x[:T_valid])})
+        _, ll_trunc = forward_filter(*b_trunc[:3])
+        np.testing.assert_allclose(
+            float(ll_masked), float(ll_trunc), rtol=1e-6
+        )
+
+    def test_resolve_auto_expanded_widths(self):
+        """The dispatch ladder must resolve (not crash, not fall into
+        an unmeasured hole) at every bucket-relevant expanded width:
+        the HSMM presents as a plain HMM with K' = K*Dmax."""
+        from hhmm_tpu.kernels.dispatch import resolve_auto
+
+        for K, Dmax in ((2, 6), (3, 8), (4, 16)):
+            for T in (128, 1024):
+                branch, source = resolve_auto(K * Dmax, T, kernel="filter")
+                assert branch in ("seq", "assoc", "pallas")
+                assert source in ("plan", "db", "table", "default")
+
+    def test_collapse_round_trips(self):
+        rng = np.random.default_rng(3)
+        p = rng.dirichlet(np.ones(12), size=(5,)).astype(np.float32)
+        c = duration.collapse_probs(p, 4)
+        assert c.shape == (5, 3)
+        np.testing.assert_allclose(c.sum(-1), 1.0, rtol=1e-5)
+        lm = duration.regime_log_marginals(safe_log(jnp.asarray(p)), 4)
+        np.testing.assert_allclose(np.exp(np.asarray(lm)), c, rtol=1e-4)
+        z = jnp.arange(12)
+        np.testing.assert_array_equal(
+            np.asarray(duration.regime_path(z, 4)), np.arange(12) // 4
+        )
+
+
+class TestSticky:
+    def test_sticky_prior_term(self):
+        params = {
+            "p_1k": jnp.asarray([0.5, 0.5], jnp.float32),
+            "A_ij": jnp.asarray([[0.9, 0.1], [0.3, 0.7]], jnp.float32),
+            "mu_k": jnp.asarray([-1.0, 1.0], jnp.float32),
+            "sigma_k": jnp.asarray([1.0, 1.0], jnp.float32),
+        }
+        base = GaussianHMM(K=2)
+        sticky = GaussianHMM(K=2, sticky_kappa=3.0)
+        expect = 3.0 * float(np.log(0.9) + np.log(0.7))
+        got = float(sticky.log_prior(params)) - float(base.log_prior(params))
+        assert np.isclose(got, expect, rtol=1e-5)
+        with pytest.raises(ValueError, match="sticky_kappa"):
+            GaussianHMM(K=2, sticky_kappa=-0.1)
+        with pytest.raises(ValueError, match="sticky_kappa"):
+            GaussianHSMM(K=2, Dmax=2, sticky_kappa=-1.0)
+
+    def test_sticky_gibbs_concentrates_diagonal(self):
+        """With a large kappa the posterior transition diagonal drawn
+        by the conjugate block must dominate the kappa=0 draw — on
+        fast-switching data, where the likelihood alone puts the
+        diagonal LOW and the sticky pseudo-counts must pull it up."""
+        _, x = hmm_sim(
+            jax.random.PRNGKey(4), 80,
+            np.array([[0.3, 0.7], [0.7, 0.3]]), np.array([0.5, 0.5]),
+            obsmodel_gaussian(np.array([-1.0, 1.0]), np.array([0.4, 0.4])),
+        )
+        data = {"x": jnp.asarray(np.asarray(x, np.float32))}
+        prior = NIGPrior(m0=0.0, kappa0=0.1, a0=2.0, b0=1.0)
+        cfg = GibbsConfig(num_warmup=5, num_samples=30, num_chains=1)
+        diags = {}
+        for kappa in (0.0, 200.0):
+            model = GaussianHMM(K=2, nig_prior=prior, sticky_kappa=kappa)
+            np_data = {k: np.asarray(v) for k, v in data.items()}
+            init = model.init_unconstrained(jax.random.PRNGKey(5), np_data)
+            qs, _ = sample_gibbs(
+                model, data, jax.random.PRNGKey(6), cfg, init_q=init[None]
+            )
+            A = np.asarray(model.constrained_draws(qs)["A_ij"])
+            diags[kappa] = float(
+                np.diagonal(A.mean(axis=(0, 1))).mean()
+            )
+        assert diags[200.0] > diags[0.0] + 0.2
+
+
+class TestSnapshotRoundTrip:
+    def test_model_spec_round_trips_hsmm(self):
+        from hhmm_tpu.serve.registry import build_model, model_spec
+
+        m = GaussianHSMM(
+            K=3, Dmax=5,
+            nig_prior=NIGPrior(m0=1.0, kappa0=0.5),
+            sticky_kappa=2.0,
+        )
+        m2 = build_model(model_spec(m))
+        assert isinstance(m2, GaussianHSMM)
+        assert (m2.K, m2.Dmax, m2.sticky_kappa) == (3, 5, 2.0)
+        assert m2.nig_prior == m.nig_prior
+        m3 = build_model(model_spec(MultinomialHSMM(K=2, Dmax=3, L=4)))
+        assert (m3.K, m3.Dmax, m3.L) == (2, 3, 4)
+
+
+def _heldout_onestep(model, qs, x_all, T_train):
+    """Pooled held-out one-step predictive loglik, draw-averaged:
+    filter each posterior draw over the FULL series; the test-segment
+    increment ll(x_{1:T}) - ll(x_{1:T_train}) pools the per-step
+    one-step predictive logliks over the held-out steps."""
+    data = {"x": jnp.asarray(x_all)}
+
+    def one(q):
+        params, _ = model.unpack(q)
+        log_pi, log_A, log_obs, _ = model.build(params, data)
+        _, ll_full = forward_filter(log_pi, log_A, log_obs)
+        _, ll_train = forward_filter(log_pi, log_A, log_obs[:T_train])
+        return ll_full - ll_train
+
+    vals = jax.vmap(one)(qs)
+    return float(jnp.mean(vals))
+
+
+class TestDurationRecovery:
+    def test_hsmm_beats_geometric_hmm_heldout(self):
+        """The acceptance gate: on peaked-dwell simulated data the
+        fitted HSMM beats the geometric-duration HMM on held-out
+        one-step predictive loglik — paired per series, pooled over
+        held-out steps and series."""
+        K, Dmax, T, T_train, S = 2, 6, 300, 220, 4
+        A = np.array([[0.0, 1.0], [1.0, 0.0]])
+        dur = np.array(
+            [[0.0, 0.0, 0.1, 0.3, 0.4, 0.2],
+             [0.0, 0.1, 0.4, 0.4, 0.1, 0.0]]
+        )
+        mu, sigma = np.array([-0.9, 0.9]), np.array([0.75, 0.75])
+        prior = NIGPrior(m0=0.0, kappa0=0.1, a0=2.0, b0=1.0)
+        cfg = GibbsConfig(num_warmup=60, num_samples=120, num_chains=1)
+        margins = []
+        for s in range(S):
+            _, x = hsmm_sim(
+                jax.random.PRNGKey(100 + s), T, A, dur, np.ones(K) / K,
+                obsmodel_gaussian(mu, sigma),
+            )
+            x = np.asarray(x, np.float32)
+            train = {"x": jnp.asarray(x[:T_train])}
+            np_train = {"x": x[:T_train]}
+            pooled = {}
+            for tag, model in (
+                ("hsmm", GaussianHSMM(K=K, Dmax=Dmax, nig_prior=prior)),
+                ("hmm", GaussianHMM(K=K, nig_prior=prior)),
+            ):
+                init = model.init_unconstrained(
+                    jax.random.PRNGKey(200 + s), np_train
+                )
+                qs, _ = sample_gibbs(
+                    model, train, jax.random.PRNGKey(300 + s), cfg,
+                    init_q=init[None],
+                )
+                # thin to keep the vmapped full-series filters cheap
+                pooled[tag] = _heldout_onestep(model, qs[0, ::4], x, T_train)
+            margins.append(pooled["hsmm"] - pooled["hmm"])
+        # paired pooled margin: HSMM must win on aggregate
+        assert sum(margins) > 0.0, margins

@@ -120,6 +120,27 @@ class TestPlannerGolden:
         assert isinstance(st["reason"], str) and "sp=4" in st["reason"]
         json.dumps(st)  # must be JSON-clean for manifests
 
+    def test_duration_expands_state_width_not_digest(self):
+        """The HSMM expansion factor (`models/hsmm.py` Dmax): branch
+        resolution sees state_width = K * duration, while as_dict —
+        the manifest-digest surface — emits `duration` ONLY when > 1,
+        so every pre-HSMM workload digest is unchanged."""
+        plain = WorkloadShape(B=4, T=64, K=3)
+        assert plain.state_width == 3
+        assert "duration" not in plain.as_dict()
+        exp = WorkloadShape(B=4, T=64, K=3, duration=8)
+        assert exp.state_width == 24
+        assert exp.as_dict()["duration"] == 8
+        assert plain.as_dict() == {"B": 4, "T": 64, "C": 1, "K": 3}
+        # the plan resolves its branch at the EXPANDED width: a plan
+        # for (K=3, duration=8) is the plan for a plain K=24 chain
+        p_exp = make_plan(exp, n_devices=1, platform="cpu")
+        p_wide = make_plan(
+            WorkloadShape(B=4, T=64, K=24), n_devices=1, platform="cpu"
+        )
+        assert p_exp.branch == p_wide.branch
+        json.dumps(p_exp.stanza())
+
     def test_stanza_noted_in_manifests(self):
         p = make_plan(
             WorkloadShape(B=3, T=32), n_devices=4, chunk_size=3, platform="cpu"

@@ -1,0 +1,290 @@
+"""Regime-event feed tests (`serve/events.py`) and its scheduler /
+request-stanza integration.
+
+The feed is an analytics SUBSCRIPTION on the tick path, so the serve
+degrade discipline is the headline contract: observation and drain shed
+(counted, swallowed), never raise; queues are bounded per tenant with
+drop-oldest; detach forgets detector state but keeps queued events.
+The integration test is the acceptance scenario: a 256-series mixed
+HMM+HSMM replay through two schedulers sharing one bucket ladder and
+one feed stays compile-flat after warmup, drains >= 1 event per tenant,
+and escapes zero exceptions.
+"""
+
+import numpy as np
+
+from hhmm_tpu.models import GaussianHMM, GaussianHSMM
+from hhmm_tpu.obs.request import RequestRecorder
+from hhmm_tpu.serve import (
+    MicroBatchScheduler,
+    PosteriorSnapshot,
+    RegimeEvent,
+    RegimeEventFeed,
+    model_spec,
+)
+
+
+def _flip_probs(regime, K=2, p=0.95):
+    out = np.full(K, (1.0 - p) / (K - 1))
+    out[regime] = p
+    return out
+
+
+class TestFeedUnit:
+    def test_flip_events_publish_and_drain_per_tenant(self):
+        feed = RegimeEventFeed(hold=2, drift_threshold=None)
+        for t in range(4):  # regime 0, committed at hold=2
+            feed.observe("a", "tenA", _flip_probs(0), -1.0)
+            feed.observe("b", "tenB", _flip_probs(0), -1.0)
+        for t in range(4):  # flip to regime 1
+            feed.observe("a", "tenA", _flip_probs(1), -1.0)
+            feed.observe("b", "tenB", _flip_probs(1), -1.0)
+        assert feed.queued("tenA") >= 1 and feed.queued("tenB") >= 1
+        evs_a = feed.drain(tenant="tenA")
+        assert evs_a and all(isinstance(e, RegimeEvent) for e in evs_a)
+        assert all(e.tenant == "tenA" and e.kind == "flip" for e in evs_a)
+        assert evs_a[-1].regime == 1
+        assert feed.queued("tenA") == 0 and feed.queued("tenB") >= 1
+        rest = feed.drain()
+        assert rest and all(e.tenant == "tenB" for e in rest)
+        st = feed.stanza()
+        assert st["errors"] == 0
+        assert st["tenants"]["tenA"]["drained"] == len(evs_a)
+        assert st["tenants"]["tenB"]["queued"] == 0
+
+    def test_queue_cap_drops_oldest(self):
+        feed = RegimeEventFeed(hold=1, drift_threshold=None, queue_cap=3)
+        # alternate every tick at hold=1: a flip per observation after
+        # the first commit
+        for t in range(10):
+            feed.observe("s", "ten", _flip_probs(t % 2), -1.0)
+        assert feed.queued("ten") == 3
+        evs = feed.drain(tenant="ten")
+        assert len(evs) == 3
+        st = feed.stanza()["tenants"]["ten"]
+        assert st["dropped"] == st["published"] - 3
+        assert st["dropped"] > 0
+        # the survivors are the NEWEST events
+        assert evs[-1].tick == 10
+
+    def test_drift_alarm_and_generation_restart(self):
+        feed = RegimeEventFeed(
+            hold=3, drift_threshold=4.0, drift_rate=0.1, drift_calibrate=8
+        )
+        ll = 0.0
+        for t in range(30):  # steady per-tick increments: calibration
+            ll += -1.0
+            assert feed.observe("s", "ten", _flip_probs(0), ll, generation=0) == []
+        # a generation bump with a big level jump must NOT alarm: the
+        # differencing baseline restarts instead of seeing a -500 step
+        ll2 = -500.0
+        evs = feed.observe("s", "ten", _flip_probs(0), ll2, generation=1)
+        assert evs == []
+        for t in range(5):
+            ll2 += -1.0
+            evs = feed.observe("s", "ten", _flip_probs(0), ll2, generation=1)
+            assert all(e.kind != "drift" for e in evs)
+        # within-generation collapse of the increments DOES alarm
+        drifted = []
+        for t in range(20):
+            ll2 += -9.0
+            drifted += feed.observe("s", "ten", _flip_probs(0), ll2, generation=1)
+        assert any(e.kind == "drift" for e in drifted)
+
+    def test_observe_sheds_never_raises(self):
+        feed = RegimeEventFeed(hold=1)
+        base = feed.stanza()["errors"]
+        # garbage inputs: non-numeric loglik trips inside the lock
+        assert feed.observe("s", "ten", _flip_probs(0), "not-a-float") == []
+        assert feed.stanza()["errors"] == base + 1
+        # NaN / wrong-rank probs are skipped silently (no flip state),
+        # not errors
+        assert feed.observe("s", "ten", np.array([np.nan, 1.0]), -1.0) == []
+        assert feed.observe("s", "ten", np.zeros((2, 2)), -1.0) == []
+        # a broken detector inside the locked section is counted too
+        feed.observe("s2", "ten", _flip_probs(0), -1.0)
+        feed._series["s2"].detector.update = None  # type: ignore[assignment]
+        assert feed.observe("s2", "ten", _flip_probs(0), -1.0) == []
+        assert feed.stanza()["errors"] >= base + 2
+
+    def test_drain_sheds_never_raises(self):
+        feed = RegimeEventFeed(hold=1, drift_threshold=None)
+        for t in range(4):
+            feed.observe("s", "ten", _flip_probs(t % 2), -1.0)
+        feed._queues = None  # type: ignore[assignment]  # sabotage
+        assert feed.drain() == []
+        feed._queues = {}  # restore so the accounting read works
+        assert feed.stanza()["errors"] >= 1
+
+    def test_forget_keeps_queued_events(self):
+        feed = RegimeEventFeed(hold=1, drift_threshold=None)
+        for t in range(4):
+            feed.observe("s", "ten", _flip_probs(t % 2), -1.0)
+        n = feed.queued("ten")
+        assert n > 0
+        feed.forget("s")
+        assert feed.stanza()["series_tracked"] == 0
+        assert feed.queued("ten") == n  # events survive detach
+
+    def test_series_cap_lru(self):
+        feed = RegimeEventFeed(hold=1, drift_threshold=None, series_cap=4)
+        for i in range(10):
+            feed.observe(f"s{i}", "ten", _flip_probs(0), -1.0)
+        assert feed.stanza()["series_tracked"] == 4
+
+
+def _packed_snapshot(model, params, n_draws=2):
+    q = np.asarray(model.pack(params), np.float32)
+    return PosteriorSnapshot(
+        spec=model_spec(model),
+        draws=np.repeat(q[None], n_draws, axis=0),
+        healthy=True,
+    )
+
+
+class TestSchedulerIntegration:
+    def test_mixed_hmm_hsmm_replay_compile_flat_events_per_tenant(self):
+        """The acceptance scenario: 256 series split across a plain
+        GaussianHMM and a duration-expanded GaussianHSMM, served by two
+        schedulers sharing one bucket ladder and ONE event feed, driven
+        through a mid-replay regime break. Post-warmup both schedulers
+        are compile-flat, every tenant drains >= 1 RegimeEvent, and no
+        response carries an error."""
+        feed = RegimeEventFeed(hold=2, margin=0.0, drift_threshold=None)
+        buckets = (8, 128)  # the SHARED ladder
+        n_ten = 8
+        hmm = GaussianHMM(K=2)
+        hsmm = GaussianHSMM(K=2, Dmax=4)
+        p_hmm = {
+            "p_1k": np.array([0.5, 0.5]),
+            "A_ij": np.array([[0.95, 0.05], [0.05, 0.95]]),
+            "mu_k": np.array([-2.0, 2.0]),
+            "sigma_k": np.array([1.0, 1.0]),
+        }
+        p_hsmm = dict(
+            p_hmm, dur_kd=np.full((2, 4), 0.25)
+        )
+        scheds = {}
+        for tag, model, params in (
+            ("hmm", hmm, p_hmm), ("hsmm", hsmm, p_hsmm)
+        ):
+            snap = _packed_snapshot(model, params)
+            sched = MicroBatchScheduler(model, buckets=buckets, events=feed)
+            rejected = sched.attach_many(
+                [
+                    (f"{tag}-{i}", snap, None, f"ten{i % n_ten}")
+                    for i in range(128)
+                ]
+            )
+            assert rejected == []
+            scheds[tag] = sched
+        rng = np.random.default_rng(0)
+        T = 12
+
+        def replay(t):
+            level = -2.0 if t < T // 2 else 2.0  # the regime break
+            out = []
+            for tag, sched in scheds.items():
+                for i in range(128):
+                    sched.submit(
+                        f"{tag}-{i}",
+                        {"x": level + 0.1 * rng.standard_normal()},
+                    )
+                out.extend(sched.flush())
+            return out
+
+        for t in range(2):  # warmup: init + update kernels compile
+            for r in replay(t):
+                assert r.error is None
+        warm = {tag: s.metrics.compile_count for tag, s in scheds.items()}
+        for t in range(2, T):
+            for r in replay(t):
+                assert r.error is None
+                assert not r.degraded
+        for tag, sched in scheds.items():
+            assert sched.metrics.compile_count == warm[tag], tag
+        evs = feed.drain()
+        by_tenant = {}
+        for e in evs:
+            by_tenant.setdefault(e.tenant, []).append(e)
+        assert set(by_tenant) == {f"ten{i}" for i in range(n_ten)}
+        assert all(len(v) >= 1 for v in by_tenant.values())
+        # expanded-state responses were collapsed before detection:
+        # flips are regime indices, not count-down lanes
+        assert all(
+            e.regime is not None and e.regime < 2
+            for e in evs if e.kind == "flip"
+        )
+        assert feed.stanza()["errors"] == 0
+
+    def test_detach_forgets_feed_state(self):
+        feed = RegimeEventFeed(hold=1, drift_threshold=None)
+        model = GaussianHMM(K=2)
+        params = {
+            "p_1k": np.array([0.5, 0.5]),
+            "A_ij": np.array([[0.9, 0.1], [0.1, 0.9]]),
+            "mu_k": np.array([-1.0, 1.0]),
+            "sigma_k": np.array([0.8, 0.8]),
+        }
+        sched = MicroBatchScheduler(
+            model, buckets=(4,), events=feed
+        )
+        sched.attach("s0", _packed_snapshot(model, params), tenant="tenX")
+        for t in range(3):
+            sched.submit("s0", {"x": (-1.0) ** t})
+            assert all(r.error is None for r in sched.flush())
+        assert feed.stanza()["series_tracked"] == 1
+        assert sched.detach("s0")
+        assert feed.stanza()["series_tracked"] == 0
+
+
+class TestRequestStanza:
+    def test_events_block_and_render(self):
+        rec = RequestRecorder(enabled=True)
+        st = rec.stanza()
+        assert "events" in st and st["events"] is None  # shape-stable
+        rec.note_event("tenA", "flip")
+        rec.note_event("tenA", "drift")
+        rec.note_event("tenB", "flip")
+        st = rec.stanza()
+        ev = st["events"]
+        assert ev["flips"] == 2 and ev["drifts"] == 1
+        assert ev["tenants"]["tenA"] == {"flips": 1, "drifts": 1}
+        # key order: the events block sits between scheduler and
+        # pipeline (stanza diffing tools key on stable ordering)
+        keys = list(st)
+        assert keys.index("scheduler") < keys.index("events") < keys.index(
+            "pipeline"
+        )
+        import io
+
+        from scripts.obs_report import render_request
+
+        buf = io.StringIO()
+        render_request({"request": st}, buf)
+        out = buf.getvalue()
+        assert "regime events" in out and "2 flips" in out
+        assert "tenA" in out
+        rec.reset_window()
+        assert rec.stanza()["events"] is None
+
+    def test_scheduler_notes_events_to_recorder(self):
+        feed = RegimeEventFeed(hold=1, drift_threshold=None)
+        rec = RequestRecorder(enabled=True)
+        model = GaussianHMM(K=2)
+        params = {
+            "p_1k": np.array([0.5, 0.5]),
+            "A_ij": np.array([[0.9, 0.1], [0.1, 0.9]]),
+            "mu_k": np.array([-2.0, 2.0]),
+            "sigma_k": np.array([0.7, 0.7]),
+        }
+        sched = MicroBatchScheduler(
+            model, buckets=(4,), events=feed, recorder=rec
+        )
+        sched.attach("s0", _packed_snapshot(model, params), tenant="tenZ")
+        for t in range(6):
+            sched.submit("s0", {"x": -2.0 if t < 3 else 2.0})
+            assert all(r.error is None for r in sched.flush())
+        ev = rec.stanza()["events"]
+        assert ev is not None and ev["flips"] >= 1
+        assert "tenZ" in ev["tenants"]
